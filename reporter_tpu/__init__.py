@@ -1,0 +1,23 @@
+"""tpu-reporter: a TPU-native GPS map-matching framework.
+
+Re-implements the capabilities of Open Traffic Reporter (the reference at
+/root/reference) with the Valhalla/Meili C++ HMM matcher replaced by a batched
+JAX/XLA dynamic program over [batch, timestep, candidates] on TPU.
+
+Package layout:
+  geo          -- geodesy helpers (numpy + jax)
+  tiles        -- tile hierarchy, segment-id bit layout, road network, dense
+                  device arrays, UBODT route-distance precompute, tile codec
+  ops          -- JAX kernels: candidate lookup, hash-table probe, Viterbi
+  matching     -- SegmentMatcher API (wire-compatible with valhalla's)
+  report       -- report() business logic (wire-compatible)
+  anonymise    -- time-quantised tiling, privacy cull, storage backends
+  serve        -- HTTP service (/report, /trace_attributes_batch)
+  stream       -- streaming stack (formatter DSL, batching, anonymising)
+  batch        -- 3-phase resumable batch pipeline
+  parallel     -- device-mesh sharding, multi-chip histogram reduction
+  baseline     -- pure-CPU matcher used as a diff oracle and bench baseline
+  synth        -- synthetic GPS trace generation
+"""
+
+__version__ = "0.1.0"

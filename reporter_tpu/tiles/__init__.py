@@ -1,0 +1,27 @@
+from .hierarchy import TileHierarchy, TileSet, BoundingBox
+from .segment_id import (
+    LEVEL_BITS,
+    TILE_INDEX_BITS,
+    SEGMENT_INDEX_BITS,
+    INVALID_SEGMENT_ID,
+    pack_segment_id,
+    unpack_segment_id,
+    get_tile_level,
+    get_tile_index,
+    get_segment_index,
+)
+
+__all__ = [
+    "TileHierarchy",
+    "TileSet",
+    "BoundingBox",
+    "LEVEL_BITS",
+    "TILE_INDEX_BITS",
+    "SEGMENT_INDEX_BITS",
+    "INVALID_SEGMENT_ID",
+    "pack_segment_id",
+    "unpack_segment_id",
+    "get_tile_level",
+    "get_tile_index",
+    "get_segment_index",
+]

@@ -1,0 +1,85 @@
+import math
+
+import numpy as np
+import pytest
+
+from reporter_tpu import geo
+
+
+def test_haversine_known_distance():
+    # Paris -> London, ~343.5 km great circle
+    d = geo.haversine_m(48.8566, 2.3522, 51.5074, -0.1278)
+    assert 340_000 < d < 348_000
+
+
+def test_haversine_zero():
+    assert geo.haversine_m(14.5, 121.0, 14.5, 121.0) == 0.0
+
+
+def test_equirectangular_close_to_haversine_at_city_scale():
+    lat1, lon1 = 37.77, -122.41
+    lat2, lon2 = 37.80, -122.38
+    h = geo.haversine_m(lat1, lon1, lat2, lon2)
+    e = geo.equirectangular_m(lat1, lon1, lat2, lon2)
+    # equirectangular uses the reference's meters_per_deg constant, which sits
+    # ~0.11% above the mean-radius scale haversine uses
+    assert abs(h - e) / h < 2e-3
+
+
+def test_local_projection_roundtrip():
+    proj = geo.LocalProjection(37.77, -122.41)
+    lats = np.array([37.70, 37.77, 37.84])
+    lons = np.array([-122.50, -122.41, -122.32])
+    x, y = proj.to_xy(lats, lons)
+    lat2, lon2 = proj.to_latlon(x, y)
+    np.testing.assert_allclose(lat2, lats, atol=1e-9)
+    np.testing.assert_allclose(lon2, lons, atol=1e-9)
+
+
+def test_local_projection_distance_agrees_with_haversine():
+    proj = geo.LocalProjection(37.77, -122.41)
+    x1, y1 = proj.to_xy(37.76, -122.42)
+    x2, y2 = proj.to_xy(37.78, -122.40)
+    d_proj = math.hypot(x2 - x1, y2 - y1)
+    d_hav = geo.haversine_m(37.76, -122.42, 37.78, -122.40)
+    assert abs(d_proj - d_hav) / d_hav < 2e-3
+
+
+def test_point_segment_distance():
+    # horizontal segment from (0,0) to (10,0)
+    d, t = geo.point_segment_distance_np(5.0, 3.0, 0.0, 0.0, 10.0, 0.0)
+    assert d == pytest.approx(3.0)
+    assert t == pytest.approx(0.5)
+    # beyond the end -> clamps
+    d, t = geo.point_segment_distance_np(14.0, 3.0, 0.0, 0.0, 10.0, 0.0)
+    assert d == pytest.approx(5.0)
+    assert t == pytest.approx(1.0)
+    # degenerate zero-length segment
+    d, t = geo.point_segment_distance_np(3.0, 4.0, 0.0, 0.0, 0.0, 0.0)
+    assert d == pytest.approx(5.0)
+    assert t == pytest.approx(0.0)
+
+
+def test_jax_haversine_matches_numpy():
+    import jax.numpy as jnp
+
+    d_np = geo.haversine_m(14.543087, 121.021019, 14.553976, 121.033997)
+    d_jax = float(geo.jax_haversine_m(jnp.float32(14.543087), jnp.float32(121.021019),
+                                      jnp.float32(14.553976), jnp.float32(121.033997)))
+    assert abs(d_np - d_jax) < 2.0  # float32 tolerance over ~1.8 km
+
+
+def test_equirectangular_matches_reference_constant():
+    # Batch.java:36 meters_per_deg = 20037581.187/180
+    d = geo.equirectangular_m(0.0, 0.0, 1.0, 0.0)
+    assert abs(d - 20037581.187 / 180.0) < 1e-6
+
+
+def test_local_projection_antimeridian():
+    proj = geo.LocalProjection.for_bbox(-17.0, 179.5, -16.0, -179.5)
+    # origin should sit near the antimeridian, not near lon 0
+    assert abs(abs(proj.lon0) - 180.0) < 1.0
+    x1, _ = proj.to_xy(-16.5, 179.9)
+    x2, _ = proj.to_xy(-16.5, -179.9)
+    # the two sides are ~21 km apart, contiguous across the seam
+    assert abs(abs(x2 - x1) - geo.haversine_m(-16.5, 179.9, -16.5, -179.9)) < 100.0

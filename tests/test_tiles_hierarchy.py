@@ -1,0 +1,111 @@
+import pytest
+
+from reporter_tpu.tiles import (
+    BoundingBox,
+    TileHierarchy,
+    TileSet,
+    INVALID_SEGMENT_ID,
+    pack_segment_id,
+    unpack_segment_id,
+    get_tile_level,
+    get_tile_index,
+    get_segment_index,
+)
+from reporter_tpu.tiles.segment_id import get_tile_id
+
+
+class TestSegmentId:
+    def test_roundtrip(self):
+        sid = pack_segment_id(2, 415760, 12345)
+        assert unpack_segment_id(sid) == (2, 415760, 12345)
+        assert get_tile_level(sid) == 2
+        assert get_tile_index(sid) == 415760
+        assert get_segment_index(sid) == 12345
+
+    def test_invalid_matches_reference_constant(self):
+        # Segment.java:16 INVALID_SEGMENT_ID = 0x3fffffffffffL
+        assert INVALID_SEGMENT_ID == 0x3FFFFFFFFFFF
+
+    def test_tile_id_low_25_bits(self):
+        sid = pack_segment_id(1, 1000, 7)
+        assert get_tile_id(sid) == (1000 << 3) | 1
+
+    def test_range_checks(self):
+        with pytest.raises(ValueError):
+            pack_segment_id(8, 0, 0)
+        with pytest.raises(ValueError):
+            pack_segment_id(0, 1 << 22, 0)
+        with pytest.raises(ValueError):
+            pack_segment_id(0, 0, 1 << 21)
+
+
+class TestTileSet:
+    def test_level_dimensions(self):
+        h = TileHierarchy()
+        assert h.levels[2].ncolumns == 1440 and h.levels[2].nrows == 720
+        assert h.levels[1].ncolumns == 360 and h.levels[1].nrows == 180
+        assert h.levels[0].ncolumns == 90 and h.levels[0].nrows == 45
+
+    def test_row_col_bounds(self):
+        t = TileSet(0.25)
+        assert t.row(-91) == -1 and t.col(-181) == -1
+        assert t.row(90.0) == t.nrows - 1
+        assert t.col(180.0) == t.ncolumns - 1
+
+    def test_tile_id_manila(self):
+        # Manila (14.6, 121.0), level 2: row=(14.6+90)/0.25=418, col=(121+180)/0.25=1204
+        t = TileSet(0.25)
+        assert t.tile_id(14.6, 121.0) == 418 * 1440 + 1204
+
+    def test_tile_bbox_inverse(self):
+        t = TileSet(0.25)
+        tid = t.tile_id(14.6, 121.0)
+        bb = t.tile_bbox(tid)
+        assert bb.min_y <= 14.6 < bb.max_y
+        assert bb.min_x <= 121.0 < bb.max_x
+
+    def test_file_suffix_grouping(self):
+        # max_tile_id for 0.25 deg = 1036799 (7 digits -> padded to 9)
+        t = TileSet(0.25)
+        assert t.file_suffix(415760, 2, "json") == "2/000/415/760.json"
+        t1 = TileSet(1.0)
+        # max_tile_id = 64799 (5 digits -> padded to 6)
+        assert t1.file_suffix(37740, 1, "gph") == "1/037/740.gph"
+        t0 = TileSet(4.0)
+        assert t0.file_suffix(2415, 0, "gph") == "0/002/415.gph"
+
+
+class TestBboxEnumeration:
+    def test_small_bbox_all_levels(self):
+        h = TileHierarchy()
+        tiles = list(h.tiles_in_bbox(121.0, 14.5, 121.1, 14.6))
+        levels = {lvl for lvl, _ in tiles}
+        assert levels == {0, 1, 2}
+        # a 0.1 deg box spans 1-2 tiles per axis at level 2
+        n2 = sum(1 for lvl, _ in tiles if lvl == 2)
+        assert 1 <= n2 <= 4
+
+    def test_antimeridian_split(self):
+        h = TileHierarchy()
+        # box crossing 180: min_lon 179.9 > max_lon -179.9 triggers the wrap
+        tiles = list(h.tiles_in_bbox(179.9, 0.0, -179.9, 0.1))
+        assert tiles  # must produce tiles on both sides, none with negative ids
+        assert all(tid >= 0 for _, tid in tiles)
+        # tiles on both edges of the world grid at level 2
+        cols = {tid % 1440 for lvl, tid in tiles if lvl == 2}
+        assert 0 in cols and 1439 in cols
+
+    def test_file_names(self):
+        h = TileHierarchy()
+        names = h.tile_files_in_bbox(121.0, 14.5, 121.05, 14.55, "json")
+        assert any(n.startswith("2/") for n in names)
+        assert all(n.endswith(".json") for n in names)
+
+
+def test_bbox_out_of_range_latitudes_clamped():
+    h = TileHierarchy()
+    tiles = list(h.tiles_in_bbox(121.0, -90.5, 121.1, -89.9))
+    assert tiles and all(tid >= 0 for _, tid in tiles)
+    # same bottom row as a clamped query
+    expected = set(h.tiles_in_bbox(121.0, -90.0, 121.1, -89.9))
+    assert set(tiles) == expected
