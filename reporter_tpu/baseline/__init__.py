@@ -1,0 +1,3 @@
+from .cpu_matcher import CPUViterbiMatcher
+
+__all__ = ["CPUViterbiMatcher"]

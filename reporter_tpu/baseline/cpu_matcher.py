@@ -1,0 +1,187 @@
+"""Pure-CPU reference matcher: the diff oracle and bench baseline.
+
+Plays the role the single-process Meili C++ engine plays for the reference
+(reporter_service.py:240): a straightforward per-trace Viterbi with the same
+emission/transition model as the JAX kernel (ops/viterbi.py), written in plain
+numpy + Python loops with no batching.  Used to
+
+  - diff TPU output segment-for-segment (BASELINE.json --backend={meili,jax})
+  - measure the single-process CPU traces/sec that bench.py's vs_baseline
+    figure is computed against
+
+Keep the math in lock-step with ops/viterbi.py; tests/test_backend_diff.py
+asserts the two backends agree on the chosen edges.
+"""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+import numpy as np
+
+from .. import geo
+
+NEG_INF = -1e30
+
+
+class CPUViterbiMatcher:
+    def __init__(self, arrays, ubodt, cfg):
+        self.arrays = arrays
+        self.ubodt = ubodt
+        self.cfg = cfg
+
+    # -- candidate lookup (numpy over all shape segments in 3x3 cells) -----
+
+    def _candidates(self, x: float, y: float) -> List[Tuple[int, float, float]]:
+        """[(edge, offset_m, dist_m)] within the search radius, one per edge,
+        nearest K first."""
+        a = self.arrays
+        cx, cy = a.cell_of(x, y)
+        items: List[int] = []
+        for dy in (-1, 0, 1):
+            for dx in (-1, 0, 1):
+                gx, gy = cx + dx, cy + dy
+                if 0 <= gx < a.grid_nx and 0 <= gy < a.grid_ny:
+                    row = a.grid_items[gy * a.grid_nx + gx]
+                    items.extend(int(s) for s in row[row >= 0])
+        if not items:
+            return []
+        items = sorted(set(items))
+        si = np.array(items, np.int64)
+        d, t = geo.point_segment_distance_np(x, y, a.shp_ax[si], a.shp_ay[si], a.shp_bx[si], a.shp_by[si])
+        best = {}
+        for k in range(len(si)):
+            if d[k] <= self.cfg.search_radius:
+                e = int(a.shp_edge[si[k]])
+                off = float(a.shp_off[si[k]] + t[k] * a.shp_len[si[k]])
+                if e not in best or d[k] < best[e][1]:
+                    best[e] = (off, float(d[k]))
+        cands = [(e, off, dist) for e, (off, dist) in best.items()]
+        cands.sort(key=lambda c: c[2])
+        return cands[: self.cfg.beam_k]
+
+    # -- transition ---------------------------------------------------------
+
+    def _transition(self, ca, cb, gc: float, dt: float) -> float:
+        a = self.arrays
+        ea, oa, _ = ca
+        eb, ob, _ = cb
+        same_known = False  # forward or jitter movement within one edge
+        if ea == eb and ob >= oa:
+            route = ob - oa
+            rtime = route / max(float(a.edge_speed[ea]), 0.1)
+            same_known = True
+        elif ea == eb and (oa - ob) <= 2.0 * self.cfg.sigma_z + 5.0:
+            route = (oa - ob) * 1.05 + 1.0
+            rtime = (oa - ob) / max(float(a.edge_speed[ea]), 0.1)
+            same_known = True
+        else:
+            sp, sp_time, _ = self.ubodt.lookup_full(int(a.edge_to[ea]), int(a.edge_from[eb]))
+            if not np.isfinite(sp):
+                return NEG_INF
+            route = (float(a.edge_len[ea]) - oa) + sp + ob
+            rtime = (float(a.edge_len[ea]) - oa) / max(float(a.edge_speed[ea]), 0.1) \
+                + sp_time + ob / max(float(a.edge_speed[eb]), 0.1)
+        cfg = self.cfg
+        if route > cfg.max_route_distance_factor * (gc + cfg.search_radius):
+            return NEG_INF
+        if dt > 0 and rtime > cfg.max_route_time_factor * max(dt, 1.0):
+            return NEG_INF
+        logp = -abs(route - gc) / cfg.beta
+        if cfg.turn_penalty_factor > 0.0 and not same_known:
+            turn = abs(_angle_diff(float(a.edge_head1[ea]), float(a.edge_head0[eb])))
+            logp -= cfg.turn_penalty_factor * turn / (np.pi * cfg.beta)
+        return logp
+
+    # -- viterbi ------------------------------------------------------------
+
+    def match_points(self, xs: np.ndarray, ys: np.ndarray, times: np.ndarray):
+        """Returns (edge[T], offset[T], breaks[T]) numpy arrays; edge=-1 where
+        unmatched."""
+        T = len(xs)
+        cands = [self._candidates(float(xs[t]), float(ys[t])) for t in range(T)]
+        sigma = self.cfg.sigma_z
+        emis = [
+            [-0.5 * (c[2] / sigma) ** 2 for c in cands[t]]
+            for t in range(T)
+        ]
+
+        edge = np.full(T, -1, np.int64)
+        offset = np.zeros(T, np.float64)
+        breaks = np.zeros(T, bool)
+
+        scores: List[float] = []
+        backptr: List[List[int]] = [[]]
+        seg_start = 0
+        seg_ranges: List[Tuple[int, int]] = []  # (start, end) of HMM segments
+        choice: List[List[float]] = [emis[0][:]]
+        scores = emis[0][:]
+        all_scores = [scores[:]]
+
+        for t in range(1, T):
+            gc = float(np.hypot(xs[t] - xs[t - 1], ys[t] - ys[t - 1]))
+            dt = float(times[t] - times[t - 1])
+            broke = gc > self.cfg.breakage_distance or not scores or not cands[t]
+            new_scores = []
+            bp = []
+            if not broke:
+                any_conn = False
+                for j, cj in enumerate(cands[t]):
+                    best, arg = NEG_INF, -1
+                    for i, ci in enumerate(cands[t - 1]):
+                        if scores[i] <= NEG_INF / 2:
+                            continue
+                        lp = self._transition(ci, cj, gc, dt)
+                        if scores[i] + lp > best:
+                            best, arg = scores[i] + lp, i
+                    if best > NEG_INF / 2:
+                        any_conn = True
+                    new_scores.append(best + emis[t][j] if best > NEG_INF / 2 else NEG_INF)
+                    bp.append(arg)
+                if not any_conn:
+                    broke = True
+            if broke:
+                seg_ranges.append((seg_start, t))
+                seg_start = t
+                new_scores = emis[t][:]
+                bp = [-1] * len(cands[t])
+                breaks[t] = True
+            scores = new_scores
+            backptr.append(bp)
+            all_scores.append(scores[:])
+        seg_ranges.append((seg_start, T))
+
+        # backtrace within each HMM segment
+        for s0, s1 in seg_ranges:
+            sc = all_scores[s1 - 1]
+            if not sc or max(sc) <= NEG_INF / 2:
+                continue
+            j = int(np.argmax(sc))
+            for t in range(s1 - 1, s0 - 1, -1):
+                if j < 0 or not cands[t]:
+                    break
+                edge[t] = cands[t][j][0]
+                offset[t] = cands[t][j][1]
+                j = backptr[t][j] if t > s0 else -1
+        return edge, offset, breaks
+
+    def run_batch(self, px: np.ndarray, py: np.ndarray, times: np.ndarray, valid: np.ndarray):
+        """Same contract as the JAX path in SegmentMatcher._run_batch."""
+        B, T = px.shape
+        edge = np.full((B, T), -1, np.int64)
+        offset = np.zeros((B, T), np.float64)
+        breaks = np.zeros((B, T), bool)
+        for b in range(B):
+            n = int(valid[b].sum())
+            e, o, br = self.match_points(px[b, :n], py[b, :n], times[b, :n])
+            edge[b, :n] = e
+            offset[b, :n] = o
+            breaks[b, :n] = br
+            if n:
+                breaks[b, 0] = True
+        return edge, offset, breaks
+
+
+def _angle_diff(a: float, b: float) -> float:
+    d = b - a
+    return (d + np.pi) % (2.0 * np.pi) - np.pi

@@ -1,0 +1,4 @@
+from .config import MatcherConfig
+from .matcher import SegmentMatcher
+
+__all__ = ["MatcherConfig", "SegmentMatcher"]

@@ -1,0 +1,61 @@
+"""Matcher configuration.
+
+Honours the same tunables the reference bakes into its meili config
+(Dockerfile:14-17,42-48 and py/generate_test_trace.py:37-52): sigma_z, beta,
+search_radius, breakage_distance, max_route_distance_factor,
+max_route_time_factor, turn_penalty_factor.  Adds the TPU-side knobs
+(beam width K, UBODT delta, padding buckets).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field, asdict
+from typing import List, Optional
+
+
+@dataclass
+class MatcherConfig:
+    # HMM parameters (reference defaults, Dockerfile:42-48)
+    sigma_z: float = 4.07
+    beta: float = 3.0
+    search_radius: float = 50.0
+    breakage_distance: float = 2000.0
+    max_route_distance_factor: float = 5.0
+    max_route_time_factor: float = 2.0
+    turn_penalty_factor: float = 0.0
+    # distance (m) from a segment's end within which trace speeds below
+    # queue_speed_threshold_kph count as queueing (queue_length reporting)
+    queue_speed_threshold_kph: float = 20.0
+    # TPU kernel shape knobs
+    beam_k: int = 8
+    ubodt_delta: float = 3000.0
+    # padded trace-length buckets for batched matching
+    length_buckets: List[int] = field(default_factory=lambda: [16, 32, 64, 128, 256])
+    # report() business-logic default (reporter_service.py:54-58)
+    threshold_sec: int = 15
+    mode: str = "auto"
+
+    def to_dict(self) -> dict:
+        return asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "MatcherConfig":
+        known = {f for f in cls.__dataclass_fields__}
+        return cls(**{k: v for k, v in d.items() if k in known})
+
+    @classmethod
+    def from_meili(cls, meili: dict) -> "MatcherConfig":
+        """Accept a valhalla-style config json ({'meili': {'default': {...}}})."""
+        d = meili.get("meili", meili).get("default", meili.get("default", meili))
+        c = cls()
+        # NB meili's interpolation_distance is intentionally absent: the
+        # batched kernel matches every point rather than interpolating
+        # near-duplicates, so accepting the key would silently do nothing.
+        for key in (
+            "sigma_z", "beta", "search_radius", "breakage_distance",
+            "max_route_distance_factor", "max_route_time_factor",
+            "turn_penalty_factor",
+        ):
+            if key in d:
+                setattr(c, key, type(getattr(c, key))(d[key]))
+        return c

@@ -1,0 +1,190 @@
+"""SegmentMatcher: the public matching API.
+
+Wire-compatible with the valhalla extension the reference calls
+(reporter_service.py:52,240: ``SegmentMatcher().Match(json) -> json``), plus
+the micro-batch entry point ``match_many`` that the /trace_attributes_batch
+endpoint and the batch pipeline feed with many traces at once — that is where
+the TPU earns its keep: traces are bucketed by length, padded, stacked
+[B, T] and matched in one vmapped device program.
+
+Backends:
+  jax  -- candidates/emission/transition/Viterbi on device (ops/)
+  cpu  -- pure numpy+Dijkstra oracle (baseline/cpu_matcher.py), same host
+          post-processing, used for segment-for-segment diffing
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..tiles.arrays import GraphArrays, build_graph_arrays
+from ..tiles.network import RoadNetwork
+from ..tiles.ubodt import UBODT, build_ubodt
+from .config import MatcherConfig
+from .segments import associate_segments
+
+log = logging.getLogger(__name__)
+
+
+class SegmentMatcher:
+    def __init__(
+        self,
+        network: Optional[RoadNetwork] = None,
+        config: Optional[MatcherConfig] = None,
+        backend: str = "jax",
+        arrays: Optional[GraphArrays] = None,
+        ubodt: Optional[UBODT] = None,
+    ):
+        self.cfg = config or MatcherConfig()
+        if arrays is None:
+            if network is None:
+                raise ValueError("need a network or prebuilt arrays")
+            arrays = build_graph_arrays(
+                network, cell_size=max(100.0, self.cfg.search_radius)
+            )
+        if arrays.cell_size < self.cfg.search_radius:
+            raise ValueError(
+                "spatial grid cell_size %.1f < search_radius %.1f: 3x3 query "
+                "neighbourhood would miss candidates" % (arrays.cell_size, self.cfg.search_radius)
+            )
+        self.arrays = arrays
+        self.ubodt = ubodt or build_ubodt(arrays, delta=self.cfg.ubodt_delta)
+        self.backend = backend
+        if backend == "jax":
+            self._init_jax()
+        elif backend == "cpu":
+            self._init_cpu()
+        else:
+            raise ValueError("unknown backend %r" % (backend,))
+
+    # -- backends ----------------------------------------------------------
+
+    def _init_jax(self):
+        import jax
+
+        from ..ops.viterbi import MatchParams, match_batch
+
+        self._dg = self.arrays.to_device()
+        self._du = self.ubodt.to_device()
+        self._params = MatchParams.from_config(self.cfg)
+        self._jit_match = jax.jit(match_batch, static_argnums=(7,))
+
+    def _init_cpu(self):
+        from ..baseline.cpu_matcher import CPUViterbiMatcher
+
+        self._cpu = CPUViterbiMatcher(self.arrays, self.ubodt, self.cfg)
+
+    def _run_batch(self, px: np.ndarray, py: np.ndarray, times: np.ndarray, valid: np.ndarray):
+        """[B, T] padded batch -> per-point (edge, offset, break) numpy arrays."""
+        if self.backend == "jax":
+            import jax.numpy as jnp
+
+            res = self._jit_match(
+                self._dg, self._du,
+                jnp.asarray(px, jnp.float32), jnp.asarray(py, jnp.float32),
+                jnp.asarray(times, jnp.float32),
+                jnp.asarray(valid, bool), self._params, self.cfg.beam_k,
+            )
+            idx = np.asarray(res.idx)
+            B, T = idx.shape
+            sel = np.maximum(idx, 0)
+            rows = np.arange(B)[:, None], np.arange(T)[None, :]
+            edge = np.asarray(res.cand.edge)[rows[0], rows[1], sel]
+            offset = np.asarray(res.cand.offset)[rows[0], rows[1], sel]
+            edge = np.where(idx >= 0, edge, -1)
+            return edge, offset, np.asarray(res.breaks)
+        else:
+            return self._cpu.run_batch(px, py, times, valid)
+
+    # -- public API --------------------------------------------------------
+
+    def match_many(self, traces: Sequence[dict]) -> List[dict]:
+        """Each trace: {"uuid":..., "trace":[{"lat","lon","time",...},...]}.
+        Returns one match dict {"segments": [...]} per trace, in order."""
+        results: List[Optional[dict]] = [None] * len(traces)
+
+        # bucket by padded length
+        buckets: Dict[int, List[int]] = {}
+        for i, tr in enumerate(traces):
+            n = len(tr["trace"])
+            buckets.setdefault(self._bucket_len(n), []).append(i)
+
+        for blen, idxs in sorted(buckets.items()):
+            B = len(idxs)
+            px = np.zeros((B, blen), np.float32)
+            py = np.zeros((B, blen), np.float32)
+            tm = np.zeros((B, blen), np.float32)
+            valid = np.zeros((B, blen), bool)
+            times = []
+            for row, i in enumerate(idxs):
+                pts = traces[i]["trace"]
+                lats = np.array([p["lat"] for p in pts], np.float64)
+                lons = np.array([p["lon"] for p in pts], np.float64)
+                x, y = self.arrays.proj.to_xy(lats, lons)
+                px[row, : len(pts)] = x
+                py[row, : len(pts)] = y
+                ts = [float(p["time"]) for p in pts]
+                # rebase to the trace start before the float32 cast: epoch
+                # seconds (~1.7e9) have ~2 minute float32 resolution, which
+                # would destroy the dt used by the time-factor cut; only
+                # deltas matter on device
+                tm[row, : len(pts)] = np.asarray(ts) - ts[0]
+                valid[row, : len(pts)] = True
+                times.append(ts)
+
+            # pad the batch dimension to a power of two so the jitted kernel
+            # compiles for a bounded set of (B, T) shapes; dummy rows are
+            # all-invalid and sliced off below
+            B_pad = 1
+            while B_pad < B:
+                B_pad <<= 1
+            if B_pad != B:
+                pad = B_pad - B
+                px = np.concatenate([px, np.zeros((pad, blen), np.float32)])
+                py = np.concatenate([py, np.zeros((pad, blen), np.float32)])
+                tm = np.concatenate([tm, np.zeros((pad, blen), np.float32)])
+                valid = np.concatenate([valid, np.zeros((pad, blen), bool)])
+
+            edge, offset, breaks = self._run_batch(px, py, tm, valid)
+
+            for row, i in enumerate(idxs):
+                n = len(traces[i]["trace"])
+                match_points = [
+                    {
+                        "edge": int(edge[row, t]),
+                        "offset": float(offset[row, t]),
+                        "time": times[row][t],
+                        "break": bool(breaks[row, t]),
+                        "shape_index": t,
+                    }
+                    for t in range(n)
+                ]
+                segs = associate_segments(
+                    self.arrays, self.ubodt, match_points,
+                    queue_thresh_mps=self.cfg.queue_speed_threshold_kph / 3.6,
+                    back_tol=2.0 * self.cfg.sigma_z + 5.0,
+                )
+                results[i] = {"segments": segs}
+        return results  # type: ignore[return-value]
+
+    def match(self, trace: dict) -> dict:
+        return self.match_many([trace])[0]
+
+    def Match(self, trace_json: str) -> str:
+        """Wire-compatible single-trace entry (valhalla SegmentMatcher.Match)."""
+        trace = json.loads(trace_json)
+        return json.dumps(self.match(trace), separators=(",", ":"))
+
+    def _bucket_len(self, n: int) -> int:
+        for b in self.cfg.length_buckets:
+            if n <= b:
+                return b
+        # beyond the largest bucket: next power of two (compiles once per size)
+        b = self.cfg.length_buckets[-1] if self.cfg.length_buckets else 1
+        while b < n:
+            b <<= 1
+        return b

@@ -1,0 +1,50 @@
+"""Device-side open-addressing hash probe for the UBODT.
+
+The route-distance lookup inside the HMM transition becomes a fixed number of
+vectorised gathers: hash the (src, dst) node pair, probe up to ``max_probes``
+slots (statically unrolled — max_probes is measured at build time and kept
+small by the builder), select the hit with ``where``.  No data-dependent
+control flow, so XLA fuses the whole probe into the transition computation.
+
+Must mirror tiles/ubodt.py's host-side layout and hash exactly.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..tiles.ubodt import DeviceUBODT
+
+
+def device_pair_hash(src: jnp.ndarray, dst: jnp.ndarray, mask: int) -> jnp.ndarray:
+    """uint32 mix identical to tiles.ubodt.pair_hash."""
+    s = src.astype(jnp.uint32)
+    d = dst.astype(jnp.uint32)
+    h = s * jnp.uint32(0x9E3779B1) + d * jnp.uint32(0x85EBCA6B)
+    h = h ^ (h >> jnp.uint32(15))
+    h = h * jnp.uint32(0x2C1B3C6D)
+    h = h ^ (h >> jnp.uint32(12))
+    return (h & jnp.uint32(mask)).astype(jnp.int32)
+
+
+def ubodt_lookup(u: DeviceUBODT, src: jnp.ndarray, dst: jnp.ndarray):
+    """Vectorised probe.  src/dst: any (broadcastable) int32 shape.
+
+    Returns (dist, time, first_edge): dist/time = +inf and first_edge = -1 on
+    miss.
+    """
+    h = device_pair_hash(src, dst, u.mask)
+    dist = jnp.full(h.shape, jnp.inf, jnp.float32)
+    time = jnp.full(h.shape, jnp.inf, jnp.float32)
+    first = jnp.full(h.shape, -1, jnp.int32)
+    found = jnp.zeros(h.shape, jnp.bool_)
+    for p in range(u.max_probes):
+        idx = (h + p) & u.mask
+        ts = u.table_src[idx]
+        td = u.table_dst[idx]
+        hit = (ts == src) & (td == dst) & (~found)
+        dist = jnp.where(hit, u.table_dist[idx], dist)
+        time = jnp.where(hit, u.table_time[idx], time)
+        first = jnp.where(hit, u.table_first_edge[idx], first)
+        found = found | hit | (ts == -1)  # empty slot terminates the chain
+    return dist, time, first

@@ -1,0 +1,221 @@
+"""Batched HMM map-matching kernel: emission, transition, Viterbi.
+
+This is the framework's replacement for the Meili C++ engine's per-trace
+matcher (reference boundary: reporter_service.py:240 Match()).  The whole
+dynamic program runs on device with static shapes:
+
+    candidates   [T, K]    (ops/candidates.py gathers)
+    emission     [T, K]    Gaussian in point->candidate distance (sigma_z)
+    transition   [K, K]    per step, |route - great_circle| / beta, with the
+                           route distance a pure UBODT hash-table gather
+    viterbi      lax.scan over T of a max-plus [K] x [K,K] contraction
+    backtrace    reverse lax.scan over stored backpointers
+
+vmap over the batch axis gives [B, T, K]; pjit/shard_map over a device mesh
+shards B (reporter_tpu/parallel).  No data-dependent control flow anywhere.
+
+Discontinuity semantics follow Meili: if consecutive points are further apart
+than ``breakage_distance``, or no feasible route connects any candidate pair,
+the HMM restarts at that point and the break is recorded (these surface as
+`begin/end discontinuities in the match, reporter_service.py:114-116).
+
+Deviation from strict Meili: *small* backward movement within one edge
+(< ~2 sigma_z) is treated as lightly-penalised jitter rather than a full loop
+route — GPS noise on a stopped vehicle otherwise produces spurious breaks.
+Large backward movement does pay the loop route, so the wrong direction of a
+two-way road cannot win.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from ..tiles.arrays import DeviceGraph
+from ..tiles.ubodt import DeviceUBODT
+from .candidates import Candidates, find_candidates_batch
+from .hashtable import ubodt_lookup
+
+NEG_INF = -1e30
+
+
+class MatchParams(NamedTuple):
+    """Traced HMM scalars (jnp f32), shared across the batch."""
+
+    sigma_z: jnp.ndarray
+    beta: jnp.ndarray
+    search_radius: jnp.ndarray
+    breakage_distance: jnp.ndarray
+    max_route_distance_factor: jnp.ndarray
+    max_route_time_factor: jnp.ndarray
+    turn_penalty_factor: jnp.ndarray
+
+    @classmethod
+    def from_config(cls, cfg) -> "MatchParams":
+        return cls(
+            sigma_z=jnp.float32(cfg.sigma_z),
+            beta=jnp.float32(cfg.beta),
+            search_radius=jnp.float32(cfg.search_radius),
+            breakage_distance=jnp.float32(cfg.breakage_distance),
+            max_route_distance_factor=jnp.float32(cfg.max_route_distance_factor),
+            max_route_time_factor=jnp.float32(cfg.max_route_time_factor),
+            turn_penalty_factor=jnp.float32(cfg.turn_penalty_factor),
+        )
+
+
+class MatchResult(NamedTuple):
+    cand: Candidates  # [T, K] candidate pool per point
+    idx: jnp.ndarray  # [T] i32 chosen candidate slot, -1 = unmatched
+    breaks: jnp.ndarray  # [T] bool, True where a new HMM segment starts
+    route_dist: jnp.ndarray  # [T] f32 route distance from previous chosen candidate
+    # (NEG_INF-free) final per-point viterbi score of the chosen slot
+    score: jnp.ndarray  # [T] f32
+
+
+def transition_matrix(dg: DeviceGraph, du: DeviceUBODT, src: Candidates, dst: Candidates,
+                      gc: jnp.ndarray, dt: jnp.ndarray, p: MatchParams):
+    """[K, K] transition log-probs and route distances for one step.
+
+    gc: great-circle (projected straight-line) metres between the two points.
+    dt: measurement seconds between them (<= 0 disables the time-factor cut).
+    """
+    ea, oa = src.edge, src.offset  # [K]
+    eb, ob = dst.edge, dst.offset  # [K]
+    safe_ea = jnp.where(ea >= 0, ea, 0)
+    safe_eb = jnp.where(eb >= 0, eb, 0)
+
+    sp, sp_time, _ = ubodt_lookup(du, dg.edge_to[safe_ea][:, None], dg.edge_from[safe_eb][None, :])
+    remain = (dg.edge_len[safe_ea] - oa)[:, None]
+    route = remain + sp + ob[None, :]
+    # same 0.1 m/s floor as the UBODT builder and CPU oracle: a zero-speed
+    # edge must not produce inf/NaN travel times
+    speed_a = jnp.maximum(dg.edge_speed[safe_ea], 0.1)
+    speed_b = jnp.maximum(dg.edge_speed[safe_eb], 0.1)
+    rtime = remain / speed_a[:, None] + sp_time + (ob / speed_b)[None, :]
+
+    # Same-edge handling.  Forward progress is the plain offset delta.  A
+    # *small* backward delta (GPS jitter on a stopped/slow vehicle) is allowed
+    # with a slight penalty so the true forward direction of a two-way road
+    # wins ties; a large backward delta must really route the loop
+    # (to[a] -> ... -> from[a]), which the general UBODT formula above
+    # already expresses because from[b] == from[a].
+    same = (ea[:, None] == eb[None, :]) & (ea[:, None] >= 0)
+    delta = ob[None, :] - oa[:, None]
+    back_tol = 2.0 * p.sigma_z + 5.0
+    same_fwd = same & (delta >= 0)
+    same_jitter = same & (delta < 0) & (-delta <= back_tol)
+    route = jnp.where(same_fwd, delta, route)
+    route = jnp.where(same_jitter, -delta * 1.05 + 1.0, route)
+    same_known = same_fwd | same_jitter
+    rtime = jnp.where(same_known, jnp.abs(delta) / speed_a[:, None], rtime)
+
+    valid = (ea[:, None] >= 0) & (eb[None, :] >= 0)
+    max_route = p.max_route_distance_factor * (gc + p.search_radius)
+    feasible = valid & jnp.isfinite(route) & (route <= max_route)
+    # free-flow travel time along the route must fit in the measurement gap
+    # scaled by max_route_time_factor (meili's max-route-time cut)
+    feasible &= (dt <= 0) | (rtime <= p.max_route_time_factor * jnp.maximum(dt, 1.0))
+
+    logp = -jnp.abs(route - gc) / p.beta
+    # turn penalty: scaled by the heading change between leaving the source
+    # edge and entering the destination edge (0..pi); factor 0 (the reference
+    # default, Dockerfile:45) disables it
+    turn = jnp.abs(angle_diff(dg.edge_head1[safe_ea][:, None], dg.edge_head0[safe_eb][None, :]))
+    logp = logp - jnp.where(same_known, 0.0, p.turn_penalty_factor * turn / (jnp.pi * p.beta))
+    logp = jnp.where(feasible, logp, NEG_INF)
+    return logp, jnp.where(feasible, route, jnp.inf)
+
+
+def angle_diff(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Signed smallest difference between two angles, in (-pi, pi]."""
+    d = b - a
+    return jnp.mod(d + jnp.pi, 2.0 * jnp.pi) - jnp.pi
+
+
+def match_trace(dg: DeviceGraph, du: DeviceUBODT, px, py, times, valid, p: MatchParams, k: int) -> MatchResult:
+    """Match one trace of T (padded) points.  px/py/times/valid: [T].
+    vmap over batch."""
+    T = px.shape[0]
+    cand = find_candidates_batch(dg, px, py, k, p.search_radius)  # [T, K]
+
+    emis = -0.5 * jnp.square(cand.dist / p.sigma_z)  # [T, K]
+    emis = jnp.where(jnp.isfinite(cand.dist), emis, NEG_INF)
+    emis = jnp.where(valid[:, None], emis, NEG_INF)
+
+    gc = jnp.hypot(px[1:] - px[:-1], py[1:] - py[:-1])  # [T-1]
+    dts = times[1:] - times[:-1]  # [T-1]
+
+    def step(scores, inputs):
+        """scores: [K] running viterbi scores.  One timestep t (1..T-1)."""
+        cand_t, emis_t, gc_t, dt_t, valid_t, cand_prev = inputs
+        logp, route = transition_matrix(dg, du, cand_prev, cand_t, gc_t, dt_t, p)
+        total = scores[:, None] + logp  # [K src, K dst]
+        best_src = jnp.argmax(total, axis=0)  # [K]
+        best_val = jnp.max(total, axis=0)
+        connected = best_val > NEG_INF / 2
+        # breakage: too far apart, or nothing connects
+        broke = (gc_t > p.breakage_distance) | ~jnp.any(connected)
+        new_scores = jnp.where(broke, emis_t, best_val + emis_t)
+        new_scores = jnp.where(valid_t, new_scores, scores)  # padding: freeze
+        backptr = jnp.where(broke | ~connected, -1, best_src)
+        backptr = jnp.where(valid_t, backptr, jnp.full_like(backptr, -2))  # -2 = padded step
+        chosen_route = jnp.where(connected, route[best_src, jnp.arange(route.shape[1])], jnp.inf)
+        return new_scores, (new_scores, backptr, broke & valid_t, chosen_route)
+
+    init_scores = emis[0]
+    xs = (
+        jax.tree_util.tree_map(lambda a: a[1:], cand),
+        emis[1:],
+        gc,
+        dts,
+        valid[1:],
+        jax.tree_util.tree_map(lambda a: a[:-1], cand),
+    )
+    _, (all_scores, all_backptr, all_broke, all_route) = jax.lax.scan(step, init_scores, xs)
+
+    # prepend step 0
+    scores_mat = jnp.concatenate([init_scores[None], all_scores], axis=0)  # [T, K]
+    backptr = jnp.concatenate([jnp.full((1, k), -1, all_backptr.dtype), all_backptr], axis=0)
+    breaks = jnp.concatenate([jnp.array([True]), all_broke], axis=0) & valid
+    route_in = jnp.concatenate([jnp.full((1, k), jnp.inf), all_route], axis=0)  # [T, K]
+
+    # ----- backtrace (reverse scan) -----
+    # segment boundaries: step t is a segment start if breaks[t]; padded steps
+    # pass the chain through untouched.
+    def back(carry, inputs):
+        nxt_idx = carry  # chosen slot at t+1, or -1
+        scores_t, backptr_next, valid_next, valid_t = inputs
+        # if next step is padded or unmatched: choose local argmax (chain restart)
+        local = jnp.argmax(scores_t)
+        local = jnp.where(scores_t[local] > NEG_INF / 2, local, -1)
+        from_next = jnp.where(nxt_idx >= 0, backptr_next[jnp.where(nxt_idx >= 0, nxt_idx, 0)], -1)
+        idx_t = jnp.where(valid_next & (nxt_idx >= 0) & (from_next >= 0), from_next, local)
+        idx_t = jnp.where(valid_t, idx_t, -1)
+        return idx_t, idx_t
+
+    last_local = jnp.argmax(scores_mat[T - 1])
+    last_idx = jnp.where((scores_mat[T - 1, last_local] > NEG_INF / 2) & valid[T - 1], last_local, -1)
+    ys = (
+        scores_mat[: T - 1][::-1],
+        backptr[1:][::-1],
+        valid[1:][::-1],
+        valid[: T - 1][::-1],
+    )
+    _, idx_rev = jax.lax.scan(back, last_idx, ys)
+    idx = jnp.concatenate([idx_rev[::-1], last_idx[None]], axis=0)  # [T]
+
+    chosen_score = jnp.take_along_axis(scores_mat, jnp.maximum(idx, 0)[:, None], axis=1)[:, 0]
+    chosen_score = jnp.where(idx >= 0, chosen_score, NEG_INF)
+    chosen_route = jnp.take_along_axis(route_in, jnp.maximum(idx, 0)[:, None], axis=1)[:, 0]
+    chosen_route = jnp.where((idx >= 0) & ~breaks, chosen_route, jnp.inf)
+
+    return MatchResult(cand=cand, idx=idx, breaks=breaks, route_dist=chosen_route, score=chosen_score)
+
+
+def match_batch(dg: DeviceGraph, du: DeviceUBODT, px, py, times, valid, p: MatchParams, k: int) -> MatchResult:
+    """px/py/times/valid: [B, T] -> MatchResult leaves with leading [B]."""
+    return jax.vmap(match_trace, in_axes=(None, None, 0, 0, 0, 0, None, None))(
+        dg, du, px, py, times, valid, p, k
+    )

@@ -1,0 +1,276 @@
+"""UBODT: upper-bounded origin-destination table of route distances.
+
+The Meili engine computes candidate-to-candidate route distances with on-line
+bidirectional A* inside C++ (the dominant hot loop, SURVEY.md §3.1).  Graph
+search is irregular and a poor fit for the TPU, so this framework moves it
+entirely to preprocessing: a bounded-radius Dijkstra from every node yields all
+node pairs within ``delta`` metres, stored in an open-addressing hash table
+whose arrays live in HBM.  At match time the [batch, T, K, K] transition
+route-distances become pure vectorised gathers (ops/hashtable.py) — no graph
+search on device at all.
+
+Each row also records the first edge of the shortest path so the full edge
+path can be reconstructed host-side after Viterbi (subpaths of shortest paths
+are shortest paths, so chaining first-edge hops stays inside the table).
+
+The table layout (linear probing, power-of-two size, uint32 mix hash) is
+identical between this host builder and the device prober; keep the two in
+sync.
+"""
+
+from __future__ import annotations
+
+import heapq
+import logging
+from dataclasses import dataclass
+from typing import List, NamedTuple, Optional, Tuple
+
+import numpy as np
+
+log = logging.getLogger(__name__)
+
+# uint32 multiplicative mixing constants (Knuth / murmur-style)
+_H1 = np.uint32(0x9E3779B1)
+_H2 = np.uint32(0x85EBCA6B)
+
+EMPTY = -1
+
+
+def pair_hash(src, dst, mask):
+    """Identical on host (numpy) and device (jnp): uint32 wraparound mix."""
+    s = src.astype(np.uint32) if hasattr(src, "astype") else np.uint32(src)
+    d = dst.astype(np.uint32) if hasattr(dst, "astype") else np.uint32(dst)
+    with np.errstate(over="ignore"):
+        h = s * _H1 + d * _H2
+        h ^= h >> np.uint32(15)
+        h = h * np.uint32(0x2C1B3C6D)
+        h ^= h >> np.uint32(12)
+    return (h & np.uint32(mask)).astype(np.int64) if hasattr(h, "astype") else int(h) & mask
+
+
+class DeviceUBODT:
+    """Pytree whose table arrays are leaves and whose (mask, max_probes) are
+    static aux data, so probe loops unroll at trace time."""
+
+    def __init__(self, table_src, table_dst, table_dist, table_time, table_first_edge,
+                 mask: int, max_probes: int):
+        self.table_src = table_src
+        self.table_dst = table_dst
+        self.table_dist = table_dist
+        self.table_time = table_time
+        self.table_first_edge = table_first_edge
+        self.mask = int(mask)
+        self.max_probes = int(max_probes)
+
+    def tree_flatten(self):
+        return (
+            (self.table_src, self.table_dst, self.table_dist, self.table_time, self.table_first_edge),
+            (self.mask, self.max_probes),
+        )
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children, *aux)
+
+
+def _register_device_ubodt():
+    from jax import tree_util
+
+    tree_util.register_pytree_node(
+        DeviceUBODT,
+        lambda u: u.tree_flatten(),
+        DeviceUBODT.tree_unflatten,
+    )
+
+
+try:
+    _register_device_ubodt()
+except ImportError:  # pragma: no cover - host-only usage without jax
+    pass
+
+
+@dataclass
+class UBODT:
+    delta: float
+    table_src: np.ndarray
+    table_dst: np.ndarray
+    table_dist: np.ndarray
+    table_time: np.ndarray  # travel seconds along the shortest-distance path
+    table_first_edge: np.ndarray
+    mask: int
+    max_probes: int
+    num_rows: int
+
+    def lookup(self, src: int, dst: int) -> Tuple[float, int]:
+        """Host-side probe.  Returns (dist, first_edge) or (inf, -1)."""
+        h = int(pair_hash(np.int64(src), np.int64(dst), self.mask))
+        for p in range(self.max_probes):
+            i = (h + p) & self.mask
+            ts = self.table_src[i]
+            if ts == EMPTY:
+                break
+            if ts == src and self.table_dst[i] == dst:
+                return float(self.table_dist[i]), int(self.table_first_edge[i])
+        return float("inf"), -1
+
+    def lookup_full(self, src: int, dst: int) -> Tuple[float, float, int]:
+        """One probe returning (dist, time, first_edge); (inf, inf, -1) miss."""
+        h = int(pair_hash(np.int64(src), np.int64(dst), self.mask))
+        for p in range(self.max_probes):
+            i = (h + p) & self.mask
+            ts = self.table_src[i]
+            if ts == EMPTY:
+                break
+            if ts == src and self.table_dst[i] == dst:
+                return float(self.table_dist[i]), float(self.table_time[i]), int(self.table_first_edge[i])
+        return float("inf"), float("inf"), -1
+
+    def path_edges(self, src: int, dst: int) -> Optional[List[int]]:
+        """Reconstruct the edge sequence of the shortest path src -> dst by
+        chaining first-edge hops.  None if unreachable within delta."""
+        if src == dst:
+            return []
+        edges: List[int] = []
+        node = src
+        # bounded iterations guard against table corruption
+        for _ in range(self.num_rows + 1):
+            dist, fe = self.lookup(node, dst)
+            if fe < 0:
+                return None
+            edges.append(fe)
+            node = int(self._edge_to[fe]) if self._edge_to is not None else None
+            if node is None:
+                return None
+            if node == dst:
+                return edges
+        return None
+
+    # edge_to is attached post-construction (avoids storing the graph twice)
+    _edge_to: Optional[np.ndarray] = None
+
+    def attach_graph(self, edge_to: np.ndarray) -> "UBODT":
+        self._edge_to = edge_to
+        return self
+
+    def to_device(self) -> DeviceUBODT:
+        import jax.numpy as jnp
+
+        return DeviceUBODT(
+            table_src=jnp.asarray(self.table_src, jnp.int32),
+            table_dst=jnp.asarray(self.table_dst, jnp.int32),
+            table_dist=jnp.asarray(self.table_dist, jnp.float32),
+            table_time=jnp.asarray(self.table_time, jnp.float32),
+            table_first_edge=jnp.asarray(self.table_first_edge, jnp.int32),
+            mask=self.mask,
+            max_probes=self.max_probes,
+        )
+
+
+def _bounded_dijkstra(
+    src: int,
+    delta: float,
+    out_start: np.ndarray,
+    out_edges: np.ndarray,
+    edge_to: np.ndarray,
+    edge_len: np.ndarray,
+    edge_speed: np.ndarray,
+) -> List[Tuple[int, float, float, int]]:
+    """All (dst, dist, time, first_edge) with dist <= delta from src, shortest
+    by distance; time is travel seconds along that path.  Includes the trivial
+    (src, 0.0, 0.0, -1) row."""
+    dist = {src: 0.0}
+    tim = {src: 0.0}
+    first = {src: -1}
+    heap = [(0.0, src)]
+    out: List[Tuple[int, float, float, int]] = []
+    done = set()
+    while heap:
+        d, n = heapq.heappop(heap)
+        if n in done:
+            continue
+        done.add(n)
+        out.append((n, d, tim[n], first[n]))
+        for k in range(out_start[n], out_start[n + 1]):
+            e = int(out_edges[k])
+            m = int(edge_to[e])
+            nd = d + float(edge_len[e])
+            if nd <= delta and nd < dist.get(m, float("inf")):
+                dist[m] = nd
+                tim[m] = tim[n] + float(edge_len[e]) / max(float(edge_speed[e]), 0.1)
+                first[m] = e if n == src else first[n]
+                heapq.heappush(heap, (nd, m))
+    return out
+
+
+def build_ubodt(
+    arrays,
+    delta: float = 3000.0,
+    load_factor: float = 0.5,
+    max_probe_limit: int = 64,
+) -> UBODT:
+    """Build the table from GraphArrays (pure Python; the native C++ builder in
+    native/ is the fast path for big regions)."""
+    rows: List[Tuple[int, int, float, float, int]] = []
+    for src in range(arrays.num_nodes):
+        for dst, d, tm, fe in _bounded_dijkstra(
+            src, delta, arrays.out_start, arrays.out_edges, arrays.edge_to,
+            arrays.edge_len, arrays.edge_speed,
+        ):
+            rows.append((src, dst, d, tm, fe))
+    return ubodt_from_rows(rows, delta, load_factor, max_probe_limit).attach_graph(arrays.edge_to)
+
+
+def ubodt_from_rows(
+    rows: List[Tuple[int, int, float, float, int]],
+    delta: float,
+    load_factor: float = 0.5,
+    max_probe_limit: int = 64,
+) -> UBODT:
+    """Pack (src, dst, dist, time, first_edge) rows into the hash table.
+    Shared by the Python builder above and the native C++ builder's output."""
+    n = len(rows)
+    size = 1
+    while size < max(int(n / load_factor), 8):
+        size <<= 1
+
+    while True:
+        mask = size - 1
+        tsrc = np.full(size, EMPTY, np.int32)
+        tdst = np.full(size, EMPTY, np.int32)
+        tdist = np.full(size, np.inf, np.float32)
+        ttime = np.full(size, np.inf, np.float32)
+        tfe = np.full(size, -1, np.int32)
+        max_probe = 0
+        ok = True
+        for src, dst, d, tm, fe in rows:
+            h = int(pair_hash(np.int64(src), np.int64(dst), mask))
+            for p in range(size):
+                i = (h + p) & mask
+                if tsrc[i] == EMPTY:
+                    tsrc[i] = src
+                    tdst[i] = dst
+                    tdist[i] = d
+                    ttime[i] = tm
+                    tfe[i] = fe
+                    max_probe = max(max_probe, p + 1)
+                    break
+            if max_probe > max_probe_limit:
+                ok = False
+                break
+        if ok:
+            break
+        size <<= 1
+        log.info("ubodt: max probe length exceeded %d, growing table to %d", max_probe_limit, size)
+
+    log.info("ubodt: %d rows, table size %d, max probes %d", n, size, max_probe)
+    return UBODT(
+        delta=delta,
+        table_src=tsrc,
+        table_dst=tdst,
+        table_dist=tdist,
+        table_time=ttime,
+        table_first_edge=tfe,
+        mask=mask,
+        max_probes=max_probe,
+        num_rows=n,
+    )

@@ -8,7 +8,34 @@ is imported anywhere.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# hard override: the surrounding environment exports JAX_PLATFORMS=axon (the
+# tunneled TPU); tests must run on the virtual-device CPU backend.  NB the
+# env var alone is not enough -- sitecustomize imports jax before this file
+# runs, so the config value is overridden again below after import.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+# The environment's sitecustomize registers the 'axon' (tunneled TPU) PJRT
+# plugin in every process, and jax's backend discovery initialises it even
+# when JAX_PLATFORMS=cpu — hanging the whole test run if the tunnel is down.
+# Tests only ever want the virtual-device CPU backend, so drop every other
+# factory before the first backend lookup.
+try:  # defensive: internal API
+    from jax._src import xla_bridge
+
+    for _name in list(getattr(xla_bridge, "_backend_factories", {})):
+        if _name != "cpu":
+            xla_bridge._backend_factories.pop(_name, None)
+except Exception:  # pragma: no cover
+    pass
+
+# XLA compiles via the axon remote-compile path were the original reason for
+# a persistent cache; it stays on because it also makes CPU reruns cheap.
+jax.config.update("jax_compilation_cache_dir", os.path.join(os.path.dirname(__file__), "..", ".jax_cache"))
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.3)

@@ -1,0 +1,146 @@
+import json
+
+import numpy as np
+import pytest
+
+from reporter_tpu.matching import SegmentMatcher, MatcherConfig
+from reporter_tpu.tiles.network import grid_city
+from reporter_tpu.tiles.arrays import build_graph_arrays
+from reporter_tpu.tiles.ubodt import build_ubodt
+
+
+@pytest.fixture(scope="module")
+def setup():
+    city = grid_city(rows=5, cols=5, spacing_m=150.0)
+    arrays = build_graph_arrays(city, cell_size=100.0)
+    ubodt = build_ubodt(arrays, delta=2000.0)
+    return city, arrays, ubodt
+
+
+@pytest.fixture(scope="module")
+def matcher(setup):
+    _, arrays, ubodt = setup
+    return SegmentMatcher(arrays=arrays, ubodt=ubodt, config=MatcherConfig())
+
+
+def make_trace(arrays, pts_xy, t0=1000, dt=15, uuid="veh"):
+    lat, lon = arrays.proj.to_latlon(
+        np.array([p[0] for p in pts_xy]), np.array([p[1] for p in pts_xy])
+    )
+    return {
+        "uuid": uuid,
+        "trace": [
+            {"lat": float(a), "lon": float(o), "time": t0 + dt * i, "accuracy": 5}
+            for i, (a, o) in enumerate(zip(lat, lon))
+        ],
+    }
+
+
+def street_trace(arrays, row_nodes, n, jitter=3.0, seed=1, t0=1000, dt=15):
+    rng = np.random.default_rng(seed)
+    xs = arrays.node_x[row_nodes]
+    ys = arrays.node_y[row_nodes]
+    t = np.linspace(0.05, 0.9, n)
+    px = np.interp(t, np.linspace(0, 1, len(xs)), xs) + rng.normal(0, jitter, n)
+    py = np.interp(t, np.linspace(0, 1, len(ys)), ys) + rng.normal(0, jitter, n)
+    return make_trace(arrays, list(zip(px, py)), t0=t0, dt=dt)
+
+
+class TestMatchWire:
+    def test_full_and_partial_segments(self, setup, matcher):
+        _, arrays, _ = setup
+        trace = street_trace(arrays, [2 * 5 + c for c in range(5)], 10)
+        out = json.loads(matcher.Match(json.dumps(trace)))
+        segs = out["segments"]
+        assert len(segs) >= 3
+        # first entered mid-segment, last exited mid-segment
+        assert segs[0]["start_time"] == -1 and segs[0]["length"] == -1
+        assert segs[-1]["end_time"] == -1 and segs[-1]["length"] == -1
+        # middles fully traversed with contiguous times
+        for a, b in zip(segs, segs[1:]):
+            if a["end_time"] != -1 and b["start_time"] != -1:
+                assert a["end_time"] == pytest.approx(b["start_time"], abs=0.01)
+        full = [s for s in segs if s["length"] != -1]
+        assert full and all(s["length"] == pytest.approx(150.0, rel=0.01) for s in full)
+        # schema keys
+        for s in segs:
+            for key in ("way_ids", "internal", "queue_length", "begin_shape_index", "end_shape_index",
+                        "start_time", "end_time", "length"):
+                assert key in s
+
+    def test_shape_indices_monotonic(self, setup, matcher):
+        _, arrays, _ = setup
+        trace = street_trace(arrays, [1 * 5 + c for c in range(5)], 12)
+        segs = matcher.match(trace)["segments"]
+        idxs = [(s["begin_shape_index"], s["end_shape_index"]) for s in segs]
+        for b, e in idxs:
+            assert 0 <= b <= e < 12
+        for (b1, e1), (b2, e2) in zip(idxs, idxs[1:]):
+            assert b2 >= b1 and e2 >= e1
+
+    def test_queue_length_stopped_vehicle(self, setup, matcher):
+        _, arrays, _ = setup
+        # drive onto the middle street then stop near the end of a block
+        row = [2 * 5 + c for c in range(5)]
+        y = float(arrays.node_y[row[0]])
+        xs = [float(arrays.node_x[row[0]]) + v for v in (10, 60, 110, 140, 141, 142, 143)]
+        # crawling at <1 m/s for the last 4 points (15 s apart)
+        trace = make_trace(arrays, [(x, y) for x in xs])
+        segs = matcher.match(trace)["segments"]
+        first = segs[0]
+        assert first["queue_length"] > 0
+
+    def test_free_flow_zero_queue(self, setup, matcher):
+        _, arrays, _ = setup
+        trace = street_trace(arrays, [3 * 5 + c for c in range(5)], 8, dt=5)
+        segs = matcher.match(trace)["segments"]
+        assert all(s["queue_length"] == 0 for s in segs)
+
+
+class TestBackendDiff:
+    def test_cpu_backend_matches_jax(self, setup):
+        _, arrays, ubodt = setup
+        jaxm = SegmentMatcher(arrays=arrays, ubodt=ubodt, config=MatcherConfig())
+        cpum = SegmentMatcher(arrays=arrays, ubodt=ubodt, config=MatcherConfig(), backend="cpu")
+        for seed, row in [(1, 0), (2, 1), (3, 2), (4, 3)]:
+            trace = street_trace(arrays, [row * 5 + c for c in range(5)], 10, seed=seed)
+            sj = jaxm.match(trace)["segments"]
+            sc = cpum.match(trace)["segments"]
+            assert [s.get("segment_id") for s in sj] == [s.get("segment_id") for s in sc], seed
+            for a, b in zip(sj, sc):
+                assert a["start_time"] == pytest.approx(b["start_time"], abs=0.5)
+                assert a["end_time"] == pytest.approx(b["end_time"], abs=0.5)
+
+
+def test_time_factor_cuts_infeasible_speed(setup):
+    """A 150 m hop in 1 s (540 km/h) exceeds free-flow time * factor -> the
+    matcher should break rather than claim a continuous traversal."""
+    _, arrays, ubodt = setup
+    m = SegmentMatcher(arrays=arrays, ubodt=ubodt, config=MatcherConfig())
+    row = [2 * 5 + c for c in range(5)]
+    y = float(arrays.node_y[row[0]])
+    xs = [10.0 + float(arrays.node_x[row[0]]), 20.0 + float(arrays.node_x[row[0]]),
+          float(arrays.node_x[row[3]]), float(arrays.node_x[row[3]]) + 10.0]
+    trace = make_trace(arrays, [(x, y) for x in xs], dt=1)
+    segs = m.match(trace)["segments"]
+    # discontinuity: some segment boundary must be partial (-1) mid-trace
+    boundary_times = [(s["start_time"], s["end_time"]) for s in segs]
+    assert any(st == -1 or et == -1 for st, et in boundary_times)
+
+
+def test_epoch_scale_times_preserved(setup, matcher):
+    """Unix-epoch timestamps (~1.7e9 s) must survive the device float32 cast:
+    times are rebased per trace before casting, so dt and interpolated
+    boundary times keep sub-second precision."""
+    _, arrays, _ = setup
+    t0 = 1753776000
+    trace = street_trace(arrays, [2 * 5 + c for c in range(5)], 10, t0=t0)
+    segs = matcher.match(trace)["segments"]
+    bounded = [s for s in segs if s["start_time"] != -1]
+    assert bounded and all(s["start_time"] >= t0 for s in bounded)
+    pairs = [
+        (a["end_time"], b["start_time"])
+        for a, b in zip(segs, segs[1:])
+        if a["end_time"] != -1 and b["start_time"] != -1
+    ]
+    assert pairs and all(abs(x - y) < 0.01 for x, y in pairs)

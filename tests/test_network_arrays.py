@@ -1,0 +1,101 @@
+import numpy as np
+import pytest
+
+from reporter_tpu import geo
+from reporter_tpu.tiles.network import RoadNetwork, Edge, grid_city
+from reporter_tpu.tiles.arrays import build_graph_arrays
+from reporter_tpu.tiles.segment_id import unpack_segment_id
+
+
+@pytest.fixture(scope="module")
+def city():
+    return grid_city(rows=6, cols=6, spacing_m=200.0)
+
+
+@pytest.fixture(scope="module")
+def arrays(city):
+    return build_graph_arrays(city, cell_size=100.0)
+
+
+def test_grid_city_shape(city):
+    assert city.num_nodes == 36
+    # 6 rows * 5 blocks + 6 cols * 5 blocks, 2 directed edges each
+    assert city.num_edges == 2 * (6 * 5 + 6 * 5)
+    # all segment ids valid and level-consistent
+    for e in city.edges:
+        level, _, _ = unpack_segment_id(e.segment_id)
+        assert level == e.level
+
+
+def test_edge_lengths_close_to_spacing(city, arrays):
+    np.testing.assert_allclose(arrays.edge_len, 200.0, rtol=5e-3)
+
+
+def test_segment_table(city, arrays):
+    # one segment per directed edge in the default grid
+    assert len(arrays.seg_ids) == city.num_edges
+    assert (arrays.edge_seg >= 0).all()
+    np.testing.assert_allclose(arrays.seg_len[arrays.edge_seg], arrays.edge_len, rtol=1e-6)
+    assert (arrays.edge_seg_off == 0).all()
+
+
+def test_multi_edge_segments():
+    city = grid_city(rows=2, cols=5, spacing_m=100.0, two_edge_segments=True)
+    arrays = build_graph_arrays(city, cell_size=100.0)
+    multi = {}
+    for ei in range(arrays.num_edges):
+        s = int(arrays.edge_seg[ei])
+        multi.setdefault(s, []).append(ei)
+    spans = [eids for eids in multi.values() if len(eids) > 1]
+    assert spans, "expected some multi-edge segments"
+    for eids in spans:
+        offs = sorted(float(arrays.edge_seg_off[e]) for e in eids)
+        assert offs[0] == 0.0 and offs[1] > 0.0
+        s = int(arrays.edge_seg[eids[0]])
+        total = sum(float(arrays.edge_len[e]) for e in eids)
+        assert arrays.seg_len[s] == pytest.approx(total, rel=1e-6)
+
+
+def test_csr_adjacency(city, arrays):
+    for n in range(city.num_nodes):
+        eids = arrays.out_edges[arrays.out_start[n]:arrays.out_start[n + 1]]
+        assert all(arrays.edge_from[e] == n for e in eids)
+    assert arrays.out_start[-1] == city.num_edges
+
+
+def test_spatial_grid_covers_all_segments(arrays):
+    present = set(arrays.grid_items[arrays.grid_items >= 0].tolist())
+    assert present == set(range(len(arrays.shp_ax)))
+
+
+def test_grid_query_finds_nearby_segment(city, arrays):
+    # a point 10 m off the middle of the first edge must appear in the 3x3
+    # neighbourhood of its cell
+    si = 0
+    mx = (arrays.shp_ax[si] + arrays.shp_bx[si]) / 2
+    my = (arrays.shp_ay[si] + arrays.shp_by[si]) / 2 + 10.0
+    cx, cy = arrays.cell_of(float(mx), float(my))
+    items = set()
+    for dy in (-1, 0, 1):
+        for dx in (-1, 0, 1):
+            cell = (cy + dy) * arrays.grid_nx + (cx + dx)
+            if 0 <= cell < arrays.grid_items.shape[0]:
+                items.update(arrays.grid_items[cell][arrays.grid_items[cell] >= 0].tolist())
+    assert si in items
+
+
+def test_roundtrip_dict(city):
+    d = city.to_dict()
+    net2 = RoadNetwork.from_dict(d)
+    assert net2.num_nodes == city.num_nodes
+    assert net2.num_edges == city.num_edges
+    assert net2.edges[3].segment_id == city.edges[3].segment_id
+
+
+def test_device_graph_pytree(arrays):
+    import jax
+
+    dg = arrays.to_device()
+    leaves = jax.tree_util.tree_leaves(dg)
+    assert all(hasattr(l, "shape") for l in leaves)
+    assert dg.grid_items.shape == arrays.grid_items.shape

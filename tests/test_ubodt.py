@@ -1,0 +1,108 @@
+import heapq
+
+import numpy as np
+import pytest
+
+from reporter_tpu.tiles.network import grid_city
+from reporter_tpu.tiles.arrays import build_graph_arrays
+from reporter_tpu.tiles.ubodt import build_ubodt, pair_hash
+
+
+@pytest.fixture(scope="module")
+def city():
+    return grid_city(rows=5, cols=5, spacing_m=150.0)
+
+
+@pytest.fixture(scope="module")
+def arrays(city):
+    return build_graph_arrays(city, cell_size=100.0)
+
+
+@pytest.fixture(scope="module")
+def ubodt(arrays):
+    return build_ubodt(arrays, delta=1000.0)
+
+
+def reference_dijkstra(arrays, src):
+    """Independent textbook Dijkstra over all nodes (no bound)."""
+    dist = {src: 0.0}
+    heap = [(0.0, src)]
+    done = {}
+    while heap:
+        d, n = heapq.heappop(heap)
+        if n in done:
+            continue
+        done[n] = d
+        for k in range(arrays.out_start[n], arrays.out_start[n + 1]):
+            e = int(arrays.out_edges[k])
+            m = int(arrays.edge_to[e])
+            nd = d + float(arrays.edge_len[e])
+            if nd < dist.get(m, float("inf")):
+                dist[m] = nd
+                heapq.heappush(heap, (nd, m))
+    return done
+
+
+def test_ubodt_distances_match_dijkstra(arrays, ubodt):
+    for src in range(0, arrays.num_nodes, 7):
+        ref = reference_dijkstra(arrays, src)
+        for dst, d in ref.items():
+            got, _ = ubodt.lookup(src, dst)
+            if d <= 1000.0:
+                assert got == pytest.approx(d, rel=1e-5), (src, dst)
+            else:
+                assert got == float("inf")
+
+
+def test_ubodt_self_distance(arrays, ubodt):
+    for n in range(arrays.num_nodes):
+        d, fe = ubodt.lookup(n, n)
+        assert d == 0.0 and fe == -1
+
+
+def test_ubodt_miss(ubodt):
+    assert ubodt.lookup(0, 10_000)[0] == float("inf")
+
+
+def test_path_reconstruction(arrays, ubodt):
+    for src in range(0, arrays.num_nodes, 5):
+        ref = reference_dijkstra(arrays, src)
+        for dst, d in ref.items():
+            if d > 1000.0 or dst == src:
+                continue
+            path = ubodt.path_edges(src, dst)
+            assert path is not None, (src, dst)
+            # path must be connected, start at src, end at dst, and sum to d
+            assert int(arrays.edge_from[path[0]]) == src
+            assert int(arrays.edge_to[path[-1]]) == dst
+            for a, b in zip(path, path[1:]):
+                assert int(arrays.edge_to[a]) == int(arrays.edge_from[b])
+            total = sum(float(arrays.edge_len[e]) for e in path)
+            assert total == pytest.approx(d, rel=1e-5)
+
+
+def test_device_lookup_matches_host(arrays, ubodt):
+    import jax.numpy as jnp
+
+    from reporter_tpu.ops.hashtable import ubodt_lookup, device_pair_hash
+
+    du = ubodt.to_device()
+    rng = np.random.default_rng(0)
+    src = rng.integers(0, arrays.num_nodes, size=200).astype(np.int32)
+    dst = rng.integers(0, arrays.num_nodes, size=200).astype(np.int32)
+    d_dev, t_dev, fe_dev = ubodt_lookup(du, jnp.asarray(src), jnp.asarray(dst))
+    d_dev = np.asarray(d_dev)
+    fe_dev = np.asarray(fe_dev)
+    for i in range(len(src)):
+        d_host, fe_host = ubodt.lookup(int(src[i]), int(dst[i]))
+        if np.isinf(d_host):
+            assert np.isinf(d_dev[i])
+        else:
+            assert d_dev[i] == pytest.approx(d_host, rel=1e-6)
+            assert fe_dev[i] == fe_host
+
+    # hash parity host vs device
+    mask = ubodt.mask
+    h_host = np.array([int(pair_hash(np.int64(s), np.int64(t), mask)) for s, t in zip(src, dst)])
+    h_dev = np.asarray(device_pair_hash(jnp.asarray(src), jnp.asarray(dst), mask))
+    np.testing.assert_array_equal(h_host, h_dev)

@@ -1,0 +1,215 @@
+import itertools
+
+import numpy as np
+import pytest
+
+from reporter_tpu.matching.config import MatcherConfig
+from reporter_tpu.tiles.network import grid_city
+from reporter_tpu.tiles.arrays import build_graph_arrays
+from reporter_tpu.tiles.ubodt import build_ubodt
+
+K = 8
+
+
+@pytest.fixture(scope="module")
+def city():
+    return grid_city(rows=5, cols=5, spacing_m=150.0)
+
+
+@pytest.fixture(scope="module")
+def arrays(city):
+    return build_graph_arrays(city, cell_size=100.0)
+
+
+@pytest.fixture(scope="module")
+def ubodt(arrays):
+    return build_ubodt(arrays, delta=2000.0)
+
+
+@pytest.fixture(scope="module")
+def device(arrays, ubodt):
+    return arrays.to_device(), ubodt.to_device()
+
+
+@pytest.fixture(scope="module")
+def params():
+    import jax.numpy as jnp  # noqa
+
+    from reporter_tpu.ops.viterbi import MatchParams
+
+    return MatchParams.from_config(MatcherConfig())
+
+
+def run_match(device, params, xs, ys, valid=None, times=None):
+    import jax
+    import jax.numpy as jnp
+
+    from reporter_tpu.ops.viterbi import match_trace
+
+    dg, du = device
+    xs = jnp.asarray(xs, jnp.float32)
+    ys = jnp.asarray(ys, jnp.float32)
+    if valid is None:
+        valid = jnp.ones(xs.shape, jnp.bool_)
+    else:
+        valid = jnp.asarray(valid, jnp.bool_)
+    if times is None:
+        times = jnp.arange(xs.shape[0], dtype=jnp.float32) * 15.0
+    else:
+        times = jnp.asarray(times, jnp.float32)
+    fn = jax.jit(match_trace, static_argnums=(7,))
+    return fn(dg, du, xs, ys, times, valid, params, K)
+
+
+def street_points(arrays, row_nodes, n, jitter, rng, t_end=0.9):
+    """Points along the straight line through the given node ids.  Ends
+    mid-block by default: a point exactly on an intersection node ties between
+    the street edge and the crossing edge (both are correct matches)."""
+    xs = arrays.node_x[row_nodes]
+    ys = arrays.node_y[row_nodes]
+    t = np.linspace(0.05, t_end, n)
+    px = np.interp(t, np.linspace(0, 1, len(xs)), xs) + rng.normal(0, jitter, n)
+    py = np.interp(t, np.linspace(0, 1, len(ys)), ys) + rng.normal(0, jitter, n)
+    return px, py
+
+
+def test_straight_drive_matches_street(arrays, device, params):
+    rng = np.random.default_rng(7)
+    # middle horizontal street: nodes 10..14 (row 2 of 5x5)
+    row = [2 * 5 + c for c in range(5)]
+    px, py = street_points(arrays, row, 12, jitter=3.0, rng=rng)
+    res = run_match(device, params, px, py)
+    idx = np.asarray(res.idx)
+    assert (idx >= 0).all(), "every point should match"
+    edges = np.asarray(res.cand.edge)[np.arange(len(idx)), idx]
+    # all matched edges must lie on that street row: both endpoints in row nodes
+    for e in edges:
+        assert int(arrays.edge_from[e]) in row and int(arrays.edge_to[e]) in row, e
+    breaks = np.asarray(res.breaks)
+    assert breaks[0] and not breaks[1:].any()
+
+
+def test_viterbi_matches_exhaustive(arrays, device, params):
+    import jax.numpy as jnp
+
+    from reporter_tpu.ops.candidates import find_candidates_batch
+    from reporter_tpu.ops.viterbi import transition_matrix, NEG_INF
+
+    rng = np.random.default_rng(3)
+    row = [1 * 5 + c for c in range(5)]
+    px, py = street_points(arrays, row, 5, jitter=8.0, rng=rng)
+    dg, du = device
+    res = run_match(device, params, px, py)
+
+    cand = find_candidates_batch(dg, jnp.asarray(px, jnp.float32), jnp.asarray(py, jnp.float32),
+                                 K, params.search_radius)
+    dist = np.asarray(cand.dist)
+    emis = np.where(np.isfinite(dist), -0.5 * (dist / float(params.sigma_z)) ** 2, NEG_INF)
+    T = len(px)
+    gc = np.hypot(np.diff(px), np.diff(py))
+    trans = []
+    import jax
+
+    for t in range(T - 1):
+        src = jax.tree_util.tree_map(lambda a: a[t], cand)
+        dst = jax.tree_util.tree_map(lambda a: a[t + 1], cand)
+        logp, _ = transition_matrix(dg, du, src, dst, jnp.float32(gc[t]), jnp.float32(15.0), params)
+        trans.append(np.asarray(logp))
+
+    # exhaustive best path (no breaks expected in this easy scenario)
+    best_score, best_path = -np.inf, None
+    for path in itertools.product(range(K), repeat=T):
+        s = emis[0, path[0]]
+        for t in range(1, T):
+            s += trans[t - 1][path[t - 1], path[t]] + emis[t, path[t]]
+        if s > best_score:
+            best_score, best_path = s, path
+
+    idx = np.asarray(res.idx)
+    got_score = emis[0, idx[0]]
+    for t in range(1, T):
+        got_score += trans[t - 1][idx[t - 1], idx[t]] + emis[t, idx[t]]
+    assert got_score == pytest.approx(best_score, rel=1e-5)
+
+
+def test_teleport_causes_break(arrays, device, params):
+    rng = np.random.default_rng(11)
+    row = [0 * 5 + c for c in range(5)]
+    px1, py1 = street_points(arrays, row, 6, jitter=2.0, rng=rng)
+    row2 = [4 * 5 + c for c in range(5)]
+    px2, py2 = street_points(arrays, row2, 6, jitter=2.0, rng=rng)
+    # rows 0 and 4 are 600 m apart; shrink breakage to force the break
+    import dataclasses
+
+    from reporter_tpu.ops.viterbi import MatchParams
+
+    cfg = MatcherConfig(breakage_distance=300.0)
+    p = MatchParams.from_config(cfg)
+    px = np.concatenate([px1, px2])
+    py = np.concatenate([py1, py2])
+    res = run_match(device, p, px, py)
+    breaks = np.asarray(res.breaks)
+    assert breaks[6], "teleport must start a new HMM segment"
+    idx = np.asarray(res.idx)
+    assert (idx >= 0).all()
+    edges = np.asarray(res.cand.edge)[np.arange(len(idx)), idx]
+    for e in edges[:6]:
+        assert int(arrays.edge_from[e]) in row
+    for e in edges[6:]:
+        assert int(arrays.edge_from[e]) in row2
+
+
+def test_padding_equivalence(arrays, device, params):
+    rng = np.random.default_rng(5)
+    row = [3 * 5 + c for c in range(5)]
+    px, py = street_points(arrays, row, 10, jitter=3.0, rng=rng)
+    res_full = run_match(device, params, px, py)
+    T_pad = 16
+    px_p = np.concatenate([px, np.zeros(T_pad - len(px))])
+    py_p = np.concatenate([py, np.zeros(T_pad - len(py))])
+    valid = np.concatenate([np.ones(len(px), bool), np.zeros(T_pad - len(px), bool)])
+    res_pad = run_match(device, params, px_p, py_p, valid)
+    idx_f = np.asarray(res_full.idx)
+    idx_p = np.asarray(res_pad.idx)
+    assert (idx_p[len(px):] == -1).all(), "padded steps must be unmatched"
+    ef = np.asarray(res_full.cand.edge)[np.arange(len(idx_f)), idx_f]
+    ep = np.asarray(res_pad.cand.edge)[np.arange(len(px)), idx_p[: len(px)]]
+    np.testing.assert_array_equal(ef, ep)
+
+
+def test_no_candidate_gap(arrays, device, params):
+    rng = np.random.default_rng(9)
+    row = [2 * 5 + c for c in range(5)]
+    px, py = street_points(arrays, row, 8, jitter=2.0, rng=rng)
+    # move one mid point to a block centre: 75 m from every road (outside the
+    # 50 m search radius but below breakage).  NB just pushing it off the
+    # street is not enough -- in a grid city a crossing street is never far.
+    px[4] = float(arrays.node_x[0]) + 75.0
+    py[4] = float(arrays.node_y[2 * 5]) + 75.0
+    res = run_match(device, params, px, py)
+    idx = np.asarray(res.idx)
+    assert idx[4] == -1, "point outside search radius must be unmatched"
+    assert (idx[:4] >= 0).all() and (idx[5:] >= 0).all()
+
+
+def test_batch_vmap_matches_single(arrays, device, params):
+    import jax
+    import jax.numpy as jnp
+
+    from reporter_tpu.ops.viterbi import match_batch
+
+    rng = np.random.default_rng(13)
+    traces = []
+    for r in range(3):
+        row = [r * 5 + c for c in range(5)]
+        traces.append(street_points(arrays, row, 9, jitter=3.0, rng=rng))
+    px = jnp.asarray(np.stack([t[0] for t in traces]), jnp.float32)
+    py = jnp.asarray(np.stack([t[1] for t in traces]), jnp.float32)
+    valid = jnp.ones(px.shape, jnp.bool_)
+    times = jnp.tile(jnp.arange(px.shape[1], dtype=jnp.float32)[None, :] * 15.0, (px.shape[0], 1))
+    dg, du = device
+    fn = jax.jit(match_batch, static_argnums=(7,))
+    res_b = fn(dg, du, px, py, times, valid, params, K)
+    for b in range(3):
+        res_1 = run_match(device, params, np.asarray(px[b]), np.asarray(py[b]))
+        np.testing.assert_array_equal(np.asarray(res_b.idx[b]), np.asarray(res_1.idx))
