@@ -1,0 +1,14 @@
+from .tiles import SegmentObservation, TimeQuantisedTile, observations_for_report, privacy_cull, CSV_HEADER
+from .storage import DirStore, HttpStore, S3Store, make_store
+
+__all__ = [
+    "SegmentObservation",
+    "TimeQuantisedTile",
+    "observations_for_report",
+    "privacy_cull",
+    "CSV_HEADER",
+    "DirStore",
+    "HttpStore",
+    "S3Store",
+    "make_store",
+]

@@ -1,0 +1,135 @@
+"""Tile storage backends: local directory, HTTP POST, S3 PUT.
+
+Mirrors the egress options of the reference's anonymiser
+(AnonymisingProcessor.java:177-220): a tile flush goes to exactly one of
+  - a local directory (tests / batch staging)
+  - an HTTP datastore endpoint (POST body = CSV)
+  - an S3 bucket, authenticated with AWS signature V2 (HMAC-SHA1 over
+    "PUT\n\n{content-type}\n{date}\n/{bucket}/{key}", HttpClient.java:44-58)
+    using urllib only -- no boto dependency.
+
+All network backends honour the reference's budget: 1 s connect-ish timeout,
+10 s total, 3 retries (HttpClient.java:80-88).
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import hmac
+import logging
+import os
+import time
+import urllib.error
+import urllib.request
+from email.utils import formatdate
+from typing import Optional
+
+log = logging.getLogger(__name__)
+
+RETRIES = 3
+TIMEOUT_SEC = 10.0
+
+
+class DirStore:
+    def __init__(self, root: str):
+        self.root = root
+
+    def put(self, key: str, body: str) -> None:
+        path = os.path.join(self.root, key)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "w") as f:
+            f.write(body)
+
+    def __repr__(self):
+        return "DirStore(%r)" % (self.root,)
+
+
+class HttpStore:
+    def __init__(self, url: str):
+        self.url = url.rstrip("/")
+
+    def put(self, key: str, body: str) -> None:
+        req = urllib.request.Request(
+            self.url + "/" + key,
+            data=body.encode("utf-8"),
+            headers={"Content-Type": "text/csv"},
+            method="POST",
+        )
+        _do_with_retries(req)
+
+    def __repr__(self):
+        return "HttpStore(%r)" % (self.url,)
+
+
+class S3Store:
+    def __init__(
+        self,
+        bucket: str,
+        access_key: Optional[str] = None,
+        secret_key: Optional[str] = None,
+        endpoint: str = "https://{bucket}.s3.amazonaws.com",
+        prefix: str = "",
+    ):
+        self.bucket = bucket
+        self.prefix = prefix.strip("/")
+        self.access_key = access_key or os.environ.get("AWS_ACCESS_KEY_ID", "")
+        self.secret_key = secret_key or os.environ.get("AWS_SECRET_ACCESS_KEY", "")
+        self.endpoint = endpoint.format(bucket=bucket)
+
+    def put(self, key: str, body: str) -> None:
+        if self.prefix:
+            key = self.prefix + "/" + key
+        content_type = "text/csv"
+        date = formatdate(usegmt=True)
+        to_sign = "PUT\n\n%s\n%s\n/%s/%s" % (content_type, date, self.bucket, key)
+        sig = base64.b64encode(
+            hmac.new(self.secret_key.encode(), to_sign.encode(), hashlib.sha1).digest()
+        ).decode()
+        req = urllib.request.Request(
+            "%s/%s" % (self.endpoint, key),
+            data=body.encode("utf-8"),
+            headers={
+                "Content-Type": content_type,
+                "Date": date,
+                "Authorization": "AWS %s:%s" % (self.access_key, sig),
+            },
+            method="PUT",
+        )
+        _do_with_retries(req)
+
+    def __repr__(self):
+        return "S3Store(%r)" % (self.bucket,)
+
+
+def _do_with_retries(req: urllib.request.Request) -> None:
+    last: Optional[Exception] = None
+    for attempt in range(RETRIES):
+        if attempt:
+            time.sleep(0.2 * attempt)
+        try:
+            with urllib.request.urlopen(req, timeout=TIMEOUT_SEC) as resp:
+                resp.read()
+                return
+        except urllib.error.HTTPError as e:
+            # 4xx won't improve on retry
+            if 400 <= e.code < 500:
+                raise
+            last = e
+        except Exception as e:  # URLError, socket timeouts
+            last = e
+    raise RuntimeError("store failed after %d attempts: %s" % (RETRIES, last))
+
+
+def make_store(spec: str):
+    """'dir:/path', 'http://...', 'https://...', 's3://bucket'."""
+    if spec.startswith("dir:"):
+        return DirStore(spec[4:])
+    if spec.startswith("s3://"):
+        rest = spec[5:].strip("/")
+        bucket, _, prefix = rest.partition("/")
+        return S3Store(bucket, prefix=prefix)
+    if spec.startswith("http://") or spec.startswith("https://"):
+        return HttpStore(spec)
+    # bare path: directory
+    return DirStore(spec)

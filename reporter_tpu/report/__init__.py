@@ -1,0 +1,3 @@
+from .reporter import report
+
+__all__ = ["report"]

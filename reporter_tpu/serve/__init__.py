@@ -1,0 +1,3 @@
+from .service import ReporterService, MicroBatcher, load_service_config
+
+__all__ = ["ReporterService", "MicroBatcher", "load_service_config"]

@@ -1,0 +1,57 @@
+"""CLI: python -m reporter_tpu.serve <config.json> <host:port>
+
+Mirrors the reference service invocation
+(py/reporter_service.py:278-299: ``reporter_service.py conf address``).
+Env: MATCHER_BIND_ADDR / MATCHER_LISTEN_PORT override the address like the
+reference's container env (README.md Env Var Overrides); THRESHOLD_SEC as in
+reporter_service.py:55-57.
+"""
+
+import logging
+import os
+import sys
+
+from ..utils.jaxenv import ensure_platform
+from .service import ReporterService, load_service_config
+
+
+def main(argv):
+    logging.basicConfig(
+        level=logging.INFO, format="%(asctime)s %(levelname)s %(message)s"
+    )
+    ensure_platform()
+    if len(argv) < 2:
+        sys.stderr.write("usage: python -m reporter_tpu.serve <config.json> [host:port]\n")
+        return 1
+    try:
+        matcher, conf = load_service_config(argv[1])
+    except Exception as e:
+        sys.stderr.write("Problem with config file: %s\n" % (e,))
+        return 1
+
+    if len(argv) > 2:
+        if ":" in argv[2]:
+            host, port = argv[2].rsplit(":", 1)
+        else:
+            host, port = "0.0.0.0", argv[2]
+    else:
+        host = os.environ.get("MATCHER_BIND_ADDR", "0.0.0.0")
+        port = os.environ.get("MATCHER_LISTEN_PORT", "8002")
+
+    batch = conf.get("batch", {})
+    service = ReporterService(
+        matcher,
+        max_batch=int(batch.get("max_batch", 64)),
+        max_wait_ms=float(batch.get("max_wait_ms", 10.0)),
+    )
+    httpd = service.make_server(host, int(port))
+    logging.info("reporter_tpu service on %s:%s (backend=%s)", host, port, matcher.backend)
+    try:
+        httpd.serve_forever()
+    except KeyboardInterrupt:
+        httpd.server_close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

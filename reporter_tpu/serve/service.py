@@ -1,0 +1,279 @@
+"""HTTP matching service.
+
+Wire-compatible with the reference's reporter_service
+(py/reporter_service.py:182-274):
+
+  GET  /report?json={...}   and   POST /report
+      -> {"datastore": ..., "segment_matcher": ..., "shape_used": ...,
+          "stats": ...}
+      with the same validation errors (uuid required, >= 2 points,
+      report_levels / transition_levels required).
+
+Plus the TPU-native addition (BASELINE.json north star):
+
+  POST /trace_attributes_batch   {"traces": [trace, ...]}
+      -> {"results": [report-output, ...]}
+
+Architecture difference from the reference, on purpose: the reference keeps
+one C++ matcher per thread and matches traces one at a time
+(reporter_service.py:51-58).  Here a single shared matcher owns the device,
+and a MicroBatcher aggregates concurrent requests into padded [B, T] batches
+for one vmapped device program -- single /report requests arriving together
+are batched transparently, which is where the TPU throughput comes from.
+
+THRESHOLD_SEC is honoured like the reference (:54-58).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import queue
+import threading
+from concurrent.futures import Future
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import List, Optional, Tuple
+from urllib.parse import parse_qs, urlsplit
+
+from ..matching import MatcherConfig, SegmentMatcher
+from ..report import report as report_fn
+from ..tiles.network import RoadNetwork, grid_city
+
+log = logging.getLogger(__name__)
+
+ACTIONS = {"report", "trace_attributes_batch"}
+
+
+class MicroBatcher:
+    """Aggregates traces from concurrent requests into one device batch.
+
+    Traces are enqueued with a Future; a single worker drains the queue,
+    waits up to ``max_wait_ms`` to fill ``max_batch`` slots, runs
+    matcher.match_many once, and resolves the futures.  Batching across
+    requests is what keeps the TPU busy when clients send one trace per call.
+    """
+
+    def __init__(self, matcher: SegmentMatcher, max_batch: int = 64, max_wait_ms: float = 10.0):
+        self.matcher = matcher
+        self.max_batch = max_batch
+        self.max_wait = max_wait_ms / 1000.0
+        self._q: "queue.Queue[Tuple[dict, Future]]" = queue.Queue()
+        self._thread = threading.Thread(target=self._worker, daemon=True)
+        self._thread.start()
+
+    def submit(self, trace: dict) -> Future:
+        f: Future = Future()
+        self._q.put((trace, f))
+        return f
+
+    def match(self, trace: dict) -> dict:
+        return self.submit(trace).result()
+
+    def match_many(self, traces: List[dict]) -> List[dict]:
+        futures = [self.submit(t) for t in traces]
+        return [f.result() for f in futures]
+
+    def _worker(self):
+        import time as _time
+
+        while True:
+            trace, fut = self._q.get()
+            batch = [(trace, fut)]
+            # opportunistically fill the batch within one absolute window so
+            # the first request's extra latency is bounded by max_wait
+            deadline = _time.monotonic() + self.max_wait
+            while len(batch) < self.max_batch:
+                remaining = deadline - _time.monotonic()
+                if remaining <= 0:
+                    break
+                try:
+                    batch.append(self._q.get(timeout=remaining))
+                except queue.Empty:
+                    break
+            try:
+                results = self.matcher.match_many([t for t, _ in batch])
+                for (t, f), r in zip(batch, results):
+                    if not f.set_running_or_notify_cancel():
+                        continue
+                    f.set_result(r)
+            except Exception as e:  # resolve everything with the error
+                log.exception("batch match failed")
+                for _, f in batch:
+                    if f.set_running_or_notify_cancel():
+                        f.set_exception(e)
+
+
+class ReporterService:
+    """Owns the matcher + batcher and implements the request semantics."""
+
+    def __init__(
+        self,
+        matcher: SegmentMatcher,
+        threshold_sec: Optional[int] = None,
+        max_batch: int = 64,
+        max_wait_ms: float = 10.0,
+    ):
+        if threshold_sec is None:
+            threshold_sec = int(os.environ.get("THRESHOLD_SEC", matcher.cfg.threshold_sec))
+        self.threshold_sec = threshold_sec
+        self.matcher = matcher
+        self.batcher = MicroBatcher(matcher, max_batch=max_batch, max_wait_ms=max_wait_ms)
+
+    # -- request handling --------------------------------------------------
+
+    def validate(self, trace: dict) -> Tuple[Optional[str], Optional[set], Optional[set]]:
+        """Returns (error, report_levels, transition_levels)."""
+        if trace.get("uuid") is None:
+            return "uuid is required", None, None
+        try:
+            trace["trace"][1]
+        except Exception:
+            return (
+                "trace must be a non zero length array of object each of which must "
+                "have at least lat, lon and time"
+            ), None, None
+        try:
+            rl = set(trace["match_options"]["report_levels"])
+        except Exception:
+            return "match_options must include report_levels array", None, None
+        try:
+            tl = set(trace["match_options"]["transition_levels"])
+        except Exception:
+            return "match_options must include transition_levels array", None, None
+        return None, rl, tl
+
+    def handle_report(self, trace: dict) -> Tuple[int, dict]:
+        err, rl, tl = self.validate(trace)
+        if err:
+            return 400, {"error": err}
+        try:
+            match = self.batcher.match(trace)
+            data = report_fn(match, trace, self.threshold_sec, rl, tl,
+                             mode=trace.get("match_options", {}).get("mode", "auto"))
+            return 200, data
+        except Exception as e:
+            log.exception("match failed")
+            return 500, {"error": str(e)}
+
+    def handle_batch(self, body: dict) -> Tuple[int, dict]:
+        traces = body.get("traces")
+        if not isinstance(traces, list) or not traces:
+            return 400, {"error": "traces must be a non-empty array"}
+        validated = []
+        for i, trace in enumerate(traces):
+            err, rl, tl = self.validate(trace)
+            if err:
+                return 400, {"error": "trace %d: %s" % (i, err)}
+            validated.append((trace, rl, tl))
+        try:
+            matches = self.batcher.match_many([t for t, _, _ in validated])
+            results = [
+                report_fn(m, t, self.threshold_sec, rl, tl,
+                          mode=t.get("match_options", {}).get("mode", "auto"))
+                for m, (t, rl, tl) in zip(matches, validated)
+            ]
+            return 200, {"results": results}
+        except Exception as e:
+            log.exception("batch failed")
+            return 500, {"error": str(e)}
+
+    # -- server ------------------------------------------------------------
+
+    def make_server(self, host: str = "0.0.0.0", port: int = 8002) -> ThreadingHTTPServer:
+        service = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def _answer(self, code: int, payload: dict):
+                body = json.dumps(payload, separators=(",", ":")).encode("utf-8")
+                self.send_response(code)
+                self.send_header("Access-Control-Allow-Origin", "*")
+                self.send_header("Content-Type", "application/json;charset=utf-8")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _route(self, post: bool):
+                try:
+                    split = urlsplit(self.path)
+                    action = split.path.split("/")[-1]
+                    if action not in ACTIONS:
+                        return self._answer(
+                            400, {"error": "Try a valid action: %s" % sorted(ACTIONS)}
+                        )
+                    if post:
+                        n = int(self.headers.get("Content-Length", 0))
+                        payload = json.loads(self.rfile.read(n).decode("utf-8"))
+                    else:
+                        params = parse_qs(split.query)
+                        if "json" not in params:
+                            return self._answer(400, {"error": "No json provided"})
+                        payload = json.loads(params["json"][0])
+                except Exception as e:
+                    return self._answer(400, {"error": str(e)})
+
+                try:
+                    if not isinstance(payload, dict):
+                        code, out = 400, {"error": "request body must be a json object"}
+                    elif action == "report":
+                        code, out = service.handle_report(payload)
+                    else:
+                        code, out = service.handle_batch(payload)
+                except Exception as e:  # belt-and-braces: never drop the socket
+                    log.exception("unhandled request error")
+                    code, out = 500, {"error": str(e)}
+                self._answer(code, out)
+
+            def do_GET(self):
+                self._route(post=False)
+
+            def do_POST(self):
+                self._route(post=True)
+
+            def log_message(self, fmt, *args):
+                log.debug("http: " + fmt, *args)
+
+        return ThreadingHTTPServer((host, port), Handler)
+
+
+def load_service_config(path: str) -> Tuple[SegmentMatcher, dict]:
+    """Service config JSON:
+
+    {
+      "network": {"type": "grid", "rows": 8, "cols": 8, "spacing_m": 200}
+               | {"type": "file", "path": "network.json"}
+               | {"type": "tiles", "path": "tiles_dir"}        (native codec)
+      "matcher": { MatcherConfig fields / meili keys },
+      "backend": "jax" | "cpu",
+      "batch": {"max_batch": 64, "max_wait_ms": 10}
+    }
+    """
+    with open(path) as f:
+        conf = json.load(f)
+    mconf = conf.get("matcher", {})
+    if "meili" in mconf or "default" in mconf:
+        cfg = MatcherConfig.from_meili(mconf)
+    else:
+        cfg = MatcherConfig.from_dict(mconf)
+    netspec = conf.get("network", {"type": "grid"})
+    kind = netspec.get("type", "grid")
+    if kind == "grid":
+        net = grid_city(
+            rows=netspec.get("rows", 8),
+            cols=netspec.get("cols", 8),
+            spacing_m=netspec.get("spacing_m", 200.0),
+            origin=tuple(netspec.get("origin", (37.75, -122.45))),
+        )
+    elif kind == "file":
+        with open(netspec["path"]) as f:
+            net = RoadNetwork.from_dict(json.load(f))
+    elif kind == "tiles":
+        from ..tiles.codec import load_network_tiles
+
+        net = load_network_tiles(netspec["path"])
+    else:
+        raise ValueError("unknown network type %r" % (kind,))
+    matcher = SegmentMatcher(network=net, config=cfg, backend=conf.get("backend", "jax"))
+    return matcher, conf

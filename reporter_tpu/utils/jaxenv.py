@@ -1,0 +1,51 @@
+"""JAX platform hygiene for entry points.
+
+In this deployment, site customisation registers every discovered PJRT
+plugin (e.g. a tunneled TPU backend) in every Python process, and JAX's
+backend discovery *initialises* all registered plugins even when
+JAX_PLATFORMS selects only "cpu".  If the accelerator tunnel is down, that
+init blocks forever -- hanging a service that only asked for CPU.
+
+``ensure_platform()`` makes the selection real: when the requested platform
+set excludes a registered factory, the factory is dropped before first
+backend use.  Call it from every entry point (service CLI, batch pipeline,
+bench) before touching jax arrays.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Optional
+
+log = logging.getLogger(__name__)
+
+
+def ensure_platform(platforms: Optional[str] = None) -> str:
+    """platforms: comma-separated allow-list, e.g. "cpu" or "axon,cpu".
+    Defaults to $JAX_PLATFORMS, else leaves everything alone.  Returns the
+    effective setting."""
+    if platforms is None:
+        platforms = os.environ.get("JAX_PLATFORMS", "")
+    if not platforms:
+        return ""
+    allowed = {p.strip() for p in platforms.split(",") if p.strip()}
+
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", ",".join(sorted(allowed)))
+    except Exception:  # pragma: no cover
+        pass
+    try:
+        from jax._src import xla_bridge
+
+        factories = getattr(xla_bridge, "_backend_factories", None)
+        if isinstance(factories, dict):
+            for name in list(factories):
+                if name not in allowed:
+                    factories.pop(name, None)
+                    log.debug("dropped jax backend factory %r (not in %s)", name, sorted(allowed))
+    except Exception:  # pragma: no cover - internal API drift
+        log.warning("could not prune jax backend factories", exc_info=True)
+    return ",".join(sorted(allowed))

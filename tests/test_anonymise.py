@@ -1,0 +1,150 @@
+import http.server
+import os
+import threading
+
+import pytest
+
+from reporter_tpu.anonymise import (
+    CSV_HEADER,
+    SegmentObservation,
+    TimeQuantisedTile,
+    observations_for_report,
+    privacy_cull,
+    make_store,
+    DirStore,
+    HttpStore,
+)
+from reporter_tpu.anonymise.tiles import tile_csv, usable_report
+from reporter_tpu.tiles.segment_id import INVALID_SEGMENT_ID, pack_segment_id, get_tile_id
+
+SID = pack_segment_id(1, 1000, 5)
+SID2 = pack_segment_id(1, 1000, 6)
+
+
+def rep(t0, t1, sid=SID, next_id=None, length=200.0, queue=0.0):
+    r = {"id": sid, "t0": t0, "t1": t1, "length": length, "queue_length": queue}
+    if next_id is not None:
+        r["next_id"] = next_id
+    return r
+
+
+class TestObservations:
+    def test_single_bucket(self):
+        out = list(observations_for_report(rep(100, 160, next_id=SID2), 3600, "src"))
+        assert len(out) == 1
+        tile, obs = out[0]
+        assert tile == TimeQuantisedTile(0, get_tile_id(SID))
+        assert obs.segment_id == SID and obs.next_segment_id == SID2
+        assert obs.duration == 60 and obs.count == 1
+        assert obs.min_timestamp == 100 and obs.max_timestamp == 160
+
+    def test_bucket_spanning(self):
+        out = list(observations_for_report(rep(3590, 3610), 3600, "src"))
+        assert [t.time_start for t, _ in out] == [0, 3600]
+
+    def test_max_buckets_guard(self):
+        out = list(observations_for_report(rep(0, 4 * 3600), 3600, "src", max_buckets=2))
+        assert out == []
+
+    def test_no_next_id_uses_invalid(self):
+        _, obs = next(iter(observations_for_report(rep(10, 20), 3600, "src")))
+        assert obs.next_segment_id == INVALID_SEGMENT_ID
+
+    def test_tile_path(self):
+        tile = TimeQuantisedTile(7200, get_tile_id(SID))
+        assert tile.path(3600) == "7200_10799/1/1000"
+
+    def test_usable_report_filter(self):
+        assert usable_report(rep(10, 20))
+        assert not usable_report(rep(0, 20))          # t0 not > 0
+        assert not usable_report(rep(10, 10.2))       # too short
+        assert not usable_report(rep(10, 20, length=0))
+        assert not usable_report(rep(10, 20, queue=-1))
+
+
+class TestPrivacyCull:
+    def obs(self, sid, next_id, t=100):
+        return SegmentObservation(sid, next_id, 10, 1, 200.0, 0.0, t, t + 10, "s", "AUTO")
+
+    def test_cull_below_privacy(self):
+        rows = [self.obs(SID, SID2), self.obs(SID, SID2), self.obs(SID2, SID)]
+        out = privacy_cull(rows, 2)
+        assert len(out) == 2
+        assert all(o.segment_id == SID for o in out)
+
+    def test_privacy_one_keeps_all(self):
+        rows = [self.obs(SID, SID2), self.obs(SID2, SID)]
+        assert len(privacy_cull(rows, 1)) == 2
+
+    def test_cull_everything(self):
+        rows = [self.obs(SID, SID2)]
+        assert privacy_cull(rows, 2) == []
+
+    def test_csv_roundtrip(self):
+        rows = [self.obs(SID, SID2), self.obs(SID, SID2)]
+        text = tile_csv(rows)
+        lines = text.strip().split("\n")
+        assert lines[0] == CSV_HEADER
+        back = SegmentObservation.from_csv_row(lines[1])
+        assert back == rows[0]
+
+
+class TestStores:
+    def test_dir_store(self, tmp_path):
+        store = make_store("dir:%s" % tmp_path)
+        store.put("7200_10799/1/1000/src.abc", "hello\n")
+        assert (tmp_path / "7200_10799" / "1" / "1000" / "src.abc").read_text() == "hello\n"
+
+    def test_make_store_kinds(self, tmp_path):
+        assert isinstance(make_store(str(tmp_path)), DirStore)
+        assert isinstance(make_store("http://x/y"), HttpStore)
+        assert make_store("s3://bucket").bucket == "bucket"
+
+    def test_http_store_posts(self):
+        received = {}
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_POST(self):
+                received["path"] = self.path
+                received["body"] = self.rfile.read(int(self.headers["Content-Length"])).decode()
+                self.send_response(200)
+                self.end_headers()
+                self.wfile.write(b"ok")
+
+            def log_message(self, *a):
+                pass
+
+        srv = http.server.HTTPServer(("127.0.0.1", 0), Handler)
+        t = threading.Thread(target=srv.serve_forever, daemon=True)
+        t.start()
+        try:
+            store = HttpStore("http://127.0.0.1:%d/store" % srv.server_port)
+            store.put("0_3599/1/1000/src.x", "csv,data\n")
+            assert received["path"] == "/store/0_3599/1/1000/src.x"
+            assert received["body"] == "csv,data\n"
+        finally:
+            srv.shutdown()
+
+    def test_http_store_4xx_raises(self):
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_POST(self):
+                self.send_response(400)
+                self.end_headers()
+
+            def log_message(self, *a):
+                pass
+
+        srv = http.server.HTTPServer(("127.0.0.1", 0), Handler)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        try:
+            store = HttpStore("http://127.0.0.1:%d" % srv.server_port)
+            with pytest.raises(Exception):
+                store.put("k", "v")
+        finally:
+            srv.shutdown()
+
+
+def test_s3_prefix_split():
+    from reporter_tpu.anonymise import make_store
+    s = make_store("s3://mybucket/tiles/v1")
+    assert s.bucket == "mybucket" and s.prefix == "tiles/v1"

@@ -1,0 +1,171 @@
+"""Behavioral tests for report(), mirroring the reference walk
+(py/reporter_service.py:79-179) case by case."""
+
+import pytest
+
+from reporter_tpu.report import report
+from reporter_tpu.tiles.segment_id import pack_segment_id
+
+
+def seg(sid=None, start=0.0, end=10.0, length=100.0, internal=False, queue=0, begin=0, end_idx=1):
+    s = {
+        "start_time": start,
+        "end_time": end,
+        "length": length,
+        "internal": internal,
+        "queue_length": queue,
+        "begin_shape_index": begin,
+        "end_shape_index": end_idx,
+        "way_ids": [],
+    }
+    if sid is not None:
+        s["segment_id"] = sid
+    return s
+
+
+def mk_trace(n=10, t0=0, dt=10):
+    return {"uuid": "u", "trace": [{"lat": 0, "lon": 0, "time": t0 + i * dt} for i in range(n)]}
+
+
+L0 = pack_segment_id(0, 1, 1)
+L0B = pack_segment_id(0, 1, 2)
+L1 = pack_segment_id(1, 1, 3)
+L2 = pack_segment_id(2, 1, 4)
+
+RL = {0, 1}
+TL = {0, 1}
+
+
+def test_basic_pair_reporting():
+    match = {"segments": [
+        seg(L0, start=0, end=30, length=300, begin=0, end_idx=3),
+        seg(L0B, start=30, end=60, length=300, begin=3, end_idx=6),
+    ]}
+    out = report(match, mk_trace(n=10, dt=10), 15, RL, TL)
+    reports = out["datastore"]["reports"]
+    # only the prior (first) segment is reported; the second awaits a successor
+    assert len(reports) == 1
+    r = reports[0]
+    assert r["id"] == L0 and r["next_id"] == L0B
+    assert r["t0"] == 0 and r["t1"] == 30  # t1 = successor start (transition level)
+    assert out["stats"]["successful_matches"]["count"] == 1
+    assert out["stats"]["successful_matches"]["length"] == 0.3
+
+
+def test_threshold_holds_back_recent_segments():
+    # trace ends at t=90; segment starting at 80 is within threshold 15
+    match = {"segments": [
+        seg(L0, start=0, end=50, length=300, begin=0, end_idx=5),
+        seg(L0B, start=80, end=90, length=300, begin=8, end_idx=9),
+    ]}
+    out = report(match, mk_trace(n=10, dt=10), 15, RL, TL)
+    # the recent segment is excluded entirely: nothing to pair the first with
+    assert out["datastore"]["reports"] == []
+    assert out.get("shape_used") is None  # begin_shape_index 0 is falsy -> omitted
+
+
+def test_shape_used_emitted_for_nonzero_index():
+    match = {"segments": [
+        seg(L0, start=0, end=30, length=300, begin=0, end_idx=3),
+        seg(L0B, start=30, end=60, length=300, begin=3, end_idx=6),
+    ]}
+    out = report(match, mk_trace(n=10, dt=10), 15, RL, TL)
+    assert out["shape_used"] == 3
+
+
+def test_non_transition_level_uses_prior_end_time():
+    # successor on level 2, transitions only {0,1}: t1 = prior end, no next_id
+    match = {"segments": [
+        seg(L0, start=0, end=30, length=300),
+        seg(L2, start=35, end=60, length=300),
+    ]}
+    out = report(match, mk_trace(n=10, dt=10), 15, RL, TL)
+    r = out["datastore"]["reports"][0]
+    assert r["t1"] == 30 and "next_id" not in r
+
+
+def test_unreported_level():
+    # prior on level 2 with report_levels {0,1}: counted unreported
+    match = {"segments": [
+        seg(L2, start=0, end=30, length=300),
+        seg(L0, start=30, end=60, length=300),
+    ]}
+    out = report(match, mk_trace(n=10, dt=10), 15, RL, TL)
+    assert out["datastore"]["reports"] == []
+    assert out["stats"]["unreported_matches"]["count"] == 1
+    assert out["stats"]["unreported_matches"]["length"] == 0.3
+
+
+def test_partial_prior_never_reported():
+    match = {"segments": [
+        seg(L0, start=-1, end=30, length=-1),
+        seg(L0B, start=30, end=60, length=300),
+    ]}
+    out = report(match, mk_trace(n=10, dt=10), 15, RL, TL)
+    assert out["datastore"]["reports"] == []
+
+
+def test_internal_segment_transparent():
+    match = {"segments": [
+        seg(L0, start=0, end=28, length=300),
+        seg(None, start=28, end=32, length=-1, internal=True),
+        seg(L0B, start=32, end=60, length=300),
+    ]}
+    out = report(match, mk_trace(n=10, dt=10), 15, RL, TL)
+    r = out["datastore"]["reports"][0]
+    # the internal segment is skipped; pair is (L0, L0B) with t1 = L0B start
+    assert r["id"] == L0 and r["next_id"] == L0B and r["t1"] == 32
+    # internal does not count as unassociated
+    assert out["stats"]["unassociated_segments"] == 0
+
+
+def test_invalid_time_and_speed_cuts():
+    match = {"segments": [
+        seg(L0, start=30, end=30, length=300),   # dt = 0 -> invalid time
+        seg(L0B, start=30, end=31, length=300),  # prior for next pair
+        seg(L1, start=31, end=60, length=300),
+    ]}
+    out = report(match, mk_trace(n=10, dt=10), 15, RL, TL)
+    # pair1: t0=30 t1=30 -> invalid time; pair2: 300m in 1s -> invalid speed
+    assert out["stats"]["match_errors"]["invalid_times"] == 1
+    assert out["stats"]["match_errors"]["invalid_speeds"] == 1
+    assert out["datastore"]["reports"] == []
+
+
+def test_discontinuity_count():
+    match = {"segments": [
+        seg(L0, start=0, end=-1, length=-1),
+        seg(L0B, start=-1, end=60, length=-1),
+        seg(L1, start=60, end=70, length=300),
+    ]}
+    out = report(match, mk_trace(n=10, dt=10), 15, RL, TL)
+    assert out["stats"]["match_errors"]["discontinuities"] == 1
+
+
+def test_unassociated_count():
+    match = {"segments": [
+        seg(None, start=0, end=30, length=-1),
+        seg(L0, start=30, end=60, length=300),
+    ]}
+    out = report(match, mk_trace(n=10, dt=10), 15, RL, TL)
+    assert out["stats"]["unassociated_segments"] == 1
+
+
+def test_mode_propagated():
+    match = {"segments": []}
+    out = report(match, mk_trace(), 15, RL, TL)
+    assert out["datastore"]["mode"] == "auto"
+    assert out["segment_matcher"]["mode"] == "auto"
+
+
+def test_unassociated_prior_with_positive_length_not_counted_unreported():
+    # reference gate (reporter_service.py:122): prior must have a segment id;
+    # a matched-but-unassociated prior with positive length contributes only
+    # to unassociated_segments, never to unreported_matches
+    match = {"segments": [
+        seg(None, start=0, end=30, length=300),
+        seg(L0, start=30, end=60, length=300),
+    ]}
+    out = report(match, mk_trace(n=10, dt=10), 15, RL, TL)
+    assert out["stats"]["unreported_matches"]["count"] == 0
+    assert out["stats"]["unassociated_segments"] == 1

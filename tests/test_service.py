@@ -1,0 +1,161 @@
+import json
+import threading
+import urllib.parse
+import urllib.request
+
+import numpy as np
+import pytest
+
+from reporter_tpu.matching import SegmentMatcher, MatcherConfig
+from reporter_tpu.serve import ReporterService
+from reporter_tpu.tiles.network import grid_city
+from reporter_tpu.tiles.arrays import build_graph_arrays
+from reporter_tpu.tiles.ubodt import build_ubodt
+
+
+@pytest.fixture(scope="module")
+def service_url():
+    city = grid_city(rows=5, cols=5, spacing_m=150.0)
+    arrays = build_graph_arrays(city, cell_size=100.0)
+    ubodt = build_ubodt(arrays, delta=2000.0)
+    matcher = SegmentMatcher(arrays=arrays, ubodt=ubodt, config=MatcherConfig())
+    service = ReporterService(matcher, max_wait_ms=5.0)
+    httpd = service.make_server("127.0.0.1", 0)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    url = "http://127.0.0.1:%d" % httpd.server_port
+    yield url, arrays
+    httpd.shutdown()
+
+
+def get_json(url):
+    try:
+        with urllib.request.urlopen(url, timeout=30) as r:
+            return r.status, json.loads(r.read().decode())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read().decode())
+
+
+def post_json(url, payload):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(), headers={"Content-Type": "application/json"}
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=60) as r:
+            return r.status, json.loads(r.read().decode())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read().decode())
+
+
+def street_trace(arrays, row=2, n=10, t0=1000):
+    nodes = [row * 5 + c for c in range(5)]
+    t = np.linspace(0.05, 0.9, n)
+    xs = np.interp(t, np.linspace(0, 1, 5), arrays.node_x[nodes])
+    ys = np.interp(t, np.linspace(0, 1, 5), arrays.node_y[nodes])
+    lat, lon = arrays.proj.to_latlon(xs, ys)
+    return {
+        "uuid": "veh-%d" % row,
+        "trace": [
+            {"lat": float(a), "lon": float(o), "time": t0 + 15 * i}
+            for i, (a, o) in enumerate(zip(lat, lon))
+        ],
+        "match_options": {"mode": "auto", "report_levels": [0, 1, 2], "transition_levels": [0, 1, 2]},
+    }
+
+
+class TestReportEndpoint:
+    def test_get_report(self, service_url):
+        url, arrays = service_url
+        trace = street_trace(arrays)
+        q = urllib.parse.quote(json.dumps(trace))
+        code, out = get_json("%s/report?json=%s" % (url, q))
+        assert code == 200
+        assert "datastore" in out and "segment_matcher" in out and "stats" in out
+        assert out["datastore"]["mode"] == "auto"
+        assert out["datastore"]["reports"], "expected reports from a clean drive"
+        for r in out["datastore"]["reports"]:
+            assert set(r) >= {"id", "t0", "t1", "length", "queue_length"}
+
+    def test_post_report(self, service_url):
+        url, arrays = service_url
+        code, out = post_json(url + "/report", street_trace(arrays))
+        assert code == 200 and out["datastore"]["reports"]
+
+    def test_missing_uuid(self, service_url):
+        url, arrays = service_url
+        trace = street_trace(arrays)
+        del trace["uuid"]
+        code, out = post_json(url + "/report", trace)
+        assert code == 400 and out["error"] == "uuid is required"
+
+    def test_short_trace(self, service_url):
+        url, arrays = service_url
+        trace = street_trace(arrays)
+        trace["trace"] = trace["trace"][:1]
+        code, out = post_json(url + "/report", trace)
+        assert code == 400 and "non zero length array" in out["error"]
+
+    def test_missing_levels(self, service_url):
+        url, arrays = service_url
+        trace = street_trace(arrays)
+        del trace["match_options"]["report_levels"]
+        code, out = post_json(url + "/report", trace)
+        assert code == 400 and "report_levels" in out["error"]
+        trace = street_trace(arrays)
+        del trace["match_options"]["transition_levels"]
+        code, out = post_json(url + "/report", trace)
+        assert code == 400 and "transition_levels" in out["error"]
+
+    def test_bad_action(self, service_url):
+        url, _ = service_url
+        code, out = post_json(url + "/bogus", {})
+        assert code == 400 and "valid action" in out["error"]
+
+    def test_bad_json(self, service_url):
+        url, _ = service_url
+        code, out = get_json(url + "/report?json=%7Bnot")
+        assert code == 400
+
+
+class TestBatchEndpoint:
+    def test_batch(self, service_url):
+        url, arrays = service_url
+        traces = [street_trace(arrays, row=r) for r in range(4)]
+        code, out = post_json(url + "/trace_attributes_batch", {"traces": traces})
+        assert code == 200
+        assert len(out["results"]) == 4
+        for res in out["results"]:
+            assert res["datastore"]["reports"]
+
+    def test_batch_matches_single(self, service_url):
+        url, arrays = service_url
+        trace = street_trace(arrays, row=1)
+        _, single = post_json(url + "/report", trace)
+        _, batch = post_json(url + "/trace_attributes_batch", {"traces": [trace]})
+        assert batch["results"][0]["datastore"] == single["datastore"]
+
+    def test_batch_validation(self, service_url):
+        url, arrays = service_url
+        code, out = post_json(url + "/trace_attributes_batch", {"traces": []})
+        assert code == 400
+        bad = street_trace(arrays)
+        del bad["uuid"]
+        code, out = post_json(url + "/trace_attributes_batch", {"traces": [bad]})
+        assert code == 400 and "trace 0" in out["error"]
+
+    def test_concurrent_singles_share_batches(self, service_url):
+        url, arrays = service_url
+        results = [None] * 8
+
+        def hit(i):
+            results[i] = post_json(url + "/report", street_trace(arrays, row=i % 4))
+
+        threads = [threading.Thread(target=hit, args=(i,)) for i in range(8)]
+        [t.start() for t in threads]
+        [t.join() for t in threads]
+        assert all(code == 200 and out["datastore"]["reports"] for code, out in results)
+
+
+def test_non_object_body_gets_400(service_url):
+    url, _ = service_url
+    code, out = post_json(url + "/report", [1, 2])
+    assert code == 400 and "object" in out["error"]
