@@ -1,0 +1,156 @@
+#!/usr/bin/env python3
+"""Benchmark: GPS traces map-matched per second per chip.
+
+Prints exactly ONE JSON line to stdout:
+  {"metric": "traces_matched_per_sec_per_chip", "value": N, "unit":
+   "traces/s", "vs_baseline": R}
+
+vs_baseline is the speedup over the single-process CPU oracle
+(reporter_tpu/baseline), the stand-in for the reference's one-Meili-process
+configuration (BASELINE.md: the reference publishes no numbers, so config 1
+of BASELINE.json is measured here).
+
+Scenario: metro-scale synthetic grid (config 4 of BASELINE.json in spirit),
+noisy 5 s-sampled traces, padded [B, T] batches through the full public
+match path (device Viterbi + host segment association).  Diagnostics
+(agreement, kernel-only throughput) go to stderr.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+
+def probe_accelerator(timeout_s: float = 90.0) -> bool:
+    """True if the default (non-cpu) jax backend initialises in a subprocess."""
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", "import jax; d=jax.devices(); print(d[0].platform)"],
+            capture_output=True, text=True, timeout=timeout_s,
+            env=dict(os.environ),
+        )
+        ok = r.returncode == 0 and r.stdout.strip() != ""
+        if ok:
+            sys.stderr.write("bench: accelerator probe ok: %s\n" % r.stdout.strip())
+        else:
+            sys.stderr.write("bench: accelerator probe failed: %s\n" % r.stderr[-300:])
+        return ok
+    except subprocess.TimeoutExpired:
+        sys.stderr.write("bench: accelerator probe timed out -- falling back to cpu\n")
+        return False
+
+
+def main():
+    env_plat = os.environ.get("JAX_PLATFORMS", "")
+    if env_plat in ("", "axon", "tpu") and not probe_accelerator():
+        os.environ["JAX_PLATFORMS"] = "cpu"
+
+    from reporter_tpu.utils.jaxenv import ensure_platform
+
+    ensure_platform()
+
+    import numpy as np
+    import jax
+
+    platform = jax.devices()[0].platform
+    sys.stderr.write("bench: running on %s (%d device(s))\n" % (platform, len(jax.devices())))
+
+    from reporter_tpu.matching import MatcherConfig, SegmentMatcher
+    from reporter_tpu.synth import TraceSynthesizer
+    from reporter_tpu.synth.generator import segment_agreement
+    from reporter_tpu.tiles.arrays import build_graph_arrays
+    from reporter_tpu.tiles.network import grid_city
+    from reporter_tpu.tiles.ubodt import build_ubodt
+
+    # metro-scale-ish synthetic city; UBODT delta trimmed to keep the pure-
+    # Python preprocess inside the bench budget (native builder is the fast path)
+    rows = cols = int(os.environ.get("BENCH_GRID", "24"))
+    t0 = time.time()
+    city = grid_city(rows=rows, cols=cols, spacing_m=150.0)
+    arrays = build_graph_arrays(city, cell_size=100.0)
+    ubodt = build_ubodt(arrays, delta=float(os.environ.get("BENCH_DELTA", "800")))
+    sys.stderr.write(
+        "bench: graph %d nodes / %d edges, ubodt %d rows (%.1fs build)\n"
+        % (arrays.num_nodes, arrays.num_edges, ubodt.num_rows, time.time() - t0)
+    )
+
+    cfg = MatcherConfig()
+    n_traces = int(os.environ.get("BENCH_TRACES", "256"))
+    n_points = int(os.environ.get("BENCH_POINTS", "64"))
+    synth = TraceSynthesizer(arrays, seed=7)
+    t0 = time.time()
+    straces = synth.batch(n_traces, n_points, dt=5.0, sigma=5.0)
+    traces = [s.trace for s in straces]
+    sys.stderr.write("bench: synthesized %d traces x %d pts (%.1fs)\n" % (n_traces, n_points, time.time() - t0))
+
+    matcher = SegmentMatcher(arrays=arrays, ubodt=ubodt, config=cfg)
+
+    # warmup (compile)
+    t0 = time.time()
+    matcher.match_many(traces[:8])
+    sys.stderr.write("bench: warmup/compile %.1fs\n" % (time.time() - t0))
+
+    # end-to-end throughput (device viterbi + host segment association)
+    reps = int(os.environ.get("BENCH_REPS", "3"))
+    t0 = time.time()
+    for _ in range(reps):
+        results = matcher.match_many(traces)
+    wall = time.time() - t0
+    tps = n_traces * reps / wall
+
+    # kernel-only throughput for the curious
+    import jax.numpy as jnp
+
+    B = n_traces
+    px = np.zeros((B, n_points), np.float32)
+    py = np.zeros((B, n_points), np.float32)
+    tm = np.zeros((B, n_points), np.float32)
+    valid = np.ones((B, n_points), bool)
+    for i, s in enumerate(straces):
+        pts = s.trace["trace"]
+        x, y = arrays.proj.to_xy([p["lat"] for p in pts], [p["lon"] for p in pts])
+        px[i], py[i] = x, y
+        tm[i] = np.asarray([p["time"] for p in pts]) - pts[0]["time"]
+    from reporter_tpu.ops.viterbi import MatchParams, match_batch
+
+    jit_match = jax.jit(match_batch, static_argnums=(7,))
+    dg, du, p = matcher._dg, matcher._du, matcher._params
+    args = (dg, du, jnp.asarray(px), jnp.asarray(py), jnp.asarray(tm), jnp.asarray(valid), p)
+    jax.block_until_ready(jit_match(*args, cfg.beam_k))
+    t0 = time.time()
+    for _ in range(reps):
+        res = jit_match(*args, cfg.beam_k)
+    jax.block_until_ready(res)
+    kernel_tps = B * reps / (time.time() - t0)
+    sys.stderr.write("bench: kernel-only %.1f traces/s; end-to-end %.1f traces/s\n" % (kernel_tps, tps))
+
+    # accuracy: segment agreement vs ground truth
+    edge = np.asarray(res.idx)
+    cand_edge = np.asarray(res.cand.edge)
+    sel = np.maximum(edge, 0)
+    medge = cand_edge[np.arange(B)[:, None], np.arange(n_points)[None, :], sel]
+    medge = np.where(edge >= 0, medge, -1)
+    agr = float(np.mean([segment_agreement(arrays, medge[i], straces[i]) for i in range(B)]))
+    sys.stderr.write("bench: mean segment agreement vs truth: %.3f\n" % agr)
+
+    # CPU single-process baseline on a subset
+    n_cpu = int(os.environ.get("BENCH_CPU_TRACES", "12"))
+    cpum = SegmentMatcher(arrays=arrays, ubodt=ubodt, config=cfg, backend="cpu")
+    cpum.match_many(traces[:1])  # warm any lazy paths
+    t0 = time.time()
+    cpum.match_many(traces[:n_cpu])
+    cpu_tps = n_cpu / (time.time() - t0)
+    sys.stderr.write("bench: cpu baseline %.2f traces/s (%d traces)\n" % (cpu_tps, n_cpu))
+
+    print(json.dumps({
+        "metric": "traces_matched_per_sec_per_chip",
+        "value": round(tps, 2),
+        "unit": "traces/s",
+        "vs_baseline": round(tps / cpu_tps, 2) if cpu_tps > 0 else None,
+    }))
+
+
+if __name__ == "__main__":
+    main()

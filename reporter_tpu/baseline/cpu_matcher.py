@@ -110,11 +110,11 @@ class CPUViterbiMatcher:
         offset = np.zeros(T, np.float64)
         breaks = np.zeros(T, bool)
 
-        scores: List[float] = []
+        if T == 0:
+            return edge, offset, breaks
         backptr: List[List[int]] = [[]]
         seg_start = 0
         seg_ranges: List[Tuple[int, int]] = []  # (start, end) of HMM segments
-        choice: List[List[float]] = [emis[0][:]]
         scores = emis[0][:]
         all_scores = [scores[:]]
 
@@ -173,12 +173,13 @@ class CPUViterbiMatcher:
         breaks = np.zeros((B, T), bool)
         for b in range(B):
             n = int(valid[b].sum())
+            if n == 0:  # batch-padding dummy row
+                continue
             e, o, br = self.match_points(px[b, :n], py[b, :n], times[b, :n])
             edge[b, :n] = e
             offset[b, :n] = o
             breaks[b, :n] = br
-            if n:
-                breaks[b, 0] = True
+            breaks[b, 0] = True
         return edge, offset, breaks
 
 

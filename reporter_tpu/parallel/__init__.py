@@ -1,0 +1,3 @@
+from .mesh import make_mesh, sharded_match_fn, match_and_histogram
+
+__all__ = ["make_mesh", "sharded_match_fn", "match_and_histogram"]

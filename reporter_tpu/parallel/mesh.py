@@ -1,0 +1,133 @@
+"""Device-mesh parallelism for the matcher.
+
+The reference scales by Kafka partitions / thread pools / multiprocessing
+(SURVEY.md §5 "distributed communication backend"); the TPU-native equivalent
+is SPMD over a ``jax.sharding.Mesh``:
+
+  - the trace batch axis is sharded over the mesh ("dp": each chip matches
+    its shard of traces)
+  - graph arrays and the UBODT table are replicated -- they are read-only,
+    gather-heavy state that every shard needs (multi-region *tile sharding*
+    is the planned later axis)
+  - per-segment histograms (the tile aggregation the anonymiser consumes) are
+    reduced across shards with a ``psum`` riding the ICI, replacing the
+    single-process sort of the reference's punctuate step
+
+Everything goes through one jit with explicit in/out shardings; XLA inserts
+the collectives.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops.viterbi import MatchParams, MatchResult, match_batch
+from ..tiles.arrays import DeviceGraph
+from ..tiles.ubodt import DeviceUBODT
+
+BATCH_AXIS = "dp"
+
+
+def make_mesh(n_devices: Optional[int] = None, devices: Optional[Sequence] = None) -> Mesh:
+    if devices is None:
+        devices = jax.devices()
+    if n_devices is not None:
+        devices = devices[:n_devices]
+    import numpy as np
+
+    return Mesh(np.asarray(devices), (BATCH_AXIS,))
+
+
+class SegmentHistogram(NamedTuple):
+    """Per-OSMLR-segment aggregates over the (global) batch -- the on-device
+    precursor of tile observations."""
+
+    point_count: jnp.ndarray  # [S] matched points per segment
+    trace_count: jnp.ndarray  # [S] traces that touched the segment
+    time_in_segment: jnp.ndarray  # [S] summed seconds between consecutive points
+    distance_in_segment: jnp.ndarray  # [S] summed route metres
+
+
+def match_and_histogram(
+    dg: DeviceGraph,
+    du: DeviceUBODT,
+    px: jnp.ndarray,
+    py: jnp.ndarray,
+    times: jnp.ndarray,
+    valid: jnp.ndarray,
+    p: MatchParams,
+    k: int,
+    num_segments: int,
+):
+    """The framework's full device step: match the [B, T] batch, then reduce
+    per-segment aggregates across the whole batch.  Under a sharded jit the
+    segment_sum over the batch axis lowers to a psum across shards."""
+    res = match_batch(dg, du, px, py, times, valid, p, k)
+    B, T = px.shape
+
+    sel = jnp.maximum(res.idx, 0)
+    edge = jnp.take_along_axis(res.cand.edge, sel[..., None], axis=2)[..., 0]  # [B, T]
+    matched = res.idx >= 0
+    seg = jnp.where(matched, dg.edge_seg[jnp.maximum(edge, 0)], -1)  # [B, T]
+
+    # per-point counts
+    flat_seg = jnp.where(seg >= 0, seg, num_segments)  # overflow bin for unmatched
+    ones = jnp.ones_like(flat_seg, jnp.float32)
+    point_count = jax.ops.segment_sum(
+        ones.reshape(-1), flat_seg.reshape(-1), num_segments=num_segments + 1
+    )[:num_segments]
+
+    # per-step dwell: time/distance between consecutive points on the same segment
+    same_seg = (seg[:, 1:] == seg[:, :-1]) & (seg[:, 1:] >= 0) & ~res.breaks[:, 1:]
+    dt = jnp.where(same_seg, times[:, 1:] - times[:, :-1], 0.0)
+    dd = jnp.where(same_seg & jnp.isfinite(res.route_dist[:, 1:]), res.route_dist[:, 1:], 0.0)
+    step_seg = jnp.where(same_seg, seg[:, 1:], num_segments)
+    time_in = jax.ops.segment_sum(
+        dt.reshape(-1), step_seg.reshape(-1), num_segments=num_segments + 1
+    )[:num_segments]
+    dist_in = jax.ops.segment_sum(
+        dd.reshape(-1), step_seg.reshape(-1), num_segments=num_segments + 1
+    )[:num_segments]
+
+    # trace-touch counts: 1 per (trace, segment) pair -- approximate with the
+    # "first point on segment" indicator (segment change or trace start)
+    first_touch = (seg >= 0) & jnp.concatenate(
+        [jnp.ones((B, 1), bool), seg[:, 1:] != seg[:, :-1]], axis=1
+    )
+    touch_seg = jnp.where(first_touch, seg, num_segments)
+    trace_count = jax.ops.segment_sum(
+        jnp.ones_like(touch_seg, jnp.float32).reshape(-1),
+        touch_seg.reshape(-1),
+        num_segments=num_segments + 1,
+    )[:num_segments]
+
+    hist = SegmentHistogram(
+        point_count=point_count,
+        trace_count=trace_count,
+        time_in_segment=time_in,
+        distance_in_segment=dist_in,
+    )
+    return res, hist
+
+
+def sharded_match_fn(mesh: Mesh, k: int, num_segments: int):
+    """Returns a jitted (dg, du, px, py, times, valid, params) -> (MatchResult,
+    SegmentHistogram) with the batch axis sharded over the mesh and the
+    histogram fully replicated (the psum happens inside)."""
+    repl = NamedSharding(mesh, P())
+    batched = NamedSharding(mesh, P(BATCH_AXIS))
+
+    def fn(dg, du, px, py, times, valid, p):
+        return match_and_histogram(dg, du, px, py, times, valid, p, k, num_segments)
+
+    # prefix shardings: a single NamedSharding applies to every leaf of the
+    # corresponding argument/result subtree
+    return jax.jit(
+        fn,
+        in_shardings=(repl, repl, batched, batched, batched, batched, repl),
+        out_shardings=(batched, repl),
+    )

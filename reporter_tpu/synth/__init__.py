@@ -1,0 +1,3 @@
+from .generator import TraceSynthesizer
+
+__all__ = ["TraceSynthesizer"]
