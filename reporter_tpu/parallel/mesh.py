@@ -36,6 +36,11 @@ def make_mesh(n_devices: Optional[int] = None, devices: Optional[Sequence] = Non
     if devices is None:
         devices = jax.devices()
     if n_devices is not None:
+        if n_devices > len(devices):
+            raise ValueError(
+                "asked for a %d-device mesh but only %d device(s) are visible"
+                % (n_devices, len(devices))
+            )
         devices = devices[:n_devices]
     import numpy as np
 

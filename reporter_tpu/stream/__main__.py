@@ -1,0 +1,74 @@
+"""Streaming runtime CLI -- the ``reporter-kafka`` equivalent
+(Reporter.java:43-136's option surface).
+
+    python -m reporter_tpu.stream \
+        --format ',sv,\\|,1,9,10,0,5,yyyy-MM-dd HH:mm:ss' \
+        --reporter-url http://localhost:8002/report \
+        --privacy 2 --quantisation 3600 --flush-interval 300 \
+        --source TEST --output /results \
+        [--bootstrap host:9092 --topic raw | reads stdin]
+"""
+
+import argparse
+import logging
+import sys
+import time
+
+from .client import HttpMatcherClient
+from .topology import build_pipeline
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--format", required=True, help="formatter mini-DSL string")
+    ap.add_argument("--reporter-url", required=True, help="matcher /report endpoint")
+    ap.add_argument("--privacy", type=int, required=True)
+    ap.add_argument("--quantisation", type=int, required=True)
+    ap.add_argument("--flush-interval", type=int, default=300, help="seconds")
+    ap.add_argument("--source", required=True)
+    ap.add_argument("--output", required=True, help="dir, http(s) url, or s3://bucket")
+    ap.add_argument("--mode", default="auto")
+    ap.add_argument("--reports", default="0,1", help="report levels csv")
+    ap.add_argument("--transitions", default="0,1", help="transition levels csv")
+    ap.add_argument("--microbatch", type=int, default=16)
+    ap.add_argument("--bootstrap", default=None, help="kafka bootstrap servers")
+    ap.add_argument("--topic", default="raw")
+    ap.add_argument("--duration", type=float, default=None, help="seconds to run")
+    args = ap.parse_args(argv)
+
+    logging.basicConfig(
+        level=logging.INFO, format="%(asctime)s %(name)s %(levelname)s %(message)s"
+    )
+
+    pipeline = build_pipeline(
+        format_config=args.format,
+        client=HttpMatcherClient(args.reporter_url),
+        privacy=args.privacy,
+        quantisation=args.quantisation,
+        output=args.output,
+        source=args.source,
+        mode=args.mode,
+        report_levels=[int(x) for x in args.reports.split(",") if x != ""],
+        transition_levels=[int(x) for x in args.transitions.split(",") if x != ""],
+        flush_interval_sec=args.flush_interval,
+        microbatch_size=args.microbatch,
+    )
+
+    if args.bootstrap:
+        from .kafka_io import run_pipeline
+
+        run_pipeline(
+            pipeline, args.topic, args.bootstrap, duration_sec=args.duration
+        )
+    else:
+        start = time.time()
+        for line in sys.stdin:
+            pipeline.feed(line.rstrip("\n"), int(time.time() * 1000))
+            if args.duration is not None and time.time() - start > args.duration:
+                break
+        pipeline.close(int(time.time() * 1000))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,117 @@
+"""Matcher clients: how the streaming tier reaches the matching service.
+
+The reference POSTs each ready batch to the Python service one trace at a
+time (Batch.java:68, HttpClient.java:65-103, budget: 1 s connect / 10 s
+socket, 3 retries).  Here the client interface is batched -- ``report_many``
+takes all currently-ready batches and returns one response per request -- so
+the device sees [B, T] micro-batches.  Two implementations:
+
+  HttpMatcherClient  -- wire-parity: POST /report per trace, or one
+                        POST /trace_attributes_batch for a micro-batch
+  LocalMatcherClient -- in-process SegmentMatcher + report(), no HTTP;
+                        the embedding used by tests and single-box deploys
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import time
+import urllib.error
+import urllib.request
+from typing import List, Optional, Sequence
+
+log = logging.getLogger(__name__)
+
+RETRIES = 3
+TIMEOUT_SEC = 10.0
+
+
+def _post_json(url: str, payload: dict, timeout: float = TIMEOUT_SEC) -> Optional[dict]:
+    body = json.dumps(payload, separators=(",", ":")).encode("utf-8")
+    req = urllib.request.Request(
+        url, data=body, headers={"Content-Type": "application/json"}, method="POST"
+    )
+    last: Optional[Exception] = None
+    for attempt in range(RETRIES):
+        if attempt:
+            time.sleep(0.2 * attempt)
+        try:
+            with urllib.request.urlopen(req, timeout=timeout) as resp:
+                return json.loads(resp.read().decode("utf-8"))
+        except urllib.error.HTTPError as e:
+            if 400 <= e.code < 500:
+                log.error("matcher rejected request: %s", e)
+                return None
+            last = e
+        except Exception as e:
+            last = e
+    log.error("matcher unreachable after %d attempts: %s", RETRIES, last)
+    return None
+
+
+class HttpMatcherClient:
+    def __init__(self, url: str, batch_url: Optional[str] = None):
+        """url: the /report endpoint.  batch_url: /trace_attributes_batch;
+        derived from url when not given."""
+        self.url = url
+        if batch_url is None and url.endswith("/report"):
+            batch_url = url[: -len("/report")] + "/trace_attributes_batch"
+        self.batch_url = batch_url
+
+    def report_one(self, request: dict) -> Optional[dict]:
+        return _post_json(self.url, request)
+
+    def report_many(self, requests: Sequence[dict]) -> List[Optional[dict]]:
+        if not requests:
+            return []
+        if self.batch_url is None or len(requests) == 1:
+            return [self.report_one(r) for r in requests]
+        resp = _post_json(self.batch_url, {"traces": list(requests)})
+        if resp is None or "results" not in resp:
+            return [None] * len(requests)
+        results = resp["results"]
+        if len(results) != len(requests):
+            log.error(
+                "batch response has %d results for %d requests", len(results), len(requests)
+            )
+            return [None] * len(requests)
+        return results
+
+
+class LocalMatcherClient:
+    """Calls the matcher + report() in-process (no HTTP hop)."""
+
+    def __init__(self, matcher, threshold_sec: int = 15, mode: str = "auto"):
+        from ..report.reporter import report as _report
+
+        self.matcher = matcher
+        self.threshold_sec = threshold_sec
+        self.mode = mode
+        self._report = _report
+
+    def _levels(self, request: dict):
+        opts = request.get("match_options", {})
+        return (
+            set(opts.get("report_levels", [0, 1])),
+            set(opts.get("transition_levels", [0, 1])),
+        )
+
+    def report_one(self, request: dict) -> Optional[dict]:
+        return self.report_many([request])[0]
+
+    def report_many(self, requests: Sequence[dict]) -> List[Optional[dict]]:
+        if not requests:
+            return []
+        matches = self.matcher.match_many(list(requests))
+        out: List[Optional[dict]] = []
+        for request, match in zip(requests, matches):
+            rl, tl = self._levels(request)
+            try:
+                out.append(
+                    self._report(match, request, self.threshold_sec, rl, tl, self.mode)
+                )
+            except Exception as e:  # a bad trace must not poison the pool
+                log.error("report() failed for %s: %s", request.get("uuid"), e)
+                out.append(None)
+        return out

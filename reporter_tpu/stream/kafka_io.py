@@ -1,0 +1,125 @@
+"""Kafka transport, kept behind an import guard.
+
+Equivalents of the reference's Kafka-facing pieces:
+  - ``produce_file``: cat_to_kafka.py -- pipe a file/stdin into a topic with
+    user-supplied key/value/filter expressions (lambda source strings,
+    cat_to_kafka.py:38-40)
+  - ``run_pipeline``: the consumer side of Reporter.java's topology -- drive
+    a StreamPipeline from a raw topic
+  - ``print_topic``: PrintConsumer.java debug helper
+
+kafka-python is optional; every entry point raises a clear error when it is
+missing so the rest of the framework works without it.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Callable, Iterable, Optional
+
+log = logging.getLogger(__name__)
+
+
+def _require_kafka():
+    try:
+        import kafka  # type: ignore
+
+        return kafka
+    except ImportError as e:
+        raise RuntimeError(
+            "kafka-python is not installed; the Kafka transport is unavailable "
+            "(the in-process StreamPipeline and the batch pipeline do not need it)"
+        ) from e
+
+
+def compile_lambda(source: Optional[str], default: Callable) -> Callable:
+    """User-supplied record accessors, e.g. "lambda line: line.split('|')[1]"
+    (cat_to_kafka.py:38-40; same power, but via eval of a lambda expression
+    only)."""
+    if not source:
+        return default
+    fn = eval(source, {"__builtins__": __builtins__}, {})  # noqa: S307
+    if not callable(fn):
+        raise ValueError("expected a lambda expression, got %r" % (source,))
+    return fn
+
+
+def produce_file(
+    lines: Iterable[str],
+    topic: str,
+    bootstrap: str,
+    key_with: Optional[str] = None,
+    value_with: Optional[str] = None,
+    send_if: Optional[str] = None,
+    log_every: int = 10000,
+) -> int:
+    kafka = _require_kafka()
+    producer = kafka.KafkaProducer(bootstrap_servers=bootstrap)
+    keyer = compile_lambda(key_with, lambda line: None)
+    valuer = compile_lambda(value_with, lambda line: line)
+    sender = compile_lambda(send_if, lambda line: True)
+    produced = 0
+    for line in lines:
+        line = line.rstrip("\n")
+        if not sender(line):
+            continue
+        key = keyer(line)
+        producer.send(
+            topic,
+            key=key.encode() if isinstance(key, str) else key,
+            value=valuer(line).encode(),
+        )
+        produced += 1
+        if produced % log_every == 0:
+            log.info("produced %d messages", produced)
+    producer.flush()
+    return produced
+
+
+def run_pipeline(
+    pipeline,
+    topic: str,
+    bootstrap: str,
+    group: str = "reporter-tpu",
+    duration_sec: Optional[float] = None,
+    tick_sec: float = 30.0,
+) -> None:
+    """Consume a raw topic and drive the StreamPipeline until duration (or
+    forever)."""
+    kafka = _require_kafka()
+    consumer = kafka.KafkaConsumer(
+        topic,
+        bootstrap_servers=bootstrap,
+        group_id=group,
+        value_deserializer=lambda b: b.decode("utf-8", "replace"),
+        # bounded poll so ticks fire on an idle topic (the reference's
+        # punctuate is wall-clock driven, not message driven)
+        consumer_timeout_ms=int(tick_sec * 1000),
+    )
+    start = time.time()
+    last_tick = start
+    while True:
+        for msg in consumer:
+            ts_ms = msg.timestamp if msg.timestamp and msg.timestamp > 0 else int(
+                time.time() * 1000
+            )
+            pipeline.feed(msg.value, ts_ms)
+            if time.time() - last_tick >= tick_sec:
+                break
+        now = time.time()
+        if now - last_tick >= tick_sec:
+            pipeline.tick(int(now * 1000))
+            last_tick = now
+        if duration_sec is not None and now - start > duration_sec:
+            break
+    pipeline.close(int(time.time() * 1000))
+
+
+def print_topic(topic: str, bootstrap: str, limit: Optional[int] = None) -> None:
+    kafka = _require_kafka()
+    consumer = kafka.KafkaConsumer(topic, bootstrap_servers=bootstrap)
+    for i, msg in enumerate(consumer):
+        print("%s %s" % (msg.key, msg.value))
+        if limit is not None and i + 1 >= limit:
+            break

@@ -1,0 +1,366 @@
+"""Streaming stack: formatter DSL, serdes, windowing, anonymiser, and the
+end-to-end pipeline against the in-process TPU matcher."""
+
+import glob
+import os
+
+import numpy as np
+import pytest
+
+from reporter_tpu.stream.anonymiser import AnonymisingProcessor, cull, quantised_tiles
+from reporter_tpu.stream.batch import Batch, equirectangular_m
+from reporter_tpu.stream.batcher import BatchingProcessor
+from reporter_tpu.stream.client import LocalMatcherClient
+from reporter_tpu.stream.formatter import Formatter, joda_to_strptime
+from reporter_tpu.stream.point import Point
+from reporter_tpu.stream.segment import (
+    INVALID_SEGMENT_ID,
+    Segment,
+    pack_list,
+    unpack_list,
+)
+from reporter_tpu.stream.topology import StreamPipeline, build_pipeline
+
+
+# -- Point ---------------------------------------------------------------
+
+
+def test_point_serde_roundtrip():
+    p = Point(3.465725, -76.5135033, 51, 1495037969)
+    data = p.pack()
+    assert len(data) == 20
+    q = Point.unpack(data)
+    assert q.accuracy == 51 and q.time == 1495037969
+    assert q.lat == pytest.approx(3.465725, abs=1e-5)
+    assert q.lon == pytest.approx(-76.5135033, abs=1e-4)
+
+
+def test_point_json():
+    assert Point(0.0, 0.0, 7, 10).to_json() == '{"lat":0,"lon":0,"time":10,"accuracy":7}'
+    assert (
+        Point(1.5, -2.25, 3, 4).to_json()
+        == '{"lat":1.5,"lon":-2.25,"time":4,"accuracy":3}'
+    )
+
+
+# -- Formatter (reference FormatterTest.java parity) ----------------------
+
+
+def test_formatter_sv():
+    f = Formatter.from_config(",sv,\\|,1,9,10,0,5,yyyy-MM-dd HH:mm:ss")
+    uuid, p = f.format("2017-01-01 06:05:40|w00t||||6.5||||0.0|0.0")
+    assert uuid == "w00t"
+    assert p.accuracy == 7  # 6.5 ceiled
+    assert p.time == 1483250740
+    assert p.lat == 0.0 and p.lon == 0.0
+
+
+def test_formatter_json():
+    f = Formatter.from_config("@json@id@la@lo@t@a@yyyy-MM-dd HH:mm:ss")
+    uuid, p = f.format(
+        '{"t":"2017-01-01 06:05:40","id":"w00t","la":0.0,"lo":0.0,"a":6.5}'
+    )
+    assert uuid == "w00t" and p.accuracy == 7 and p.time == 1483250740
+
+
+def test_formatter_json_epoch():
+    f = Formatter.from_config("@json@id@latitude@longitude@timestamp@accuracy")
+    uuid, p = f.format(
+        '{"timestamp":1495037969,"id":"abc","accuracy":51.305,'
+        '"latitude":3.465725,"longitude":-76.5135033}'
+    )
+    assert uuid == "abc" and p.accuracy == 52 and p.time == 1495037969
+
+
+def test_formatter_bogus():
+    for bogus in ("%sv%,%a", "%json%a%b%c%d", "bogus_formatter"):
+        with pytest.raises(Exception):
+            Formatter.from_config(bogus)
+
+
+def test_joda_conversion():
+    assert joda_to_strptime("yyyy-MM-dd HH:mm:ss") == "%Y-%m-%d %H:%M:%S"
+    with pytest.raises(ValueError):
+        joda_to_strptime("QQQ")
+
+
+# -- Segment --------------------------------------------------------------
+
+
+def test_segment_serde_and_csv():
+    s = Segment(id=0b1010_001, next_id=None, min=100.2, max=163.7, length=120, queue=5)
+    data = s.pack()
+    assert len(data) == 40
+    t = Segment.unpack(data)
+    assert t.id == s.id and t.next_id == INVALID_SEGMENT_ID
+    assert t.min == pytest.approx(100.2) and t.max == pytest.approx(163.7)
+    # csv: duration rounded, min floored / max ceiled, empty next_id
+    row = t.csv_row("AUTO", "SRC")
+    assert row == "81,,63,1,120,5,100,164,SRC,AUTO"
+    lst = unpack_list(pack_list([s, t]))
+    assert len(lst) == 2 and lst[1].length == 120
+
+
+def test_segment_validity_and_tile():
+    good = Segment(id=(42 << 25) | (7 << 3) | 1, next_id=3, min=1.0, max=2.0, length=5, queue=0)
+    assert good.valid()
+    assert good.tile_id() == (7 << 3) | 1
+    assert not Segment(id=1, next_id=None, min=0, max=2.0, length=5, queue=0).valid()
+    assert not Segment(id=1, next_id=None, min=3.0, max=2.0, length=5, queue=0).valid()
+    assert not Segment(id=1, next_id=None, min=1.0, max=2.0, length=0, queue=0).valid()
+    assert not Segment(id=1, next_id=None, min=1.0, max=2.0, length=5, queue=-1).valid()
+
+
+# -- Batch ----------------------------------------------------------------
+
+
+def _pt(lat, lon, t):
+    return Point(lat, lon, 5, t)
+
+
+def test_batch_separation_and_gate():
+    b = Batch(_pt(0.0, 0.0, 0))
+    b.update(_pt(0.0, 0.005, 30))  # ~557 m east at the equator
+    assert b.max_separation == pytest.approx(556.6, rel=0.01)
+    assert not b.meets(500, 10, 60)  # too few points, too little time
+    for i in range(2, 11):
+        b.update(_pt(0.0, 0.005, i * 30))
+    assert b.meets(500, 10, 60)
+
+
+def test_batch_serde_roundtrip():
+    b = Batch(_pt(1.0, 2.0, 3))
+    b.update(_pt(1.1, 2.1, 4))
+    b.last_update = 99
+    c = Batch.unpack(b.pack())
+    assert len(c.points) == 2 and c.last_update == 99
+    assert c.max_separation == pytest.approx(b.max_separation)
+
+
+def test_batch_trim_on_shape_used():
+    b = Batch(_pt(0.0, 0.0, 0))
+    for i in range(1, 5):
+        b.update(_pt(0.0, 0.001 * i, i))
+    b.apply_response({"shape_used": 3})
+    assert len(b.points) == 2
+    assert b.points[0].time == 3
+    # separation recomputed over the survivors
+    assert b.max_separation == pytest.approx(
+        equirectangular_m(b.points[1], b.points[0])
+    )
+    # unusable response clears everything
+    b.apply_response(None)
+    assert not b.points and b.max_separation == 0.0
+
+
+# -- BatchingProcessor -----------------------------------------------------
+
+
+class FakeClient:
+    """Consumes every trace fully, reporting one fixed segment pair."""
+
+    def __init__(self):
+        self.requests = []
+
+    def report_many(self, requests):
+        self.requests.extend(requests)
+        out = []
+        for r in requests:
+            n = len(r["trace"])
+            out.append(
+                {
+                    "shape_used": n,
+                    "datastore": {
+                        "reports": [
+                            {
+                                "id": 8,
+                                "next_id": 16,
+                                "t0": r["trace"][0]["time"],
+                                "t1": r["trace"][-1]["time"],
+                                "length": 100,
+                                "queue_length": 0,
+                            }
+                        ]
+                    },
+                }
+            )
+        return out
+
+    def report_one(self, request):
+        return self.report_many([request])[0]
+
+
+def test_batcher_reports_and_trims():
+    client = FakeClient()
+    forwarded = []
+    bp = BatchingProcessor(
+        client, lambda k, s: forwarded.append((k, s)), report_dist=100,
+        report_count=5, report_time=30, microbatch_size=1,
+    )
+    t0 = 1_483_250_000
+    for i in range(5):
+        bp.process("veh-1", _pt(0.0, 0.001 * i, t0 + i * 10), (t0 + i * 10) * 1000)
+    # 5 points, 40s, ~445m -> gate passed at the 5th point, flushed, trimmed
+    assert len(client.requests) == 1
+    assert [k for k, _ in forwarded] == ["8 16"]
+    assert forwarded[0][1].valid()
+    assert "veh-1" not in bp.store  # fully consumed
+
+
+def test_batcher_eviction_relaxed():
+    client = FakeClient()
+    forwarded = []
+    bp = BatchingProcessor(client, lambda k, s: forwarded.append((k, s)))
+    t0 = 1_483_250_000
+    bp.process("veh-2", _pt(0.0, 0.0, t0), t0 * 1000)
+    bp.process("veh-2", _pt(0.0, 0.0004, t0 + 5), (t0 + 5) * 1000)
+    # nowhere near the normal gate; 2 points qualifies for the relaxed one
+    bp.punctuate((t0 + 5) * 1000 + bp.session_gap_ms + 1)
+    assert len(client.requests) == 1
+    assert "veh-2" not in bp.store
+    assert forwarded
+
+
+def test_batcher_single_point_evicted_silently():
+    client = FakeClient()
+    bp = BatchingProcessor(client, lambda k, s: None)
+    bp.process("veh-3", _pt(0.0, 0.0, 100), 100_000)
+    bp.punctuate(100_000 + bp.session_gap_ms + 1)
+    assert not client.requests and "veh-3" not in bp.store
+
+
+def test_batcher_microbatch_pools():
+    client = FakeClient()
+    bp = BatchingProcessor(
+        client, lambda k, s: None, report_dist=50, report_count=2, report_time=0,
+        microbatch_size=8,
+    )
+    t0 = 1_483_250_000
+    for v in range(3):
+        bp.process("veh-%d" % v, _pt(0.0, 0.0, t0), t0 * 1000)
+        bp.process("veh-%d" % v, _pt(0.0, 0.001, t0 + 10), (t0 + 10) * 1000)
+    assert not client.requests  # pooled, not yet flushed
+    bp.flush_ready()
+    assert len(client.requests) == 3  # one micro-batch of three traces
+
+
+# -- Anonymiser ------------------------------------------------------------
+
+
+def _seg(sid, nid, t0, t1):
+    return Segment(id=sid, next_id=nid, min=t0, max=t1, length=100, queue=0)
+
+
+def test_quantised_tiles_span():
+    s = _seg(8, 16, 3590.0, 3610.0)
+    tiles = quantised_tiles(s, 3600)
+    assert tiles == [(0, 8 & 0x1FFFFFF), (3600, 8 & 0x1FFFFFF)]
+
+
+def test_cull_trailing_group():
+    # the reference's in-place cull keeps a trailing under-count group that
+    # follows a passing one (AnonymisingProcessor.java:155-175); ours must not
+    rows = sorted(
+        [_seg(1, 2, 10, 20), _seg(1, 2, 11, 21), _seg(3, 4, 12, 22)],
+        key=Segment.sort_key,
+    )
+    kept = cull(rows, 2)
+    assert len(kept) == 2 and all(s.id == 1 for s in kept)
+
+
+def test_anonymiser_flush(tmp_path):
+    out = str(tmp_path / "tiles")
+    ap = AnonymisingProcessor(
+        privacy=2, quantisation=3600, output=out, source="TEST", mode="auto"
+    )
+    for i in range(3):
+        ap.process("8 16", _seg(8, 16, 7200 + i, 7230 + i))
+    ap.process("24 -", _seg(24, None, 7200, 7230))  # lone observation: culled
+    ap.punctuate()
+    files = glob.glob(os.path.join(out, "*", "*", "*", "*"))
+    assert len(files) == 1
+    body = open(files[0]).read()
+    lines = body.strip().split("\n")
+    assert lines[0] == Segment.column_layout()
+    assert len(lines) == 4  # header + 3 surviving observations
+    assert all(line.split(",")[9] == "AUTO" for line in lines[1:])
+    # path layout {start}_{end}/{level}/{index}/{source}.{uuid}
+    rel = os.path.relpath(files[0], out).split(os.sep)
+    assert rel[0] == "7200_10799"
+    assert rel[1] == str(8 & 0x7) and rel[2] == str((8 >> 3) & 0x3FFFFF)
+    assert rel[3].startswith("TEST.")
+
+
+def test_anonymiser_slicing():
+    ap = AnonymisingProcessor(
+        privacy=1, quantisation=3600, output="unused", source="S",
+        store=type("N", (), {"put": lambda self, k, b: None})(), slice_size=2,
+    )
+    for i in range(5):
+        ap.process("k", _seg(8, 16, 100 + i, 110 + i))
+    # 5 observations with slice_size 2 -> slices 0,1 full + slice 2 current
+    assert ap.map[(0, 8 & 0x1FFFFFF)] == 2
+    assert sum(len(v) for v in ap.slices.values()) == 5
+
+
+def test_anonymiser_validation():
+    with pytest.raises(ValueError):
+        AnonymisingProcessor(privacy=0, quantisation=3600, output="x", source="s")
+    with pytest.raises(ValueError):
+        AnonymisingProcessor(privacy=1, quantisation=30, output="x", source="s")
+
+
+# -- end to end: raw SV lines -> tiles ------------------------------------
+
+
+@pytest.fixture(scope="module")
+def grid_matcher():
+    from reporter_tpu.matching import MatcherConfig, SegmentMatcher
+    from reporter_tpu.tiles.network import grid_city
+
+    cfg = MatcherConfig()
+    city = grid_city(rows=5, cols=5, spacing_m=150.0)
+    return SegmentMatcher(network=city, config=cfg, backend="jax")
+
+
+def test_stream_end_to_end(grid_matcher, tmp_path):
+    from reporter_tpu.synth.generator import TraceSynthesizer
+
+    out = str(tmp_path / "results")
+    client = LocalMatcherClient(grid_matcher, threshold_sec=15)
+    pipeline = build_pipeline(
+        format_config=",sv,\\|,0,1,2,3,4",
+        client=client,
+        privacy=1,
+        quantisation=3600,
+        output=out,
+        source="CI",
+        report_levels=(0, 1, 2),
+        transition_levels=(0, 1, 2),
+        microbatch_size=4,
+    )
+    # loosen the report gate to the scale of the 5x5 test grid
+    pipeline.batcher.report_dist = 200
+    pipeline.batcher.report_count = 8
+    pipeline.batcher.report_time = 30
+
+    synth = TraceSynthesizer(grid_matcher.arrays, seed=7)
+    for v in range(3):
+        st = synth.synthesize(24, dt=15.0, sigma=3.0, uuid="veh-%d" % v)
+        for pt in st.trace["trace"]:
+            line = "veh-%d|%.7f|%.7f|%d|%d" % (
+                v, pt["lat"], pt["lon"], int(pt["time"]), pt["accuracy"]
+            )
+            pipeline.feed(line, int(pt["time"] * 1000))
+    pipeline.close()
+
+    assert pipeline.formatted == 72 and pipeline.dropped == 0
+    assert pipeline.batcher.reported_pairs > 0
+    files = glob.glob(os.path.join(out, "*", "*", "*", "*"))
+    assert files, "no tiles written"
+    rows = 0
+    for f in files:
+        lines = open(f).read().strip().split("\n")
+        assert lines[0] == Segment.column_layout()
+        rows += len(lines) - 1
+    assert rows >= pipeline.batcher.reported_pairs  # buckets may duplicate
