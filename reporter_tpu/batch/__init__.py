@@ -1,0 +1,19 @@
+"""Batch (historical backfill) pipeline.
+
+The reference's simple_reporter.py: three resumable phases over archived
+probe data -- gather traces, match them to OSMLR segments, anonymise and
+upload time tiles.  Same phases and on-disk formats here, but phase 2 feeds
+the device length-bucketed [B, T] micro-batches through
+``SegmentMatcher.match_many`` instead of one serial C++ Match() per trace --
+the device replaces the reference's per-process matcher fan-out.
+"""
+
+from .pipeline import (
+    get_traces,
+    make_matches,
+    report_tiles,
+    run_pipeline,
+    split,
+)
+
+__all__ = ["get_traces", "make_matches", "report_tiles", "run_pipeline", "split"]

@@ -1,0 +1,517 @@
+"""The three batch phases (behavioral port of py/simple_reporter.py).
+
+Phase 1  get_traces   -- crawl an archive (local dir, or S3 when boto3 is
+                         importable), parse each record with a user valuer,
+                         bbox-filter, and append to uuid-hash shard files
+                         (3 hex chars of sha1, simple_reporter.py:116) so one
+                         vehicle's points land in one file.  Fans out over
+                         ``concurrency`` processes on hash-partitioned key
+                         lists (split(), simple_reporter.py:70-79).
+Phase 2  make_matches -- per shard file: group by uuid, sort by time, split
+                         traces at inactivity gaps (>120 s default,
+                         simple_reporter.py:149-163), then match ALL windows
+                         of the file in pooled [B, T] device micro-batches,
+                         run report(), keep usable segments, and fan them
+                         across quantised time buckets into tile files
+                         (simple_reporter.py:176-196).  One process drives
+                         the device; batching replaces process fan-out.
+Phase 3  report_tiles -- sort each tile file, cull segment pairs seen fewer
+                         than ``privacy`` times, upload CSV with header
+                         (simple_reporter.py:211-254).
+
+Resumable exactly like the reference: pass trace_dir to skip phase 1,
+match_dir to skip phases 1+2 (simple_reporter.py:350-363).
+
+Deviation (deliberate): the privacy cull groups correctly; the reference's
+in-place range cull merges a trailing under-count group into a passing
+predecessor (simple_reporter.py:220-239) -- a privacy leak not replicated.
+"""
+
+from __future__ import annotations
+
+import calendar
+import functools
+import glob
+import gzip
+import hashlib
+import logging
+import math
+import multiprocessing
+import os
+import re
+import tempfile
+import time
+import uuid as uuidlib
+from typing import Callable, Iterable, List, Optional, Sequence, Tuple
+
+from ..anonymise.storage import make_store
+from ..anonymise.tiles import (
+    CSV_HEADER,
+    SegmentObservation,
+    observations_for_report,
+    privacy_cull,
+    usable_report,
+)
+from ..report.reporter import report as report_fn
+
+log = logging.getLogger("reporter_tpu.batch")
+
+DEFAULT_VALUER = (
+    'lambda l: (lambda c: (c[1], c[0], c[9], c[10], c[5]))(l.split("|"))'
+)
+
+
+def split(items: Sequence, n: int) -> List[List]:
+    """Balanced n-way split, same contract as simple_reporter.py:70-79."""
+    items = list(items)
+    size = int(math.ceil(len(items) / float(n)))
+    cutoff = len(items) % n
+    result = []
+    pos = 0
+    for i in range(n):
+        end = pos + size if cutoff == 0 or i < cutoff else pos + size - 1
+        result.append(items[pos:end])
+        pos = end
+    return result
+
+
+def compile_valuer(source: Optional[str]) -> Callable:
+    """The record-extraction lambda: line -> (uuid, time, lat, lon, accuracy)
+    (simple_reporter.py:337,357 -- same power, eval of an expression only)."""
+    fn = eval(source or DEFAULT_VALUER, {"functools": functools}, {})  # noqa: S307
+    if not callable(fn):
+        raise ValueError("valuer must be a lambda expression")
+    return fn
+
+
+# -- archives --------------------------------------------------------------
+
+
+class LocalArchive:
+    """A directory (or glob) of probe files, possibly gzipped."""
+
+    def __init__(self, path: str):
+        self.path = path
+
+    def keys(self, prefix: str = "", key_regex: str = ".*") -> List[str]:
+        pat = re.compile(key_regex)
+        root = os.path.join(self.path, prefix) if prefix else self.path
+        found = []
+        for r, _dirs, files in os.walk(root):
+            for f in files:
+                full = os.path.join(r, f)
+                rel = os.path.relpath(full, self.path)
+                if pat.match(rel):
+                    found.append(rel)
+        return sorted(found)
+
+    def open(self, key: str):
+        full = os.path.join(self.path, key)
+        if key.endswith(".gz"):
+            return gzip.open(full, "rt")
+        return open(full, "r")
+
+
+class S3Archive:
+    """boto3-gated S3 source (simple_reporter.py:256-276)."""
+
+    def __init__(self, bucket: str):
+        try:
+            import boto3  # type: ignore
+        except ImportError as e:
+            raise RuntimeError(
+                "boto3 is not installed; use a local archive directory instead"
+            ) from e
+        self.bucket = bucket
+        self._client = boto3.session.Session().client("s3")
+
+    def keys(self, prefix: str = "", key_regex: str = ".*") -> List[str]:
+        pat = re.compile(key_regex)
+        keys: List[str] = []
+        token = None
+        while True:
+            kw = {"Bucket": self.bucket, "Prefix": prefix}
+            if token:
+                kw["ContinuationToken"] = token
+            objects = self._client.list_objects_v2(**kw)
+            keys.extend(o["Key"] for o in objects.get("Contents", []))
+            token = objects.get("NextContinuationToken")
+            if not token:
+                break
+        return [k for k in keys if pat.match(k)]
+
+    def open(self, key: str):
+        import io
+
+        body = self._client.get_object(Bucket=self.bucket, Key=key)["Body"].read()
+        if key.endswith(".gz"):
+            return io.TextIOWrapper(gzip.GzipFile(fileobj=io.BytesIO(body)))
+        return io.TextIOWrapper(io.BytesIO(body))
+
+
+def make_archive(spec: str):
+    if spec.startswith("s3://"):
+        return S3Archive(spec[5:].strip("/"))
+    return LocalArchive(spec)
+
+
+# -- phase 1: gather -------------------------------------------------------
+
+
+def _gather(archive_spec, keys, valuer_src, time_pattern, bbox, dest_dir):
+    archive = make_archive(archive_spec)
+    valuer = compile_valuer(valuer_src)
+    for key in keys:
+        try:
+            shards = {}
+            with archive.open(key) as f:
+                for line in f:
+                    uuid, tm, lat, lon, acc = valuer(line.rstrip("\n"))
+                    lat = float(lat)
+                    lon = float(lon)
+                    # bbox is [min_lat, min_lon, max_lat, max_lon]
+                    if lat < bbox[0] or lat > bbox[2] or lon < bbox[1] or lon > bbox[3]:
+                        continue
+                    if time_pattern:
+                        tm = calendar.timegm(time.strptime(str(tm), time_pattern))
+                    else:
+                        tm = int(tm)
+                    acc = min(int(math.ceil(float(acc))), 1000)
+                    shard = hashlib.sha1(str(uuid).encode()).hexdigest()[:3]
+                    shards.setdefault(shard, []).append(
+                        "%s,%d,%s,%s,%d\n" % (uuid, tm, lat, lon, acc)
+                    )
+            for shard, rows in shards.items():
+                with open(os.path.join(dest_dir, shard), "a") as sf:
+                    sf.write("".join(rows))
+            log.info("gathered traces from %s", key)
+        except (KeyboardInterrupt, SystemExit):
+            raise
+        except Exception as e:
+            log.error("%s was not processed: %s", key, e)
+
+
+def get_traces(
+    archive_spec: str,
+    prefix: str = "",
+    key_regex: str = ".*",
+    valuer: Optional[str] = None,
+    time_pattern: Optional[str] = "%Y-%m-%d %H:%M:%S",
+    bbox: Sequence[float] = (-90.0, -180.0, 90.0, 180.0),
+    concurrency: int = 1,
+    dest_dir: Optional[str] = None,
+) -> str:
+    """Phase 1: archive -> uuid-hash shard files.  Returns the shard dir."""
+    archive = make_archive(archive_spec)
+    keys = archive.keys(prefix, key_regex)
+    if dest_dir is None:
+        dest_dir = tempfile.mkdtemp(prefix="traces_")
+    os.makedirs(dest_dir, exist_ok=True)
+    log.info("gathering %d source files into %s", len(keys), dest_dir)
+    if concurrency <= 1 or len(keys) <= 1:
+        _gather(archive_spec, keys, valuer, time_pattern, list(bbox), dest_dir)
+    else:
+        # spawn, not fork: the driver process usually has JAX (and its thread
+        # pool) initialised, and forking a multithreaded process can deadlock
+        ctx = multiprocessing.get_context("spawn")
+        procs = []
+        for chunk in split(keys, concurrency):
+            p = ctx.Process(
+                target=_gather,
+                args=(archive_spec, chunk, valuer, time_pattern, list(bbox), dest_dir),
+            )
+            p.start()
+            procs.append(p)
+        if _join_checked(procs):
+            raise RuntimeError(
+                "one or more gather workers died; the shard set is incomplete"
+            )
+    log.info("done gathering traces")
+    return dest_dir
+
+
+# -- phase 2: match --------------------------------------------------------
+
+
+def _windows(points: List[dict], inactivity: float) -> Iterable[List[dict]]:
+    """Split a sorted point list at inactivity gaps; drop <2-point windows
+    (simple_reporter.py:149-163)."""
+    starts = [
+        i
+        for i, p in enumerate(points)
+        if i == 0 or p["time"] - points[i - 1]["time"] > inactivity
+    ]
+    for idx, i in enumerate(starts):
+        j = starts[idx + 1] if idx + 1 < len(starts) else len(points)
+        if j - i >= 2:
+            yield points[i:j]
+
+
+def make_matches(
+    trace_dir: str,
+    matcher,
+    mode: str = "auto",
+    report_levels=frozenset((0, 1)),
+    transition_levels=frozenset((0, 1)),
+    quantisation: int = 3600,
+    inactivity: float = 120.0,
+    source: str = "smpl_rprt",
+    threshold_sec: int = 15,
+    dest_dir: Optional[str] = None,
+    microbatch: int = 256,
+) -> str:
+    """Phase 2: shard files -> tile files of observation rows.
+
+    All windows of a shard file are matched in pooled device micro-batches
+    (up to ``microbatch`` traces per match_many call)."""
+    if dest_dir is None:
+        dest_dir = tempfile.mkdtemp(prefix="matches_")
+    os.makedirs(dest_dir, exist_ok=True)
+    file_names = sorted(
+        os.path.join(r, f) for r, _d, fs in os.walk(trace_dir) for f in fs
+    )
+    log.info("matching traces from %d files into %s", len(file_names), dest_dir)
+    report_levels = set(report_levels)
+    transition_levels = set(transition_levels)
+
+    for file_name in file_names:
+        traces: dict = {}
+        with open(file_name) as f:
+            for line in f:
+                # concurrent phase-1 appends can tear a row mid-line; a bad
+                # row must not abort the whole phase
+                try:
+                    uuid, tm, lat, lon, acc = line.strip().split(",")
+                    traces.setdefault(uuid, []).append(
+                        {
+                            "lat": float(lat),
+                            "lon": float(lon),
+                            "time": int(tm),
+                            "accuracy": int(acc),
+                        }
+                    )
+                except ValueError:
+                    log.warning("skipping malformed row in %s: %r", file_name, line[:80])
+
+        # build every match request up front; competing phase-1 appends are
+        # repaired by the sort (simple_reporter.py:145-146)
+        requests = []
+        for uuid, points in traces.items():
+            points.sort(key=lambda v: v["time"])
+            for window in _windows(points, inactivity):
+                requests.append(
+                    {"uuid": uuid, "trace": window, "match_options": {"mode": mode}}
+                )
+
+        tiles: dict = {}
+        matched = 0
+        for lo in range(0, len(requests), microbatch):
+            chunk = requests[lo : lo + microbatch]
+            try:
+                matches = matcher.match_many(chunk)
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except Exception as e:
+                log.error("match micro-batch failed in %s: %s", file_name, e)
+                continue
+            for request, match in zip(chunk, matches):
+                try:
+                    rep = report_fn(
+                        match, request, threshold_sec, report_levels, transition_levels, mode
+                    )
+                except (KeyboardInterrupt, SystemExit):
+                    raise
+                except Exception:
+                    log.error(
+                        "failed to report trace with uuid %s from file %s",
+                        request["uuid"], file_name,
+                    )
+                    continue
+                matched += 1
+                _bucket_reports(
+                    rep, request, quantisation, source, mode, tiles, file_name
+                )
+
+        for tile_file, rows in tiles.items():
+            path = os.path.join(dest_dir, tile_file)
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            with open(path, "a") as f:
+                f.write("".join(rows))
+        log.info("finished matching %d windows in %s", matched, file_name)
+    log.info("done matching trace data files")
+    return dest_dir
+
+
+def _bucket_reports(rep, request, quantisation, source, mode, tiles, file_name):
+    """Fan one report()'s usable segments across quantised time buckets
+    (simple_reporter.py:176-196), via the shared tiling helpers so the batch
+    and streaming paths can't drift."""
+    points = request["trace"]
+    max_buckets = (points[-1]["time"] - points[0]["time"]) // quantisation + 1
+    for r in rep["datastore"]["reports"]:
+        if not usable_report(r):
+            continue
+        emitted = False
+        for tile, obs in observations_for_report(
+            r, quantisation, source, vehicle_type=mode.upper(), max_buckets=max_buckets
+        ):
+            tiles.setdefault(tile.path(quantisation), []).append(obs.csv_row() + "\n")
+            emitted = True
+        if not emitted:
+            log.error(
+                "segment spans more than %d buckets for uuid %s in %s",
+                max_buckets, request["uuid"], file_name,
+            )
+
+
+# -- phase 3: anonymise + upload ------------------------------------------
+
+
+def _cull_lines(lines: List[str], privacy: int) -> List[str]:
+    """Drop (segment_id, next_id) groups under the privacy count, via the
+    shared privacy_cull (grouping is exact; see module docstring re the
+    reference's trailing-group leak).  Unparseable rows are dropped."""
+    observations = []
+    for line in lines:
+        try:
+            observations.append(SegmentObservation.from_csv_row(line))
+        except Exception:
+            log.warning("dropping malformed tile row %r", line[:80])
+    kept = privacy_cull(observations, privacy)
+    return [o.csv_row() + "\n" for o in kept]
+
+
+def _report_files(match_dir, file_names, store_spec, privacy, fail_counter=None):
+    """Cull + upload a list of tile files.  Returns the number of failed
+    uploads (also added to ``fail_counter`` when given, for fan-out)."""
+    store = make_store(store_spec)
+    failures = 0
+    for file_name in file_names:
+        with open(file_name) as f:
+            lines = [l for l in f.readlines() if l.strip()]
+        kept = _cull_lines(lines, privacy)
+        if not kept:
+            log.info("no segments for %s after anonymising", file_name)
+            continue
+        rel = os.path.relpath(file_name, match_dir)
+        # a fresh suffix per run so overlapping backfills accumulate instead
+        # of overwriting (the stream anonymiser names tiles the same way)
+        key = rel.replace(os.sep, "/") + "/" + uuidlib.uuid4().hex
+        log.info("writing %d segments to %s", len(kept), key)
+        try:
+            store.put(key, CSV_HEADER + "\n" + "".join(kept))
+        except Exception as e:
+            failures += 1
+            log.error("failed to upload %s: %s", key, e)
+    if fail_counter is not None and failures:
+        with fail_counter.get_lock():
+            fail_counter.value += failures
+    return failures
+
+
+def report_tiles(
+    match_dir: str,
+    store_spec: str,
+    privacy: int = 2,
+    concurrency: int = 1,
+) -> int:
+    """Phase 3: cull + upload every tile file under match_dir.  Returns the
+    number of failed uploads (0 == everything shipped)."""
+    file_names = sorted(
+        os.path.join(r, f) for r, _d, fs in os.walk(match_dir) for f in fs
+    )
+    log.info("reporting %d anonymised time tiles", len(file_names))
+    if concurrency <= 1 or len(file_names) <= 1:
+        failures = _report_files(match_dir, file_names, store_spec, privacy)
+    else:
+        ctx = multiprocessing.get_context("spawn")  # see get_traces re fork+JAX
+        fail_counter = ctx.Value("i", 0)
+        procs = []
+        for chunk in split(file_names, concurrency):
+            p = ctx.Process(
+                target=_report_files,
+                args=(match_dir, chunk, store_spec, privacy, fail_counter),
+            )
+            p.start()
+            procs.append(p)
+        dead = _join_checked(procs)
+        failures = fail_counter.value + dead
+    log.info("done reporting tiles (%d upload failures)", failures)
+    return failures
+
+
+def _join_checked(procs) -> int:
+    """Join workers and count the ones that died abnormally -- a crashed
+    worker must not read as success."""
+    dead = 0
+    for p in procs:
+        p.join()
+        if p.exitcode != 0:
+            dead += 1
+            log.error("worker %s exited with code %s", p.name, p.exitcode)
+    return dead
+
+
+# -- driver ----------------------------------------------------------------
+
+
+def run_pipeline(
+    matcher,
+    archive_spec: Optional[str] = None,
+    dest_store: Optional[str] = None,
+    trace_dir: Optional[str] = None,
+    match_dir: Optional[str] = None,
+    cleanup: bool = True,
+    **kw,
+) -> Tuple[Optional[str], Optional[str]]:
+    """All three phases with the reference's resume semantics."""
+    phase1 = {
+        k: kw[k]
+        for k in ("prefix", "key_regex", "valuer", "time_pattern", "bbox", "concurrency")
+        if k in kw
+    }
+    phase2 = {
+        k: kw[k]
+        for k in (
+            "mode", "report_levels", "transition_levels", "quantisation",
+            "inactivity", "source", "threshold_sec", "microbatch",
+        )
+        if k in kw
+    }
+    made_traces = made_matches = False
+    if not trace_dir and not match_dir:
+        if not archive_spec:
+            raise ValueError("need an archive (or trace_dir/match_dir to resume)")
+        trace_dir = get_traces(archive_spec, **phase1)
+        made_traces = True
+    if not match_dir:
+        match_dir = make_matches(trace_dir, matcher, **phase2)
+        made_matches = True
+    failures = 0
+    uploaded = False
+    if dest_store:
+        failures = report_tiles(
+            match_dir, dest_store,
+            privacy=kw.get("privacy", 2),
+            concurrency=kw.get("concurrency", 1),
+        )
+        uploaded = failures == 0
+    if cleanup:
+        import shutil
+
+        # never destroy output that hasn't shipped: the match dir survives
+        # when there was no destination or any upload failed, so the run can
+        # resume with --match-dir
+        if made_traces and trace_dir and made_matches:
+            shutil.rmtree(trace_dir, ignore_errors=True)
+            trace_dir = None
+        if made_matches and match_dir and uploaded:
+            shutil.rmtree(match_dir, ignore_errors=True)
+            match_dir = None
+        if match_dir:
+            log.warning(
+                "keeping match dir %s (%s); resume phase 3 with --match-dir",
+                match_dir,
+                "no destination given" if not dest_store else "%d upload failures" % failures,
+            )
+    return trace_dir, match_dir
