@@ -1,0 +1,225 @@
+// reporter_tpu native core: binary tile codec + probe-record parser.
+//
+// The reference keeps its graph in Valhalla's native .gph tiles read by C++
+// (SURVEY.md L0/L5) and parses probe archives in its ingest hot loops
+// (simple_reporter.py download/match phases).  This library is the
+// TPU-native equivalent of that native tier: a dense, mmap-friendly tile
+// format whose arrays feed straight into device buffers, and a zero-copy
+// record parser for the shard files the batch pipeline reads.
+//
+// Exposed as a plain C ABI consumed through ctypes
+// (reporter_tpu/native/__init__.py); reporter_tpu/tiles/codec.py implements
+// the identical format in numpy as the fallback when no compiler is
+// available.  Keep the two in lockstep (tests diff them byte-for-byte).
+//
+// Tile format v1, little-endian:
+//   u32 magic 'RPTT' (0x54545052)  u32 version
+//   u32 n_nodes  u32 n_edges  u32 n_shape  u32 reserved
+//   f64 node_lat[n_nodes]  f64 node_lon[n_nodes]
+//   u32 edge_from[n_edges] u32 edge_to[n_edges]
+//   f32 speed_kph[n_edges] u8 level[n_edges]  u8 internal[n_edges]
+//   i64 segment_id[n_edges] (-1 = none)  i64 way_id[n_edges] (-1 = none)
+//   u32 shape_start[n_edges + 1]
+//   f64 shape_lat[n_shape]  f64 shape_lon[n_shape]
+
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+
+namespace {
+
+constexpr uint32_t kMagic = 0x54545052u;  // 'RPTT'
+constexpr uint32_t kVersion = 1;
+
+struct Header {
+  uint32_t magic;
+  uint32_t version;
+  uint32_t n_nodes;
+  uint32_t n_edges;
+  uint32_t n_shape;
+  uint32_t reserved;
+};
+
+bool write_all(FILE* f, const void* p, size_t n) {
+  return n == 0 || fwrite(p, 1, n, f) == n;
+}
+
+bool read_all(FILE* f, void* p, size_t n) {
+  return n == 0 || fread(p, 1, n, f) == n;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Returns 0 on success, negative errno-style codes on failure.
+int rn_tile_write(const char* path, uint32_t n_nodes, const double* node_lat,
+                  const double* node_lon, uint32_t n_edges,
+                  const uint32_t* edge_from, const uint32_t* edge_to,
+                  const float* speed_kph, const uint8_t* level,
+                  const uint8_t* internal_flag, const int64_t* segment_id,
+                  const int64_t* way_id, const uint32_t* shape_start,
+                  uint32_t n_shape, const double* shape_lat,
+                  const double* shape_lon) {
+  FILE* f = fopen(path, "wb");
+  if (!f) return -1;
+  Header h = {kMagic, kVersion, n_nodes, n_edges, n_shape, 0};
+  bool ok = write_all(f, &h, sizeof h) &&
+            write_all(f, node_lat, sizeof(double) * n_nodes) &&
+            write_all(f, node_lon, sizeof(double) * n_nodes) &&
+            write_all(f, edge_from, sizeof(uint32_t) * n_edges) &&
+            write_all(f, edge_to, sizeof(uint32_t) * n_edges) &&
+            write_all(f, speed_kph, sizeof(float) * n_edges) &&
+            write_all(f, level, n_edges) &&
+            write_all(f, internal_flag, n_edges) &&
+            write_all(f, segment_id, sizeof(int64_t) * n_edges) &&
+            write_all(f, way_id, sizeof(int64_t) * n_edges) &&
+            write_all(f, shape_start,
+                      n_edges ? sizeof(uint32_t) * (n_edges + 1) : 0) &&
+            write_all(f, shape_lat, sizeof(double) * n_shape) &&
+            write_all(f, shape_lon, sizeof(double) * n_shape);
+  if (fclose(f) != 0) ok = false;
+  return ok ? 0 : -2;
+}
+
+// out: [version, n_nodes, n_edges, n_shape].  0 on success.
+int rn_tile_header(const char* path, uint32_t* out) {
+  FILE* f = fopen(path, "rb");
+  if (!f) return -1;
+  Header h;
+  bool ok = read_all(f, &h, sizeof h) && h.magic == kMagic;
+  fclose(f);
+  if (!ok) return -2;
+  if (h.version != kVersion) return -3;
+  out[0] = h.version;
+  out[1] = h.n_nodes;
+  out[2] = h.n_edges;
+  out[3] = h.n_shape;
+  return 0;
+}
+
+// Caller sizes the arrays from rn_tile_header.  0 on success.
+int rn_tile_read(const char* path, double* node_lat, double* node_lon,
+                 uint32_t* edge_from, uint32_t* edge_to, float* speed_kph,
+                 uint8_t* level, uint8_t* internal_flag, int64_t* segment_id,
+                 int64_t* way_id, uint32_t* shape_start, double* shape_lat,
+                 double* shape_lon) {
+  FILE* f = fopen(path, "rb");
+  if (!f) return -1;
+  Header h;
+  bool ok = read_all(f, &h, sizeof h) && h.magic == kMagic &&
+            h.version == kVersion &&
+            read_all(f, node_lat, sizeof(double) * h.n_nodes) &&
+            read_all(f, node_lon, sizeof(double) * h.n_nodes) &&
+            read_all(f, edge_from, sizeof(uint32_t) * h.n_edges) &&
+            read_all(f, edge_to, sizeof(uint32_t) * h.n_edges) &&
+            read_all(f, speed_kph, sizeof(float) * h.n_edges) &&
+            read_all(f, level, h.n_edges) &&
+            read_all(f, internal_flag, h.n_edges) &&
+            read_all(f, segment_id, sizeof(int64_t) * h.n_edges) &&
+            read_all(f, way_id, sizeof(int64_t) * h.n_edges) &&
+            read_all(f, shape_start,
+                     h.n_edges ? sizeof(uint32_t) * (h.n_edges + 1) : 0) &&
+            read_all(f, shape_lat, sizeof(double) * h.n_shape) &&
+            read_all(f, shape_lon, sizeof(double) * h.n_shape);
+  fclose(f);
+  return ok ? 0 : -2;
+}
+
+// Parse shard rows "uuid,epoch,lat,lon,accuracy\n" (the phase-1 output
+// format, simple_reporter.py:116 analogue).  Malformed rows are skipped.
+// uuid_off/uuid_len index into buf.  Returns rows parsed (<= max_rows).
+static bool only_trailing_ws(const char* p) {
+  while (*p == ' ' || *p == '\t' || *p == '\r') p++;
+  return *p == 0;
+}
+
+int64_t rn_parse_shard(const char* buf, int64_t len, double* lat, double* lon,
+                       int64_t* tm, int32_t* acc, int64_t* uuid_off,
+                       int32_t* uuid_len, int64_t max_rows) {
+  int64_t rows = 0;
+  int64_t i = 0;
+  while (i < len && rows < max_rows) {
+    int64_t line_start = i;
+    int64_t raw_end = i;
+    while (raw_end < len && buf[raw_end] != '\n') raw_end++;
+    // tolerate CRLF and trailing whitespace, like the Python fallback's
+    // line.strip()
+    int64_t line_end = raw_end;
+    while (line_end > line_start &&
+           (buf[line_end - 1] == '\r' || buf[line_end - 1] == ' ' ||
+            buf[line_end - 1] == '\t'))
+      line_end--;
+
+    // split into 5 comma-separated fields
+    int64_t field_start[5];
+    int64_t field_len[5];
+    int nf = 0;
+    int64_t fs = line_start;
+    for (int64_t j = line_start; j <= line_end && nf < 5; ++j) {
+      if (j == line_end || buf[j] == ',') {
+        field_start[nf] = fs;
+        field_len[nf] = j - fs;
+        nf++;
+        fs = j + 1;
+      }
+    }
+    bool bad = (nf != 5) || (fs <= line_end);  // too few or too many fields
+    if (!bad) {
+      char tmp[64];
+      char* endp = nullptr;
+      // time
+      int64_t l = field_len[1];
+      if (l <= 0 || l >= 63) {
+        bad = true;
+      } else {
+        memcpy(tmp, buf + field_start[1], l);
+        tmp[l] = 0;
+        tm[rows] = strtoll(tmp, &endp, 10);
+        if (!only_trailing_ws(endp)) bad = true;
+      }
+      // lat / lon
+      for (int k = 2; k < 4 && !bad; ++k) {
+        l = field_len[k];
+        if (l <= 0 || l >= 63) {
+          bad = true;
+          break;
+        }
+        memcpy(tmp, buf + field_start[k], l);
+        tmp[l] = 0;
+        double v = strtod(tmp, &endp);
+        if (!only_trailing_ws(endp)) {
+          bad = true;
+        } else if (k == 2) {
+          lat[rows] = v;
+        } else {
+          lon[rows] = v;
+        }
+      }
+      // accuracy
+      if (!bad) {
+        l = field_len[4];
+        if (l <= 0 || l >= 63) {
+          bad = true;
+        } else {
+          memcpy(tmp, buf + field_start[4], l);
+          tmp[l] = 0;
+          acc[rows] = (int32_t)strtol(tmp, &endp, 10);
+          if (!only_trailing_ws(endp)) bad = true;
+        }
+      }
+      if (!bad && field_len[0] > 0) {
+        uuid_off[rows] = field_start[0];
+        uuid_len[rows] = (int32_t)field_len[0];
+        rows++;
+      }
+    }
+    i = raw_end + 1;
+  }
+  return rows;
+}
+
+uint32_t rn_abi_version(void) { return kVersion; }
+
+}  // extern "C"

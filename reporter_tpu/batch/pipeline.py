@@ -31,7 +31,6 @@ from __future__ import annotations
 
 import calendar
 import functools
-import glob
 import gzip
 import hashlib
 import logging
@@ -52,6 +51,7 @@ from ..anonymise.tiles import (
     privacy_cull,
     usable_report,
 )
+from ..native import parse_shard_bytes
 from ..report.reporter import report as report_fn
 
 log = logging.getLogger("reporter_tpu.batch")
@@ -275,23 +275,26 @@ def make_matches(
     transition_levels = set(transition_levels)
 
     for file_name in file_names:
+        # the native parser skips torn rows (concurrent phase-1 appends can
+        # tear a line mid-write); so does its Python fallback
+        with open(file_name, "rb") as f:
+            data = f.read()
+        uuids, tms, lats, lons, accs = parse_shard_bytes(data)
+        n_lines = data.count(b"\n") + (0 if data.endswith(b"\n") or not data else 1)
+        if len(uuids) < n_lines:
+            log.warning(
+                "skipped %d malformed row(s) in %s", n_lines - len(uuids), file_name
+            )
         traces: dict = {}
-        with open(file_name) as f:
-            for line in f:
-                # concurrent phase-1 appends can tear a row mid-line; a bad
-                # row must not abort the whole phase
-                try:
-                    uuid, tm, lat, lon, acc = line.strip().split(",")
-                    traces.setdefault(uuid, []).append(
-                        {
-                            "lat": float(lat),
-                            "lon": float(lon),
-                            "time": int(tm),
-                            "accuracy": int(acc),
-                        }
-                    )
-                except ValueError:
-                    log.warning("skipping malformed row in %s: %r", file_name, line[:80])
+        for i in range(len(uuids)):
+            traces.setdefault(uuids[i], []).append(
+                {
+                    "lat": float(lats[i]),
+                    "lon": float(lons[i]),
+                    "time": int(tms[i]),
+                    "accuracy": int(accs[i]),
+                }
+            )
 
         # build every match request up front; competing phase-1 appends are
         # repaired by the sort (simple_reporter.py:145-146)

@@ -1,0 +1,136 @@
+"""ctypes binding for the native core (native/reporter_native.cc).
+
+``get_lib()`` lazily compiles the shared library with g++ on first use and
+returns the loaded CDLL with argtypes configured, or None when no compiler
+is available -- every caller has a pure-Python fallback (the framework's
+native tier accelerates, never gates)."""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+log = logging.getLogger(__name__)
+
+_NATIVE_DIR = os.path.join(os.path.dirname(__file__), "..", "..", "native")
+_SRC = os.path.join(_NATIVE_DIR, "reporter_native.cc")
+_LIB = os.path.join(_NATIVE_DIR, "libreporter_native.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+_u32p = np.ctypeslib.ndpointer(np.uint32, flags="C_CONTIGUOUS")
+_f64p = np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS")
+_f32p = np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS")
+_u8p = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
+_i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
+_i32p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
+
+
+def _build() -> bool:
+    if not os.path.exists(_SRC):
+        return False
+    if os.path.exists(_LIB) and os.path.getmtime(_LIB) >= os.path.getmtime(_SRC):
+        return True
+    try:
+        subprocess.run(
+            ["g++", "-O3", "-fPIC", "-shared", "-std=c++17", "-Wall",
+             "-o", _LIB, _SRC],
+            check=True, capture_output=True, timeout=120,
+        )
+        return True
+    except Exception as e:
+        log.warning("native build failed, using Python fallbacks: %s", e)
+        return False
+
+
+def _configure(lib: ctypes.CDLL) -> ctypes.CDLL:
+    lib.rn_tile_write.restype = ctypes.c_int
+    lib.rn_tile_write.argtypes = [
+        ctypes.c_char_p, ctypes.c_uint32, _f64p, _f64p, ctypes.c_uint32,
+        _u32p, _u32p, _f32p, _u8p, _u8p, _i64p, _i64p, _u32p,
+        ctypes.c_uint32, _f64p, _f64p,
+    ]
+    lib.rn_tile_header.restype = ctypes.c_int
+    lib.rn_tile_header.argtypes = [ctypes.c_char_p, _u32p]
+    lib.rn_tile_read.restype = ctypes.c_int
+    lib.rn_tile_read.argtypes = [
+        ctypes.c_char_p, _f64p, _f64p, _u32p, _u32p, _f32p, _u8p, _u8p,
+        _i64p, _i64p, _u32p, _f64p, _f64p,
+    ]
+    lib.rn_parse_shard.restype = ctypes.c_int64
+    lib.rn_parse_shard.argtypes = [
+        ctypes.c_char_p, ctypes.c_int64, _f64p, _f64p, _i64p, _i32p,
+        _i64p, _i32p, ctypes.c_int64,
+    ]
+    lib.rn_abi_version.restype = ctypes.c_uint32
+    lib.rn_abi_version.argtypes = []
+    return lib
+
+
+def get_lib(force_rebuild: bool = False) -> Optional[ctypes.CDLL]:
+    global _lib, _tried
+    with _lock:
+        if _lib is not None and not force_rebuild:
+            return _lib
+        if _tried and not force_rebuild:
+            return _lib
+        _tried = True
+        if force_rebuild and os.path.exists(_LIB):
+            os.remove(_LIB)
+        if not _build():
+            return None
+        try:
+            _lib = _configure(ctypes.CDLL(_LIB))
+        except OSError as e:
+            log.warning("native library load failed: %s", e)
+            _lib = None
+        return _lib
+
+
+def parse_shard_bytes(data: bytes, lib=None):
+    """Parse shard rows 'uuid,epoch,lat,lon,acc' -> (uuids, time, lat, lon,
+    acc).  Native when available, numpy/python otherwise."""
+    if lib is None:
+        lib = get_lib()
+    n_lines = data.count(b"\n") + 1
+    if lib is not None:
+        lat = np.empty(n_lines, np.float64)
+        lon = np.empty(n_lines, np.float64)
+        tm = np.empty(n_lines, np.int64)
+        acc = np.empty(n_lines, np.int32)
+        uoff = np.empty(n_lines, np.int64)
+        ulen = np.empty(n_lines, np.int32)
+        n = lib.rn_parse_shard(data, len(data), lat, lon, tm, acc, uoff, ulen, n_lines)
+        uuids = [data[uoff[i] : uoff[i] + ulen[i]].decode() for i in range(n)]
+        return uuids, tm[:n].copy(), lat[:n].copy(), lon[:n].copy(), acc[:n].copy()
+    uuids, tms, lats, lons, accs = [], [], [], [], []
+    for line in data.decode().splitlines():
+        # parse the whole row before appending anything, so a row that fails
+        # on a late field can't leave the columns misaligned
+        try:
+            uuid, tm_, lat_, lon_, acc_ = line.strip().split(",")
+            if not uuid:
+                continue
+            row = (int(tm_), float(lat_), float(lon_), int(acc_))
+        except ValueError:
+            continue
+        uuids.append(uuid)
+        tms.append(row[0])
+        lats.append(row[1])
+        lons.append(row[2])
+        accs.append(row[3])
+    return (
+        uuids,
+        np.asarray(tms, np.int64),
+        np.asarray(lats, np.float64),
+        np.asarray(lons, np.float64),
+        np.asarray(accs, np.int32),
+    )

@@ -1,0 +1,142 @@
+"""Native core: C++ tile codec and shard parser, diffed against the
+pure-Python implementations of the same formats."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import reporter_tpu.native as native
+from reporter_tpu.native import get_lib, parse_shard_bytes
+from reporter_tpu.tiles import codec
+from reporter_tpu.tiles.network import grid_city
+
+
+@pytest.fixture(scope="module")
+def lib():
+    lib = get_lib()
+    if lib is None:
+        pytest.skip("no C++ toolchain available")
+    return lib
+
+
+def test_native_builds_and_reports_abi(lib):
+    assert lib.rn_abi_version() == codec.VERSION
+
+
+def _force_python_path(monkeypatch):
+    monkeypatch.setattr(codec, "get_lib", lambda: None)
+
+
+def test_tile_roundtrip_native(lib, tmp_path):
+    net = grid_city(rows=4, cols=4, spacing_m=150.0, two_edge_segments=True)
+    manifest = codec.save_network_tiles(net, str(tmp_path))
+    assert sum(t["edges"] for t in manifest["tiles"]) == net.num_edges
+    assert {t["level"] for t in manifest["tiles"]} <= {0, 1, 2}
+    back = codec.load_network_tiles(str(tmp_path))
+    assert back.num_nodes == net.num_nodes
+    assert back.num_edges == net.num_edges
+    # edge multiset equivalence (tiling reorders edges)
+    def key(e):
+        return (e.from_node, e.to_node, e.segment_id, e.level, round(e.speed_kph, 3))
+
+    assert sorted(map(key, back.edges)) == sorted(map(key, net.edges))
+    # shapes survive
+    e0 = back.edges[0]
+    assert len(e0.shape) >= 2 and isinstance(e0.shape[0][0], float)
+
+
+def test_python_fallback_byte_identical(lib, tmp_path, monkeypatch):
+    """The numpy fallback must produce the same bytes as the C++ writer."""
+    net = grid_city(rows=3, cols=3, spacing_m=100.0)
+    codec.save_network_tiles(net, str(tmp_path / "native"))
+    _force_python_path(monkeypatch)
+    codec.save_network_tiles(net, str(tmp_path / "python"))
+    for root, _dirs, files in os.walk(str(tmp_path / "native")):
+        for f in files:
+            rel = os.path.relpath(os.path.join(root, f), str(tmp_path / "native"))
+            a = open(os.path.join(str(tmp_path / "native"), rel), "rb").read()
+            b = open(os.path.join(str(tmp_path / "python"), rel), "rb").read()
+            if rel.endswith(".json"):
+                assert json.loads(a) == json.loads(b)
+            else:
+                assert a == b, "mismatch in %s" % rel
+
+
+def test_python_reads_native_tiles(lib, tmp_path, monkeypatch):
+    net = grid_city(rows=3, cols=3)
+    codec.save_network_tiles(net, str(tmp_path))
+    _force_python_path(monkeypatch)
+    back = codec.load_network_tiles(str(tmp_path))
+    assert back.num_edges == net.num_edges
+
+
+def test_level_filtered_load(lib, tmp_path):
+    net = grid_city(rows=5, cols=5)
+    codec.save_network_tiles(net, str(tmp_path))
+    only_arterial = codec.load_network_tiles(str(tmp_path), levels={1})
+    assert 0 < only_arterial.num_edges < net.num_edges
+    assert all(e.level == 1 for e in only_arterial.edges)
+
+
+def test_corrupt_tile_rejected(lib, tmp_path):
+    p = str(tmp_path / "bad.rptt")
+    with open(p, "wb") as f:
+        f.write(b"not a tile at all")
+    with pytest.raises(IOError):
+        codec.read_tile(p)
+
+
+SHARD = (
+    b"veh-1,1483250740,37.75,-122.45,5\n"
+    b"veh-2,1483250750,37.76,-122.44,7\n"
+    b"torn-row,148325\n"
+    b"veh-1,1483250760,37.77,-122.43,4\n"
+)
+
+
+def _python_parse(data):
+    import unittest.mock as mock
+
+    with mock.patch.object(native, "get_lib", lambda: None):
+        return native.parse_shard_bytes(data)
+
+
+def test_parse_shard_native_vs_python(lib):
+    na = parse_shard_bytes(SHARD, lib=lib)
+    py = _python_parse(SHARD)
+    assert na[0] == ["veh-1", "veh-2", "veh-1"]  # torn row skipped
+    assert list(na[1]) == [1483250740, 1483250750, 1483250760]
+    assert na[0] == py[0]
+    np.testing.assert_array_equal(na[1], py[1])
+    np.testing.assert_allclose(na[2], py[2])
+    np.testing.assert_allclose(na[3], py[3])
+    np.testing.assert_array_equal(na[4], py[4])
+
+
+def test_parse_shard_crlf(lib):
+    """CRLF archives must parse identically on both paths."""
+    crlf = SHARD.replace(b"\n", b"\r\n")
+    na = parse_shard_bytes(crlf, lib=lib)
+    py = _python_parse(crlf)
+    assert na[0] == py[0] == ["veh-1", "veh-2", "veh-1"]
+    np.testing.assert_array_equal(na[1], py[1])
+    np.testing.assert_array_equal(na[4], py[4])
+
+
+def test_service_tiles_config(lib, tmp_path):
+    """The serve config 'tiles' network type loads through the codec."""
+    from reporter_tpu.serve.service import load_service_config
+
+    net = grid_city(rows=4, cols=4, spacing_m=150.0)
+    codec.save_network_tiles(net, str(tmp_path / "tiles"))
+    conf = {
+        "network": {"type": "tiles", "path": str(tmp_path / "tiles")},
+        "backend": "cpu",
+    }
+    cpath = str(tmp_path / "conf.json")
+    with open(cpath, "w") as f:
+        json.dump(conf, f)
+    matcher, _ = load_service_config(cpath)
+    assert matcher.arrays.num_edges == net.num_edges
