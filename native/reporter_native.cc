@@ -151,6 +151,9 @@ int64_t rn_parse_shard(const char* buf, int64_t len, double* lat, double* lon,
            (buf[line_end - 1] == '\r' || buf[line_end - 1] == ' ' ||
             buf[line_end - 1] == '\t'))
       line_end--;
+    while (line_start < line_end &&
+           (buf[line_start] == ' ' || buf[line_start] == '\t'))
+      line_start++;
 
     // split into 5 comma-separated fields
     int64_t field_start[5];
@@ -177,7 +180,7 @@ int64_t rn_parse_shard(const char* buf, int64_t len, double* lat, double* lon,
         memcpy(tmp, buf + field_start[1], l);
         tmp[l] = 0;
         tm[rows] = strtoll(tmp, &endp, 10);
-        if (!only_trailing_ws(endp)) bad = true;
+        if (endp == tmp || !only_trailing_ws(endp)) bad = true;
       }
       // lat / lon
       for (int k = 2; k < 4 && !bad; ++k) {
@@ -189,7 +192,7 @@ int64_t rn_parse_shard(const char* buf, int64_t len, double* lat, double* lon,
         memcpy(tmp, buf + field_start[k], l);
         tmp[l] = 0;
         double v = strtod(tmp, &endp);
-        if (!only_trailing_ws(endp)) {
+        if (endp == tmp || !only_trailing_ws(endp)) {
           bad = true;
         } else if (k == 2) {
           lat[rows] = v;
@@ -206,7 +209,7 @@ int64_t rn_parse_shard(const char* buf, int64_t len, double* lat, double* lon,
           memcpy(tmp, buf + field_start[4], l);
           tmp[l] = 0;
           acc[rows] = (int32_t)strtol(tmp, &endp, 10);
-          if (!only_trailing_ws(endp)) bad = true;
+          if (endp == tmp || !only_trailing_ws(endp)) bad = true;
         }
       }
       if (!bad && field_len[0] > 0) {

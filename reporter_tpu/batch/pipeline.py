@@ -233,6 +233,28 @@ def get_traces(
 # -- phase 2: match --------------------------------------------------------
 
 
+def _iter_shard_chunks(file_name: str, chunk_bytes: int = 1 << 26):
+    """Yield (uuids, time, lat, lon, acc, line_count) per newline-aligned
+    chunk of the shard file."""
+    with open(file_name, "rb") as f:
+        carry = b""
+        while True:
+            block = f.read(chunk_bytes)
+            if not block:
+                if carry.strip():
+                    parsed = parse_shard_bytes(carry)
+                    yield (*parsed, carry.count(b"\n") + (0 if carry.endswith(b"\n") else 1))
+                return
+            data = carry + block
+            cut = data.rfind(b"\n")
+            if cut < 0:
+                carry = data
+                continue
+            chunk, carry = data[: cut + 1], data[cut + 1 :]
+            parsed = parse_shard_bytes(chunk)
+            yield (*parsed, chunk.count(b"\n"))
+
+
 def _windows(points: List[dict], inactivity: float) -> Iterable[List[dict]]:
     """Split a sorted point list at inactivity gaps; drop <2-point windows
     (simple_reporter.py:149-163)."""
@@ -276,25 +298,23 @@ def make_matches(
 
     for file_name in file_names:
         # the native parser skips torn rows (concurrent phase-1 appends can
-        # tear a line mid-write); so does its Python fallback
-        with open(file_name, "rb") as f:
-            data = f.read()
-        uuids, tms, lats, lons, accs = parse_shard_bytes(data)
-        n_lines = data.count(b"\n") + (0 if data.endswith(b"\n") or not data else 1)
-        if len(uuids) < n_lines:
-            log.warning(
-                "skipped %d malformed row(s) in %s", n_lines - len(uuids), file_name
-            )
+        # tear a line mid-write); so does its Python fallback.  Shards are
+        # read in bounded chunks so a multi-GB archive doesn't spike memory.
         traces: dict = {}
-        for i in range(len(uuids)):
-            traces.setdefault(uuids[i], []).append(
-                {
-                    "lat": float(lats[i]),
-                    "lon": float(lons[i]),
-                    "time": int(tms[i]),
-                    "accuracy": int(accs[i]),
-                }
-            )
+        skipped = 0
+        for uuids, tms, lats, lons, accs, chunk_lines in _iter_shard_chunks(file_name):
+            skipped += chunk_lines - len(uuids)
+            for i in range(len(uuids)):
+                traces.setdefault(uuids[i], []).append(
+                    {
+                        "lat": float(lats[i]),
+                        "lon": float(lons[i]),
+                        "time": int(tms[i]),
+                        "accuracy": int(accs[i]),
+                    }
+                )
+        if skipped:
+            log.warning("skipped %d malformed row(s) in %s", skipped, file_name)
 
         # build every match request up front; competing phase-1 appends are
         # repaired by the sort (simple_reporter.py:145-146)

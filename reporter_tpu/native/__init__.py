@@ -109,10 +109,13 @@ def parse_shard_bytes(data: bytes, lib=None):
         uoff = np.empty(n_lines, np.int64)
         ulen = np.empty(n_lines, np.int32)
         n = lib.rn_parse_shard(data, len(data), lat, lon, tm, acc, uoff, ulen, n_lines)
-        uuids = [data[uoff[i] : uoff[i] + ulen[i]].decode() for i in range(n)]
+        # "replace": a torn multi-byte character must not abort the batch
+        uuids = [
+            data[uoff[i] : uoff[i] + ulen[i]].decode(errors="replace") for i in range(n)
+        ]
         return uuids, tm[:n].copy(), lat[:n].copy(), lon[:n].copy(), acc[:n].copy()
     uuids, tms, lats, lons, accs = [], [], [], [], []
-    for line in data.decode().splitlines():
+    for line in data.decode(errors="replace").splitlines():
         # parse the whole row before appending anything, so a row that fails
         # on a late field can't leave the columns misaligned
         try:

@@ -116,6 +116,8 @@ def read_tile(path: str) -> TileArrays:
         return t
     with open(path, "rb") as f:
         data = f.read()
+    if len(data) < _HDR.size:
+        raise IOError("not a tile file (truncated header): %s" % path)
     magic, version, n_nodes, n_edges, n_shape, _ = _HDR.unpack_from(data, 0)
     if magic != MAGIC:
         raise IOError("not a tile file: %s" % path)
@@ -125,7 +127,10 @@ def read_tile(path: str) -> TileArrays:
 
     def take(dtype, count):
         nonlocal off
-        arr = np.frombuffer(data, dtype, count, off)
+        try:
+            arr = np.frombuffer(data, dtype, count, off)
+        except ValueError as e:  # same IOError the native path raises
+            raise IOError("truncated tile file %s: %s" % (path, e))
         off += arr.nbytes
         return arr
 

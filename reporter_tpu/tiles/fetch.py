@@ -1,0 +1,131 @@
+"""Tile acquisition tooling: bbox -> tile file list -> parallel fetch.
+
+The reference's py/get_tiles.py lists the tile files intersecting a bbox and
+py/download_tiles.sh drives parallel curl over that list (xargs -P) with
+post-download verification.  Both fold into this module: ``list_files`` is
+the listing, ``fetch`` downloads over HTTP with a bounded thread pool and
+verifies every file landed, and the CLI exposes the same workflow:
+
+    # just print the file list (get_tiles.py behavior)
+    python -m reporter_tpu.tiles.fetch --bbox -122.5,37.7,-122.3,37.8 --suffix gph
+
+    # download them too
+    python -m reporter_tpu.tiles.fetch --bbox ... --base-url https://tiles.example \
+        --output-dir ./tiles --concurrency 8
+"""
+
+from __future__ import annotations
+
+import argparse
+import concurrent.futures
+import logging
+import os
+import sys
+import urllib.error
+import urllib.request
+from typing import List, Optional, Tuple
+
+from .hierarchy import TileHierarchy
+
+log = logging.getLogger(__name__)
+
+
+def list_files(
+    bbox: Tuple[float, float, float, float],
+    suffix: str = "json",
+    levels: Optional[set] = None,
+) -> List[str]:
+    """Tile file paths intersecting bbox (min_lon, min_lat, max_lon,
+    max_lat); min_lon >= max_lon means the bbox crosses the antimeridian
+    (get_tiles.py:143-144)."""
+    return TileHierarchy().tile_files_in_bbox(*bbox, suffix=suffix, levels=levels)
+
+
+def _fetch_one(base_url: str, rel: str, out_dir: str, retries: int = 3) -> Tuple[str, Optional[str]]:
+    url = base_url.rstrip("/") + "/" + rel
+    dest = os.path.join(out_dir, rel)
+    os.makedirs(os.path.dirname(dest), exist_ok=True)
+    last = None
+    for _ in range(retries):
+        try:
+            with urllib.request.urlopen(url, timeout=30.0) as resp:
+                data = resp.read()
+                length = resp.headers.get("Content-Length")
+            # a truncated body must read as a retryable failure, not a tile
+            if length is not None and len(data) != int(length):
+                last = "truncated: %d of %s bytes" % (len(data), length)
+                continue
+            if not data:
+                last = "empty response"
+                continue
+            with open(dest, "wb") as f:
+                f.write(data)
+            return rel, None
+        except urllib.error.HTTPError as e:
+            if e.code == 404:
+                return rel, "404"  # sparse tile sets are normal
+            last = str(e)
+        except Exception as e:
+            last = str(e)
+    return rel, last or "failed"
+
+
+def fetch(
+    files: List[str],
+    base_url: str,
+    out_dir: str,
+    concurrency: int = 8,
+) -> Tuple[List[str], List[Tuple[str, str]]]:
+    """Download the listed tiles.  Returns (fetched, [(file, error), ...]);
+    404s count as errors so the caller can distinguish sparse coverage."""
+    fetched: List[str] = []
+    failed: List[Tuple[str, str]] = []
+    with concurrent.futures.ThreadPoolExecutor(max_workers=concurrency) as pool:
+        for rel, err in pool.map(lambda r: _fetch_one(base_url, r, out_dir), files):
+            if err is None:
+                fetched.append(rel)
+            else:
+                failed.append((rel, err))
+    return fetched, failed
+
+
+def check_box(bbox: str):
+    parts = [float(x) for x in bbox.split(",")]
+    if len(parts) != 4:
+        raise argparse.ArgumentTypeError(
+            "bbox needs 4 values: min_lon,min_lat,max_lon,max_lat"
+        )
+    if not (-90 <= parts[1] <= 90 and -90 <= parts[3] <= 90) or parts[1] >= parts[3]:
+        raise argparse.ArgumentTypeError("%s is not a valid bbox" % bbox)
+    return tuple(parts)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--bbox", type=check_box, required=True,
+                    help="min_lon,min_lat,max_lon,max_lat (min>=max wraps the antimeridian)")
+    ap.add_argument("--suffix", default="json")
+    ap.add_argument("--levels", default=None, help="comma list, e.g. 0,1")
+    ap.add_argument("--base-url", default=None, help="download from this URL root")
+    ap.add_argument("--output-dir", default="tiles")
+    ap.add_argument("--concurrency", type=int, default=8)
+    args = ap.parse_args(argv)
+
+    logging.basicConfig(level=logging.INFO, format="%(asctime)s %(levelname)s %(message)s")
+    levels = (
+        {int(x) for x in args.levels.split(",")} if args.levels is not None else None
+    )
+    files = list_files(args.bbox, args.suffix, levels)
+    if not args.base_url:
+        for f in files:
+            print(f)
+        return 0
+    fetched, failed = fetch(files, args.base_url, args.output_dir, args.concurrency)
+    log.info("fetched %d tiles, %d failed", len(fetched), len(failed))
+    for rel, err in failed:
+        log.warning("%s: %s", rel, err)
+    return 0 if not any(err != "404" for _rel, err in failed) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -131,8 +131,11 @@ class TileHierarchy:
                     for c in range(min_col, tiles.col(box.max_x) + 1):
                         yield level, r * tiles.ncolumns + c
 
-    def tile_files_in_bbox(self, min_lon, min_lat, max_lon, max_lat, suffix: str) -> List[str]:
+    def tile_files_in_bbox(
+        self, min_lon, min_lat, max_lon, max_lat, suffix: str, levels=None
+    ) -> List[str]:
         return [
             self.levels[level].file_suffix(tile_id, level, suffix)
             for level, tile_id in self.tiles_in_bbox(min_lon, min_lat, max_lon, max_lat)
+            if levels is None or level in levels
         ]

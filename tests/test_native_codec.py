@@ -80,12 +80,24 @@ def test_level_filtered_load(lib, tmp_path):
     assert all(e.level == 1 for e in only_arterial.edges)
 
 
-def test_corrupt_tile_rejected(lib, tmp_path):
+def test_corrupt_tile_rejected(lib, tmp_path, monkeypatch):
     p = str(tmp_path / "bad.rptt")
     with open(p, "wb") as f:
         f.write(b"not a tile at all")
     with pytest.raises(IOError):
         codec.read_tile(p)
+    # the numpy fallback must raise the same exception type
+    _force_python_path(monkeypatch)
+    with pytest.raises(IOError):
+        codec.read_tile(p)
+    # header-valid but truncated body
+    import struct
+
+    t = str(tmp_path / "trunc.rptt")
+    with open(t, "wb") as f:
+        f.write(struct.pack("<6I", codec.MAGIC, codec.VERSION, 100, 0, 0, 0))
+    with pytest.raises(IOError):
+        codec.read_tile(t)
 
 
 SHARD = (
@@ -123,6 +135,38 @@ def test_parse_shard_crlf(lib):
     assert na[0] == py[0] == ["veh-1", "veh-2", "veh-1"]
     np.testing.assert_array_equal(na[1], py[1])
     np.testing.assert_array_equal(na[4], py[4])
+
+
+def test_parse_shard_edge_rows(lib):
+    """Whitespace-only fields, leading-space uuids, and non-UTF-8 bytes must
+    behave the same on both paths."""
+    data = (
+        b"veh-1,1483250740,37.75, ,5\n"      # whitespace lon: reject
+        b"  veh-2,1483250750,37.76,-122.44,7\n"  # leading ws: uuid stripped
+        b"veh-\xff3,1483250760,37.77,-122.43,4\n"  # invalid utf-8 in uuid
+    )
+    na = parse_shard_bytes(data, lib=lib)
+    py = _python_parse(data)
+    assert na[0] == py[0]
+    assert na[0][0] == "veh-2"
+    assert len(na[0]) == 2  # bad-lon row dropped, other two kept
+    np.testing.assert_array_equal(na[1], py[1])
+
+
+def test_shard_chunked_iter(tmp_path):
+    from reporter_tpu.batch.pipeline import _iter_shard_chunks
+
+    p = str(tmp_path / "shard")
+    with open(p, "wb") as f:
+        f.write(SHARD)
+    # tiny chunks force the carry/split logic through every boundary
+    rows = []
+    total_lines = 0
+    for uuids, tms, lats, lons, accs, n_lines in _iter_shard_chunks(p, chunk_bytes=7):
+        rows.extend(zip(uuids, tms))
+        total_lines += n_lines
+    assert [u for u, _ in rows] == ["veh-1", "veh-2", "veh-1"]
+    assert total_lines == 4
 
 
 def test_service_tiles_config(lib, tmp_path):

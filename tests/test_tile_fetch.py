@@ -1,0 +1,69 @@
+"""Tile acquisition tooling: listing and the threaded fetch driver."""
+
+import http.server
+import os
+import threading
+
+import pytest
+
+from reporter_tpu.tiles.fetch import check_box, fetch, list_files
+
+
+def test_list_files_levels_and_suffix():
+    bbox = (-122.5, 37.7, -122.3, 37.8)
+    files = list_files(bbox, suffix="gph")
+    assert files and all(f.endswith(".gph") for f in files)
+    # one tile per level for a small box
+    assert {f.split("/")[0] for f in files} == {"0", "1", "2"}
+    only2 = list_files(bbox, suffix="gph", levels={2})
+    assert only2 and all(f.startswith("2/") for f in only2)
+    assert set(only2) <= set(files)
+
+
+def test_list_files_antimeridian():
+    files = list_files((179.9, -17.0, -179.9, -16.0), suffix="json")
+    # fiji-style wrap: tiles on both sides of the antimeridian
+    assert len(files) >= 6  # 3 levels x at least 2 tiles
+
+
+def test_check_box_rejects_garbage():
+    import argparse
+
+    with pytest.raises(argparse.ArgumentTypeError):
+        check_box("1,2,3")
+    with pytest.raises(argparse.ArgumentTypeError):
+        check_box("0,50,10,40")  # min_lat >= max_lat
+    assert check_box("179.9,-17,-179.9,-16") == (179.9, -17.0, -179.9, -16.0)
+
+
+def test_fetch_with_local_server(tmp_path):
+    src = tmp_path / "src"
+    bbox = (-122.5, 37.7, -122.3, 37.8)
+    files = list_files(bbox, suffix="json", levels={1, 2})
+    # serve only some of the tiles: the rest must come back as 404 failures
+    served = files[:-1]
+    for rel in served:
+        p = src / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text('{"tile": "%s"}' % rel)
+
+    class Handler(http.server.SimpleHTTPRequestHandler):
+        def __init__(self, *a, **kw):
+            super().__init__(*a, directory=str(src), **kw)
+
+        def log_message(self, *a):
+            pass
+
+    httpd = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    try:
+        base = "http://127.0.0.1:%d" % httpd.server_address[1]
+        out = tmp_path / "out"
+        fetched, failed = fetch(files, base, str(out), concurrency=4)
+        assert sorted(fetched) == sorted(served)
+        assert [err for _r, err in failed] == ["404"]
+        for rel in fetched:
+            assert (out / rel).read_text() == '{"tile": "%s"}' % rel
+    finally:
+        httpd.shutdown()
