@@ -31,6 +31,8 @@ class MatcherConfig:
     ubodt_delta: float = 3000.0
     # padded trace-length buckets for batched matching
     length_buckets: List[int] = field(default_factory=lambda: [16, 32, 64, 128, 256])
+    # device-batch cap: bounds the kernel's [B, T, K, K] transition arrays
+    max_device_batch: int = 2048
     # report() business-logic default (reporter_service.py:54-58)
     threshold_sec: int = 15
     mode: str = "auto"

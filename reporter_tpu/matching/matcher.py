@@ -66,12 +66,12 @@ class SegmentMatcher:
     def _init_jax(self):
         import jax
 
-        from ..ops.viterbi import MatchParams, match_batch
+        from ..ops.viterbi import MatchParams, match_batch_compact
 
         self._dg = self.arrays.to_device()
         self._du = self.ubodt.to_device()
         self._params = MatchParams.from_config(self.cfg)
-        self._jit_match = jax.jit(match_batch, static_argnums=(7,))
+        self._jit_match_compact = jax.jit(match_batch_compact, static_argnums=(7,))
 
     def _init_cpu(self):
         from ..baseline.cpu_matcher import CPUViterbiMatcher
@@ -83,20 +83,13 @@ class SegmentMatcher:
         if self.backend == "jax":
             import jax.numpy as jnp
 
-            res = self._jit_match(
+            res = self._jit_match_compact(
                 self._dg, self._du,
                 jnp.asarray(px, jnp.float32), jnp.asarray(py, jnp.float32),
                 jnp.asarray(times, jnp.float32),
                 jnp.asarray(valid, bool), self._params, self.cfg.beam_k,
             )
-            idx = np.asarray(res.idx)
-            B, T = idx.shape
-            sel = np.maximum(idx, 0)
-            rows = np.arange(B)[:, None], np.arange(T)[None, :]
-            edge = np.asarray(res.cand.edge)[rows[0], rows[1], sel]
-            offset = np.asarray(res.cand.offset)[rows[0], rows[1], sel]
-            edge = np.where(idx >= 0, edge, -1)
-            return edge, offset, np.asarray(res.breaks)
+            return np.asarray(res.edge), np.asarray(res.offset), np.asarray(res.breaks)
         else:
             return self._cpu.run_batch(px, py, times, valid)
 
@@ -113,7 +106,15 @@ class SegmentMatcher:
             n = len(tr["trace"])
             buckets.setdefault(self._bucket_len(n), []).append(i)
 
-        for blen, idxs in sorted(buckets.items()):
+        # cap the device batch: the kernel materialises [B, T, K, K] transition
+        # arrays, so an unbounded bucket could exhaust HBM
+        cap = max(1, int(self.cfg.max_device_batch))
+        chunks = [
+            (blen, idxs[i : i + cap])
+            for blen, idxs in sorted(buckets.items())
+            for i in range(0, len(idxs), cap)
+        ]
+        for blen, idxs in chunks:
             B = len(idxs)
             px = np.zeros((B, blen), np.float32)
             py = np.zeros((B, blen), np.float32)

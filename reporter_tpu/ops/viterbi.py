@@ -147,10 +147,19 @@ def match_trace(dg: DeviceGraph, du: DeviceUBODT, px, py, times, valid, p: Match
     gc = jnp.hypot(px[1:] - px[:-1], py[1:] - py[:-1])  # [T-1]
     dts = times[1:] - times[:-1]  # [T-1]
 
+    # All transition matrices at once: the UBODT hash probes and graph gathers
+    # become one [T-1, K, K] op (further batched [B, ...] by the vmap in
+    # match_batch) instead of T-1 sequential small gathers inside the scan —
+    # the scan below carries only the tiny max-plus recursion.
+    src_c = jax.tree_util.tree_map(lambda a: a[:-1], cand)
+    dst_c = jax.tree_util.tree_map(lambda a: a[1:], cand)
+    logp_all, route_all = jax.vmap(
+        transition_matrix, in_axes=(None, None, 0, 0, 0, 0, None)
+    )(dg, du, src_c, dst_c, gc, dts, p)  # [T-1, K, K]
+
     def step(scores, inputs):
         """scores: [K] running viterbi scores.  One timestep t (1..T-1)."""
-        cand_t, emis_t, gc_t, dt_t, valid_t, cand_prev = inputs
-        logp, route = transition_matrix(dg, du, cand_prev, cand_t, gc_t, dt_t, p)
+        logp, route, emis_t, gc_t, valid_t = inputs
         total = scores[:, None] + logp  # [K src, K dst]
         best_src = jnp.argmax(total, axis=0)  # [K]
         best_val = jnp.max(total, axis=0)
@@ -165,14 +174,7 @@ def match_trace(dg: DeviceGraph, du: DeviceUBODT, px, py, times, valid, p: Match
         return new_scores, (new_scores, backptr, broke & valid_t, chosen_route)
 
     init_scores = emis[0]
-    xs = (
-        jax.tree_util.tree_map(lambda a: a[1:], cand),
-        emis[1:],
-        gc,
-        dts,
-        valid[1:],
-        jax.tree_util.tree_map(lambda a: a[:-1], cand),
-    )
+    xs = (logp_all, route_all, emis[1:], gc, valid[1:])
     _, (all_scores, all_backptr, all_broke, all_route) = jax.lax.scan(step, init_scores, xs)
 
     # prepend step 0
@@ -219,3 +221,23 @@ def match_batch(dg: DeviceGraph, du: DeviceUBODT, px, py, times, valid, p: Match
     return jax.vmap(match_trace, in_axes=(None, None, 0, 0, 0, 0, None, None))(
         dg, du, px, py, times, valid, p, k
     )
+
+
+class CompactMatch(NamedTuple):
+    """Per-point chosen match, gathered on device so only [B, T] arrays cross
+    the host boundary (the full MatchResult is [B, T, K] — K times the
+    transfer for fields the host never reads)."""
+
+    edge: jnp.ndarray  # [B, T] i32 matched edge, -1 unmatched
+    offset: jnp.ndarray  # [B, T] f32 metres along edge
+    breaks: jnp.ndarray  # [B, T] bool
+
+
+def match_batch_compact(dg: DeviceGraph, du: DeviceUBODT, px, py, times, valid, p: MatchParams, k: int) -> CompactMatch:
+    """match_batch + on-device gather of the chosen candidate per point."""
+    res = match_batch(dg, du, px, py, times, valid, p, k)
+    sel = jnp.maximum(res.idx, 0)[..., None]  # [B, T, 1]
+    edge = jnp.take_along_axis(res.cand.edge, sel, axis=-1)[..., 0]
+    offset = jnp.take_along_axis(res.cand.offset, sel, axis=-1)[..., 0]
+    edge = jnp.where(res.idx >= 0, edge, -1)
+    return CompactMatch(edge=edge, offset=offset, breaks=res.breaks)
