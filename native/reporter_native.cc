@@ -226,3 +226,359 @@ int64_t rn_parse_shard(const char* buf, int64_t len, double* lat, double* lon,
 uint32_t rn_abi_version(void) { return kVersion; }
 
 }  // extern "C"
+
+// ---------------------------------------------------------------------------
+// Batched segment association: the host-side post-processing of the device
+// match (matched candidate per point -> wire-format OSMLR segment records).
+// Mirrors reporter_tpu/matching/segments.py operation-for-operation (same
+// double arithmetic in the same order, so outputs are bit-identical to the
+// Python oracle); that module stays as the fallback and the test oracle.
+// The reference runs this walk inside reporter_service.py report()'s caller
+// (the C++ matcher emits segments directly); on a 1-core host the Python
+// walk caps end-to-end throughput, hence the native tier.
+
+#include <vector>
+
+namespace {
+
+struct Span {
+  int32_t edge;
+  double enter_off;
+  double exit_off;
+  double route_start;
+};
+
+struct Pin {
+  double route_pos;
+  double time;
+  int32_t shape_index;
+};
+
+struct UbodtView {
+  const int32_t* src;
+  const int32_t* dst;
+  const int32_t* first_edge;
+  int64_t mask;
+  int32_t max_probes;
+};
+
+inline uint32_t pair_hash(uint32_t s, uint32_t d, int64_t mask) {
+  uint32_t h = s * 0x9E3779B1u + d * 0x85EBCA6Bu;
+  h ^= h >> 15;
+  h *= 0x2C1B3C6Du;
+  h ^= h >> 12;
+  return h & (uint32_t)mask;
+}
+
+// (first_edge) of the shortest src->dst row, or -1 on miss.
+inline int32_t ubodt_first_edge(const UbodtView& u, int32_t src, int32_t dst) {
+  uint32_t h = pair_hash((uint32_t)src, (uint32_t)dst, u.mask);
+  for (int32_t p = 0; p < u.max_probes; ++p) {
+    int64_t i = (h + p) & u.mask;
+    int32_t ts = u.src[i];
+    if (ts == -1) break;
+    if (ts == src && u.dst[i] == dst) return u.first_edge[i];
+  }
+  return -1;
+}
+
+// Edge sequence src -> dst by chaining first-edge hops (UBODT.path_edges).
+// Returns false if unreachable.
+inline bool ubodt_path_edges(const UbodtView& u, const int32_t* edge_to,
+                             int32_t src, int32_t dst, int64_t guard,
+                             std::vector<int32_t>* out) {
+  out->clear();
+  if (src == dst) return true;
+  int32_t node = src;
+  for (int64_t it = 0; it <= guard; ++it) {
+    int32_t fe = ubodt_first_edge(u, node, dst);
+    if (fe < 0) return false;
+    out->push_back(fe);
+    node = edge_to[fe];
+    if (node == dst) return true;
+  }
+  return false;
+}
+
+// _TimeLine.time_at: piecewise-linear time by route position.
+inline double time_at(const std::vector<Pin>& pins, double pos) {
+  if (pins.empty()) return -1.0;
+  if (pos <= pins.front().route_pos) return pins.front().time;
+  for (size_t i = 0; i + 1 < pins.size(); ++i) {
+    const Pin& a = pins[i];
+    const Pin& b = pins[i + 1];
+    if (pos <= b.route_pos) {
+      if (b.route_pos <= a.route_pos) return a.time;
+      double f = (pos - a.route_pos) / (b.route_pos - a.route_pos);
+      return a.time + f * (b.time - a.time);
+    }
+  }
+  return pins.back().time;
+}
+
+// _TimeLine.shape_index_at: last trace point at/before the position.
+inline int32_t shape_index_at(const std::vector<Pin>& pins, double pos) {
+  int32_t out = pins.empty() ? 0 : pins.front().shape_index;
+  for (const Pin& p : pins) {
+    if (p.route_pos <= pos + 1e-6)
+      out = p.shape_index;
+    else
+      break;
+  }
+  return out;
+}
+
+// _TimeLine.queue_length: contiguous slow run ending at the exit position.
+inline double queue_length(const std::vector<Pin>& pins, double entry,
+                           double exit, double thresh_mps) {
+  double q = 0.0;
+  double pos = exit;
+  if (pins.size() < 2) return q;
+  for (size_t k = pins.size() - 1; k >= 1; --k) {
+    const Pin& a = pins[k - 1];
+    const Pin& b = pins[k];
+    if (b.route_pos <= entry) break;
+    double lo = a.route_pos > entry ? a.route_pos : entry;
+    double hi = b.route_pos < exit ? b.route_pos : exit;
+    if (hi <= lo) continue;
+    if (hi < pos - 1e-6) break;  // gap: slow run no longer touches the exit
+    double dt = b.time - a.time;
+    double dr = b.route_pos - a.route_pos;
+    bool slow = dt > 0 && (dr / dt) < thresh_mps;
+    if (slow) {
+      q += hi - lo;
+      pos = lo;
+    } else {
+      break;
+    }
+  }
+  return q;
+}
+
+struct RecordSink {
+  int64_t out_cap;
+  int64_t way_cap;
+  int64_t n_rec = 0;
+  int64_t n_way = 0;
+  bool overflow = false;
+
+  uint8_t* has_seg;
+  int64_t* segment_id;
+  double* start_time;
+  double* end_time;
+  double* length;
+  uint8_t* internal_flag;
+  double* queue_len;
+  int32_t* begin_shape;
+  int32_t* end_shape;
+  int64_t* way_start;
+  int64_t* way_ids;
+};
+
+// _segment_records over one finished path.
+void emit_records(const std::vector<Span>& spans, const std::vector<Pin>& pins,
+                  const int32_t* edge_seg, const float* edge_seg_off,
+                  const uint8_t* edge_internal, const int64_t* edge_way,
+                  const int64_t* seg_ids, const float* seg_len,
+                  double queue_thresh_mps, RecordSink* sink) {
+  size_t i = 0;
+  size_t n = spans.size();
+  while (i < n) {
+    const Span& sp = spans[i];
+    int32_t seg = edge_seg[sp.edge];
+    bool internal = edge_internal[sp.edge] != 0;
+    size_t j = i;
+    while (j < n && edge_seg[spans[j].edge] == seg &&
+           (edge_internal[spans[j].edge] != 0) == internal)
+      j++;
+
+    const Span& first = spans[i];
+    const Span& last = spans[j - 1];
+    double entry_route = first.route_start;
+    double exit_route = last.route_start + (last.exit_off - last.enter_off);
+
+    if (sink->n_rec >= sink->out_cap) {
+      sink->overflow = true;
+      return;
+    }
+    int64_t r = sink->n_rec;
+
+    // way ids: dedup preserving order (tiny sets; O(g^2) is fine)
+    sink->way_start[r] = sink->n_way;
+    for (size_t g = i; g < j; ++g) {
+      int64_t w = edge_way[spans[g].edge];
+      if (w < 0) continue;
+      bool seen = false;
+      for (int64_t q = sink->way_start[r]; q < sink->n_way; ++q)
+        if (sink->way_ids[q] == w) {
+          seen = true;
+          break;
+        }
+      if (seen) continue;
+      if (sink->n_way >= sink->way_cap) {
+        sink->overflow = true;
+        return;
+      }
+      sink->way_ids[sink->n_way++] = w;
+    }
+
+    sink->internal_flag[r] = internal ? 1 : 0;
+    sink->queue_len[r] =
+        queue_length(pins, entry_route, exit_route, queue_thresh_mps);
+    sink->begin_shape[r] = shape_index_at(pins, entry_route);
+    sink->end_shape[r] = shape_index_at(pins, exit_route);
+
+    if (seg >= 0 && !internal) {
+      double seg_total = (double)seg_len[seg];
+      double seg_entry = (double)edge_seg_off[first.edge] + first.enter_off;
+      double seg_exit = (double)edge_seg_off[last.edge] + last.exit_off;
+      bool at_start = seg_entry <= 1e-3;
+      bool at_end = seg_exit >= seg_total - 1e-3;
+      sink->has_seg[r] = 1;
+      sink->segment_id[r] = seg_ids[seg];
+      sink->start_time[r] = at_start ? time_at(pins, entry_route) : -1.0;
+      sink->end_time[r] = at_end ? time_at(pins, exit_route) : -1.0;
+      sink->length[r] = (at_start && at_end) ? seg_total : -1.0;
+    } else {
+      sink->has_seg[r] = 0;
+      sink->segment_id[r] = -1;
+      sink->start_time[r] = time_at(pins, entry_route);
+      sink->end_time[r] = time_at(pins, exit_route);
+      sink->length[r] = -1.0;
+    }
+    sink->n_rec++;
+    i = j;
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// Batched associate_segments.  All [B, T] arrays row-major; n_points[b] gives
+// the live prefix of row b.  Returns 0 on success, -1 on output overflow
+// (caller grows out_cap/way_cap and retries), filling rec_start[B] (record
+// range ends per trace; range b is [rec_start[b-1] or 0, rec_start[b])) and
+// way_start[n_rec] (same convention over way_ids).
+int32_t rn_associate_batch(
+    // graph
+    const int32_t* edge_from, const int32_t* edge_to, const float* edge_len,
+    const int32_t* edge_seg, const float* edge_seg_off,
+    const uint8_t* edge_internal, const int64_t* edge_way,
+    const int64_t* seg_ids, const float* seg_len,
+    // ubodt
+    const int32_t* t_src, const int32_t* t_dst, const int32_t* t_first_edge,
+    int64_t mask, int32_t max_probes, int64_t ubodt_rows,
+    // matches
+    int64_t B, int64_t T, const int32_t* m_edge, const float* m_offset,
+    const uint8_t* m_break, const double* m_time, const int32_t* n_points,
+    // params
+    double queue_thresh_mps, double back_tol,
+    // outputs
+    int64_t out_cap, int64_t way_cap, int64_t* rec_start, uint8_t* rec_has_seg,
+    int64_t* rec_segment_id, double* rec_start_time, double* rec_end_time,
+    double* rec_length, uint8_t* rec_internal, double* rec_queue_len,
+    int32_t* rec_begin_shape, int32_t* rec_end_shape, int64_t* way_start,
+    int64_t* way_ids_out) {
+  UbodtView u = {t_src, t_dst, t_first_edge, mask, max_probes};
+  RecordSink sink;
+  sink.out_cap = out_cap;
+  sink.way_cap = way_cap;
+  sink.has_seg = rec_has_seg;
+  sink.segment_id = rec_segment_id;
+  sink.start_time = rec_start_time;
+  sink.end_time = rec_end_time;
+  sink.length = rec_length;
+  sink.internal_flag = rec_internal;
+  sink.queue_len = rec_queue_len;
+  sink.begin_shape = rec_begin_shape;
+  sink.end_shape = rec_end_shape;
+  sink.way_start = way_start;
+  sink.way_ids = way_ids_out;
+
+  std::vector<Span> spans;
+  std::vector<Pin> pins;
+  std::vector<int32_t> mid;
+
+  for (int64_t b = 0; b < B; ++b) {
+    const int32_t* edge = m_edge + b * T;
+    const float* off = m_offset + b * T;
+    const uint8_t* brk = m_break + b * T;
+    const double* tim = m_time + b * T;
+    int64_t n = n_points[b];
+
+    spans.clear();
+    pins.clear();
+    double route_pos = 0.0;
+    bool have_prev = false;
+
+    auto flush = [&]() {
+      if (!spans.empty())
+        emit_records(spans, pins, edge_seg, edge_seg_off, edge_internal,
+                     edge_way, seg_ids, seg_len, queue_thresh_mps, &sink);
+      spans.clear();
+      pins.clear();
+      route_pos = 0.0;
+    };
+
+    for (int64_t t = 0; t < n && !sink.overflow; ++t) {
+      int32_t e_cur = edge[t];
+      double o_cur = (double)off[t];
+      double tm = tim[t];
+      if (e_cur < 0) {  // unmatched: close the current path
+        flush();
+        have_prev = false;
+        continue;
+      }
+      if (!have_prev || brk[t]) {
+        flush();
+        spans.push_back({e_cur, o_cur, o_cur, 0.0});
+        pins.push_back({0.0, tm, (int32_t)t});
+        route_pos = 0.0;
+        have_prev = true;
+        continue;
+      }
+
+      Span& cur = spans.back();
+      int32_t e_prev = cur.edge;
+      bool same_edge = e_cur == e_prev;
+      if (same_edge && o_cur >= cur.exit_off) {
+        route_pos += o_cur - cur.exit_off;
+        cur.exit_off = o_cur;
+      } else if (same_edge && cur.exit_off - o_cur <= back_tol) {
+        // small backward jitter: keep position, pin the time only
+      } else {
+        // leave prev edge through its end, route to current edge's start
+        int32_t nd_to = edge_to[e_prev];
+        int32_t nd_from = edge_from[e_cur];
+        if (!ubodt_path_edges(u, edge_to, nd_to, nd_from, ubodt_rows + 1,
+                              &mid)) {
+          // no route (should have been a break) -- split defensively
+          flush();
+          spans.push_back({e_cur, o_cur, o_cur, 0.0});
+          pins.push_back({0.0, tm, (int32_t)t});
+          route_pos = 0.0;
+          continue;
+        }
+        Span& cur2 = spans.back();  // flush() above may not run; re-take ref
+        route_pos += (double)edge_len[e_prev] - cur2.exit_off;
+        cur2.exit_off = (double)edge_len[e_prev];
+        for (int32_t me : mid) {
+          spans.push_back({me, 0.0, (double)edge_len[me], route_pos});
+          route_pos += (double)edge_len[me];
+        }
+        spans.push_back({e_cur, 0.0, o_cur, route_pos});
+        route_pos += o_cur;
+      }
+      pins.push_back({route_pos, tm, (int32_t)t});
+    }
+    flush();
+    rec_start[b] = sink.n_rec;
+    if (sink.overflow) return -1;
+  }
+  // way range end per record (way_start is sized out_cap + 1 by the caller)
+  way_start[sink.n_rec] = sink.n_way;
+  return 0;
+}
+
+}  // extern "C"

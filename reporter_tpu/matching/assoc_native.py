@@ -1,0 +1,154 @@
+"""Native-accelerated batched segment association.
+
+``associate_segments_batch`` post-processes a whole device batch (matched
+edge/offset/break per point) into wire-format segment records in one C++
+call (native/reporter_native.cc rn_associate_batch), falling back to the
+pure-Python walk in matching/segments.py point-for-point when the native
+library is unavailable.  The C++ mirrors the Python arithmetic exactly, so
+both paths produce identical records (tests/test_assoc_native.py diffs
+them); rounding happens here, after the raw doubles come back, to keep the
+wire format byte-identical with the fallback.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+import numpy as np
+
+from ..native import get_lib
+from .segments import associate_segments
+
+
+def _fallback(arrays, ubodt, edge, offset, breaks, times, n_points,
+              queue_thresh_mps: float, back_tol: float) -> List[List[dict]]:
+    # match offsets are float32 by contract (the device kernel's dtype); the
+    # cpu oracle hands back float64 -- normalise so both association paths
+    # see bit-identical doubles
+    offset = np.asarray(offset, np.float32)
+    out: List[List[dict]] = []
+    for b in range(edge.shape[0]):
+        n = int(n_points[b])
+        match_points = [
+            {
+                "edge": int(edge[b, t]),
+                "offset": float(offset[b, t]),
+                "time": float(times[b, t]),
+                "break": bool(breaks[b, t]),
+                "shape_index": t,
+            }
+            for t in range(n)
+        ]
+        out.append(
+            associate_segments(
+                arrays, ubodt, match_points,
+                queue_thresh_mps=queue_thresh_mps, back_tol=back_tol,
+            )
+        )
+    return out
+
+
+def associate_segments_batch(
+    arrays,
+    ubodt,
+    edge: np.ndarray,  # [B, T] i32, -1 unmatched
+    offset: np.ndarray,  # [B, T] f32
+    breaks: np.ndarray,  # [B, T] bool
+    times: np.ndarray,  # [B, T] f64 epoch seconds
+    n_points: Sequence[int],  # live prefix per row
+    queue_thresh_mps: float = 20.0 / 3.6,
+    back_tol: float = 15.0,
+    lib=None,
+) -> List[List[dict]]:
+    """One wire-format segments list per batch row."""
+    B, T = edge.shape
+    n_pts = np.ascontiguousarray(n_points, np.int32)
+    if lib is None:
+        lib = get_lib()
+    if lib is None:
+        return _fallback(arrays, ubodt, edge, offset, breaks, times, n_pts,
+                         queue_thresh_mps, back_tol)
+
+    m_edge = np.ascontiguousarray(edge, np.int32)
+    m_off = np.ascontiguousarray(offset, np.float32)
+    m_brk = np.ascontiguousarray(breaks, np.uint8)
+    m_tim = np.ascontiguousarray(times, np.float64)
+
+    # graph/UBODT views are immutable; convert once per object, not per chunk
+    views = getattr(arrays, "_assoc_views", None)
+    if views is None:
+        views = (
+            np.ascontiguousarray(arrays.edge_from, np.int32),
+            np.ascontiguousarray(arrays.edge_to, np.int32),
+            np.ascontiguousarray(arrays.edge_len, np.float32),
+            np.ascontiguousarray(arrays.edge_seg, np.int32),
+            np.ascontiguousarray(arrays.edge_seg_off, np.float32),
+            np.ascontiguousarray(arrays.edge_internal, np.uint8),
+            np.ascontiguousarray(arrays.edge_way, np.int64),
+            np.ascontiguousarray(arrays.seg_ids, np.int64),
+            np.ascontiguousarray(arrays.seg_len, np.float32),
+        )
+        arrays._assoc_views = views
+    g_from, g_to, g_len, g_seg, g_seg_off, g_internal, g_way, s_ids, s_len = views
+
+    tviews = getattr(ubodt, "_assoc_views", None)
+    if tviews is None:
+        tviews = (
+            np.ascontiguousarray(ubodt.table_src, np.int32),
+            np.ascontiguousarray(ubodt.table_dst, np.int32),
+            np.ascontiguousarray(ubodt.table_first_edge, np.int32),
+        )
+        ubodt._assoc_views = tviews
+    t_src, t_dst, t_fe = tviews
+
+    out_cap = int(m_edge.size) * 2 + 64 * B + 64
+    way_cap = out_cap * 2
+    while True:
+        rec_start = np.zeros(B + 1, np.int64)
+        has_seg = np.zeros(out_cap, np.uint8)
+        seg_id = np.zeros(out_cap, np.int64)
+        t0 = np.zeros(out_cap, np.float64)
+        t1 = np.zeros(out_cap, np.float64)
+        length = np.zeros(out_cap, np.float64)
+        internal = np.zeros(out_cap, np.uint8)
+        qlen = np.zeros(out_cap, np.float64)
+        bshape = np.zeros(out_cap, np.int32)
+        eshape = np.zeros(out_cap, np.int32)
+        way_start = np.zeros(out_cap + 1, np.int64)
+        way_ids = np.zeros(way_cap, np.int64)
+        rc = lib.rn_associate_batch(
+            g_from, g_to, g_len, g_seg, g_seg_off, g_internal, g_way, s_ids,
+            s_len, t_src, t_dst, t_fe, int(ubodt.mask), int(ubodt.max_probes),
+            int(ubodt.num_rows), B, T, m_edge, m_off, m_brk, m_tim, n_pts,
+            float(queue_thresh_mps), float(back_tol), out_cap, way_cap,
+            rec_start[1:], has_seg, seg_id, t0, t1, length, internal, qlen,
+            bshape, eshape, way_start, way_ids,
+        )
+        if rc == 0:
+            break
+        out_cap *= 2
+        way_cap *= 2
+
+    out: List[List[dict]] = []
+    for b in range(B):
+        recs: List[dict] = []
+        for r in range(int(rec_start[b]), int(rec_start[b + 1])):
+            rec: dict = {
+                "way_ids": [int(w) for w in way_ids[way_start[r]:way_start[r + 1]]],
+                "internal": bool(internal[r]),
+                "queue_length": round(float(qlen[r]), 1),
+                "begin_shape_index": int(bshape[r]),
+                "end_shape_index": int(eshape[r]),
+            }
+            if has_seg[r]:
+                rec["segment_id"] = int(seg_id[r])
+                rec["start_time"] = round(float(t0[r]), 3) if t0[r] >= 0 else -1
+                rec["end_time"] = round(float(t1[r]), 3) if t1[r] >= 0 else -1
+                rec["length"] = round(float(length[r]), 3) if length[r] >= 0 else -1
+            else:
+                rec["start_time"] = round(float(t0[r]), 3)
+                rec["end_time"] = round(float(t1[r]), 3)
+                rec["length"] = -1
+            recs.append(rec)
+        out.append(recs)
+    return out

@@ -31,8 +31,10 @@ class MatcherConfig:
     ubodt_delta: float = 3000.0
     # padded trace-length buckets for batched matching
     length_buckets: List[int] = field(default_factory=lambda: [16, 32, 64, 128, 256])
-    # device-batch cap: bounds the kernel's [B, T, K, K] transition arrays
+    # device-batch caps: the kernel materialises [B, T, K, K] transition
+    # arrays, so the binding bound is on points (B*T), with a row cap on top
     max_device_batch: int = 2048
+    max_device_points: int = 2048 * 64
     # report() business-logic default (reporter_service.py:54-58)
     threshold_sec: int = 15
     mode: str = "auto"

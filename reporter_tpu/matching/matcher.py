@@ -24,8 +24,8 @@ import numpy as np
 from ..tiles.arrays import GraphArrays, build_graph_arrays
 from ..tiles.network import RoadNetwork
 from ..tiles.ubodt import UBODT, build_ubodt
+from .assoc_native import associate_segments_batch
 from .config import MatcherConfig
-from .segments import associate_segments
 
 log = logging.getLogger(__name__)
 
@@ -104,16 +104,23 @@ class SegmentMatcher:
         buckets: Dict[int, List[int]] = {}
         for i, tr in enumerate(traces):
             n = len(tr["trace"])
+            if n == 0:
+                results[i] = {"segments": []}
+                continue
             buckets.setdefault(self._bucket_len(n), []).append(i)
 
-        # cap the device batch: the kernel materialises [B, T, K, K] transition
-        # arrays, so an unbounded bucket could exhaust HBM
-        cap = max(1, int(self.cfg.max_device_batch))
-        chunks = [
-            (blen, idxs[i : i + cap])
-            for blen, idxs in sorted(buckets.items())
-            for i in range(0, len(idxs), cap)
-        ]
+        # cap the device batch: the kernel materialises [B, T, K, K]
+        # transition arrays, so bound B*T (and rows on top); rounded down to a
+        # power of two so the pow2 batch padding below cannot overshoot it
+        chunks = []
+        for blen, idxs in sorted(buckets.items()):
+            cap = max(1, min(int(self.cfg.max_device_batch),
+                             int(self.cfg.max_device_points) // blen))
+            while cap & (cap - 1):
+                cap &= cap - 1  # largest power of two <= cap
+            chunks.extend(
+                (blen, idxs[i : i + cap]) for i in range(0, len(idxs), cap)
+            )
         for blen, idxs in chunks:
             B = len(idxs)
             px = np.zeros((B, blen), np.float32)
@@ -152,24 +159,20 @@ class SegmentMatcher:
 
             edge, offset, breaks = self._run_batch(px, py, tm, valid)
 
+            # association wants true epoch times, not the rebased ones
+            abs_tm = np.zeros((B, blen), np.float64)
+            n_pts = np.zeros(B, np.int32)
+            for row, _ in enumerate(idxs):
+                n_pts[row] = len(times[row])
+                abs_tm[row, : n_pts[row]] = times[row]
+            seg_lists = associate_segments_batch(
+                self.arrays, self.ubodt,
+                edge[:B], offset[:B], breaks[:B], abs_tm, n_pts,
+                queue_thresh_mps=self.cfg.queue_speed_threshold_kph / 3.6,
+                back_tol=2.0 * self.cfg.sigma_z + 5.0,
+            )
             for row, i in enumerate(idxs):
-                n = len(traces[i]["trace"])
-                match_points = [
-                    {
-                        "edge": int(edge[row, t]),
-                        "offset": float(offset[row, t]),
-                        "time": times[row][t],
-                        "break": bool(breaks[row, t]),
-                        "shape_index": t,
-                    }
-                    for t in range(n)
-                ]
-                segs = associate_segments(
-                    self.arrays, self.ubodt, match_points,
-                    queue_thresh_mps=self.cfg.queue_speed_threshold_kph / 3.6,
-                    back_tol=2.0 * self.cfg.sigma_z + 5.0,
-                )
-                results[i] = {"segments": segs}
+                results[i] = {"segments": seg_lists[row]}
         return results  # type: ignore[return-value]
 
     def match(self, trace: dict) -> dict:

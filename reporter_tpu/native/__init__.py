@@ -72,6 +72,20 @@ def _configure(lib: ctypes.CDLL) -> ctypes.CDLL:
     ]
     lib.rn_abi_version.restype = ctypes.c_uint32
     lib.rn_abi_version.argtypes = []
+    lib.rn_associate_batch.restype = ctypes.c_int32
+    lib.rn_associate_batch.argtypes = [
+        # graph
+        _i32p, _i32p, _f32p, _i32p, _f32p, _u8p, _i64p, _i64p, _f32p,
+        # ubodt
+        _i32p, _i32p, _i32p, ctypes.c_int64, ctypes.c_int32, ctypes.c_int64,
+        # matches
+        ctypes.c_int64, ctypes.c_int64, _i32p, _f32p, _u8p, _f64p, _i32p,
+        # params
+        ctypes.c_double, ctypes.c_double,
+        # outputs
+        ctypes.c_int64, ctypes.c_int64, _i64p, _u8p, _i64p, _f64p, _f64p,
+        _f64p, _u8p, _f64p, _i32p, _i32p, _i64p, _i64p,
+    ]
     return lib
 
 

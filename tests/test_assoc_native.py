@@ -1,0 +1,95 @@
+"""Native (C++) batched association vs the pure-Python oracle.
+
+The two implementations must produce byte-identical wire records: the C++
+mirrors segments.py's double arithmetic operation-for-operation and the
+wrapper applies the same rounding.
+"""
+
+import numpy as np
+import pytest
+
+from reporter_tpu.matching import MatcherConfig, SegmentMatcher
+from reporter_tpu.matching.assoc_native import (
+    _fallback,
+    associate_segments_batch,
+)
+from reporter_tpu.native import get_lib
+from reporter_tpu.synth import TraceSynthesizer
+from reporter_tpu.tiles.arrays import build_graph_arrays
+from reporter_tpu.tiles.network import grid_city
+from reporter_tpu.tiles.ubodt import build_ubodt
+
+
+@pytest.fixture(scope="module")
+def setup():
+    city = grid_city(rows=6, cols=6, spacing_m=150.0)
+    arrays = build_graph_arrays(city, cell_size=100.0)
+    ubodt = build_ubodt(arrays, delta=1200.0)
+    return arrays, ubodt
+
+
+def _matched_batch(arrays, ubodt, B=8, T=24, seed=3):
+    cfg = MatcherConfig()
+    m = SegmentMatcher(arrays=arrays, ubodt=ubodt, config=cfg, backend="cpu")
+    synth = TraceSynthesizer(arrays, seed=seed)
+    straces = synth.batch(B, T, dt=5.0, sigma=6.0)
+    px = np.zeros((B, T), np.float32)
+    py = np.zeros((B, T), np.float32)
+    tm = np.zeros((B, T), np.float32)
+    abs_tm = np.zeros((B, T), np.float64)
+    valid = np.ones((B, T), bool)
+    for i, s in enumerate(straces):
+        pts = s.trace["trace"]
+        x, y = arrays.proj.to_xy([p["lat"] for p in pts], [p["lon"] for p in pts])
+        px[i], py[i] = x, y
+        ts = np.asarray([p["time"] for p in pts], np.float64)
+        tm[i] = ts - ts[0]
+        abs_tm[i] = ts
+    edge, offset, breaks = m._run_batch(px, py, tm, valid)
+    return cfg, edge, offset, breaks, abs_tm
+
+
+def test_native_matches_python_oracle(setup):
+    arrays, ubodt = setup
+    lib = get_lib()
+    if lib is None:
+        pytest.skip("no native compiler available")
+    cfg, edge, offset, breaks, abs_tm = _matched_batch(arrays, ubodt)
+    B, T = edge.shape
+    # exercise flush paths: unmatched points and forced mid-trace breaks
+    edge = edge.copy()
+    breaks = breaks.copy()
+    edge[1, 7] = -1
+    edge[2, 3:6] = -1
+    breaks[3, 10] = True
+    n_pts = np.full(B, T, np.int32)
+    n_pts[4] = 9  # short row: padded tail must be ignored
+
+    kw = dict(
+        queue_thresh_mps=cfg.queue_speed_threshold_kph / 3.6,
+        back_tol=2.0 * cfg.sigma_z + 5.0,
+    )
+    native = associate_segments_batch(
+        arrays, ubodt, edge, offset, breaks, abs_tm, n_pts, lib=lib, **kw
+    )
+    oracle = _fallback(
+        arrays, ubodt, edge, offset, breaks, abs_tm, n_pts,
+        kw["queue_thresh_mps"], kw["back_tol"],
+    )
+    assert native == oracle
+
+
+def test_native_all_unmatched(setup):
+    arrays, ubodt = setup
+    lib = get_lib()
+    if lib is None:
+        pytest.skip("no native compiler available")
+    B, T = 3, 8
+    edge = np.full((B, T), -1, np.int32)
+    offset = np.zeros((B, T), np.float32)
+    breaks = np.zeros((B, T), bool)
+    tm = np.arange(T, dtype=np.float64)[None, :].repeat(B, 0)
+    out = associate_segments_batch(
+        arrays, ubodt, edge, offset, breaks, tm, np.full(B, T, np.int32), lib=lib
+    )
+    assert out == [[], [], []]
