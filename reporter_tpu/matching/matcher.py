@@ -66,12 +66,13 @@ class SegmentMatcher:
     def _init_jax(self):
         import jax
 
-        from ..ops.viterbi import MatchParams, match_batch_compact
+        from ..ops.viterbi import MatchParams, match_batch_carry, match_batch_compact
 
         self._dg = self.arrays.to_device()
         self._du = self.ubodt.to_device()
         self._params = MatchParams.from_config(self.cfg)
         self._jit_match_compact = jax.jit(match_batch_compact, static_argnums=(7,))
+        self._jit_match_carry = jax.jit(match_batch_carry, static_argnums=(7,))
 
     def _init_cpu(self):
         from ..baseline.cpu_matcher import CPUViterbiMatcher
@@ -100,80 +101,149 @@ class SegmentMatcher:
         Returns one match dict {"segments": [...]} per trace, in order."""
         results: List[Optional[dict]] = [None] * len(traces)
 
-        # bucket by padded length
+        # bucket by padded length; traces beyond the largest bucket stream
+        # through fixed windows with carried Viterbi state (jax backend)
+        # instead of compiling ever-larger shapes
         buckets: Dict[int, List[int]] = {}
+        long_idxs: List[int] = []
+        max_bucket = self.cfg.length_buckets[-1] if self.cfg.length_buckets else 256
         for i, tr in enumerate(traces):
             n = len(tr["trace"])
             if n == 0:
                 results[i] = {"segments": []}
                 continue
+            if n > max_bucket and self.backend == "jax":
+                long_idxs.append(i)
+                continue
             buckets.setdefault(self._bucket_len(n), []).append(i)
+        if long_idxs:
+            self._match_long(traces, long_idxs, results)
 
         # cap the device batch: the kernel materialises [B, T, K, K]
         # transition arrays, so bound B*T (and rows on top); rounded down to a
         # power of two so the pow2 batch padding below cannot overshoot it
         chunks = []
         for blen, idxs in sorted(buckets.items()):
-            cap = max(1, min(int(self.cfg.max_device_batch),
-                             int(self.cfg.max_device_points) // blen))
-            while cap & (cap - 1):
-                cap &= cap - 1  # largest power of two <= cap
+            cap = self._device_cap(blen)
             chunks.extend(
                 (blen, idxs[i : i + cap]) for i in range(0, len(idxs), cap)
             )
         for blen, idxs in chunks:
-            B = len(idxs)
-            px = np.zeros((B, blen), np.float32)
-            py = np.zeros((B, blen), np.float32)
-            tm = np.zeros((B, blen), np.float32)
-            valid = np.zeros((B, blen), bool)
-            times = []
-            for row, i in enumerate(idxs):
-                pts = traces[i]["trace"]
-                lats = np.array([p["lat"] for p in pts], np.float64)
-                lons = np.array([p["lon"] for p in pts], np.float64)
-                x, y = self.arrays.proj.to_xy(lats, lons)
-                px[row, : len(pts)] = x
-                py[row, : len(pts)] = y
-                ts = [float(p["time"]) for p in pts]
-                # rebase to the trace start before the float32 cast: epoch
-                # seconds (~1.7e9) have ~2 minute float32 resolution, which
-                # would destroy the dt used by the time-factor cut; only
-                # deltas matter on device
-                tm[row, : len(pts)] = np.asarray(ts) - ts[0]
-                valid[row, : len(pts)] = True
-                times.append(ts)
-
-            # pad the batch dimension to a power of two so the jitted kernel
-            # compiles for a bounded set of (B, T) shapes; dummy rows are
-            # all-invalid and sliced off below
-            B_pad = 1
-            while B_pad < B:
-                B_pad <<= 1
-            if B_pad != B:
-                pad = B_pad - B
-                px = np.concatenate([px, np.zeros((pad, blen), np.float32)])
-                py = np.concatenate([py, np.zeros((pad, blen), np.float32)])
-                tm = np.concatenate([tm, np.zeros((pad, blen), np.float32)])
-                valid = np.concatenate([valid, np.zeros((pad, blen), bool)])
-
-            edge, offset, breaks = self._run_batch(px, py, tm, valid)
-
-            # association wants true epoch times, not the rebased ones
-            abs_tm = np.zeros((B, blen), np.float64)
-            n_pts = np.zeros(B, np.int32)
-            for row, _ in enumerate(idxs):
-                n_pts[row] = len(times[row])
-                abs_tm[row, : n_pts[row]] = times[row]
-            seg_lists = associate_segments_batch(
-                self.arrays, self.ubodt,
-                edge[:B], offset[:B], breaks[:B], abs_tm, n_pts,
-                queue_thresh_mps=self.cfg.queue_speed_threshold_kph / 3.6,
-                back_tol=2.0 * self.cfg.sigma_z + 5.0,
-            )
-            for row, i in enumerate(idxs):
-                results[i] = {"segments": seg_lists[row]}
+            px, py, tm, valid, times = self._fill_rows(traces, idxs, blen)
+            edge, offset, breaks = self._run_batch(*self._pad_pow2(px, py, tm, valid))
+            self._associate_and_store(idxs, edge, offset, breaks, times, results)
         return results  # type: ignore[return-value]
+
+    def _device_cap(self, blen: int) -> int:
+        """Rows per device batch for window length blen: bound B*T (the
+        kernel materialises [B, T, K, K]) with a row cap on top, rounded down
+        to a power of two so pow2 batch padding cannot overshoot it."""
+        cap = max(1, min(int(self.cfg.max_device_batch),
+                         int(self.cfg.max_device_points) // blen))
+        while cap & (cap - 1):
+            cap &= cap - 1
+        return cap
+
+    def _fill_rows(self, traces, idxs, T):
+        """Pack traces[idxs] into padded [B, T] device arrays + times lists."""
+        B = len(idxs)
+        px = np.zeros((B, T), np.float32)
+        py = np.zeros((B, T), np.float32)
+        tm = np.zeros((B, T), np.float32)
+        valid = np.zeros((B, T), bool)
+        times = []
+        for row, i in enumerate(idxs):
+            pts = traces[i]["trace"]
+            lats = np.array([p["lat"] for p in pts], np.float64)
+            lons = np.array([p["lon"] for p in pts], np.float64)
+            x, y = self.arrays.proj.to_xy(lats, lons)
+            px[row, : len(pts)] = x
+            py[row, : len(pts)] = y
+            ts = [float(p["time"]) for p in pts]
+            # rebase to the trace start before the float32 cast: epoch
+            # seconds (~1.7e9) have ~2 minute float32 resolution, which
+            # would destroy the dt used by the time-factor cut; only
+            # deltas matter on device
+            tm[row, : len(pts)] = np.asarray(ts) - ts[0]
+            valid[row, : len(pts)] = True
+            times.append(ts)
+        return px, py, tm, valid, times
+
+    @staticmethod
+    def _pad_pow2(px, py, tm, valid):
+        """Pad the batch dimension to a power of two so the jitted kernel
+        compiles for a bounded set of (B, T) shapes; dummy rows are
+        all-invalid and sliced off by the caller."""
+        B = px.shape[0]
+        B_pad = 1
+        while B_pad < B:
+            B_pad <<= 1
+        if B_pad == B:
+            return px, py, tm, valid
+        pad = B_pad - B
+        z = lambda a: np.concatenate([a, np.zeros((pad,) + a.shape[1:], a.dtype)])
+        return z(px), z(py), z(tm), z(valid)
+
+    def _associate_and_store(self, idxs, edge, offset, breaks, times, results):
+        """Wire-format association for B rows (edge may carry pow2 pad rows;
+        only the first len(idxs) are read).  times: per-row epoch-sec lists."""
+        B = len(idxs)
+        T = edge.shape[1]
+        abs_tm = np.zeros((B, T), np.float64)
+        n_pts = np.zeros(B, np.int32)
+        for row in range(B):
+            n_pts[row] = len(times[row])
+            abs_tm[row, : n_pts[row]] = times[row]
+        seg_lists = associate_segments_batch(
+            self.arrays, self.ubodt,
+            edge[:B], offset[:B], breaks[:B], abs_tm, n_pts,
+            queue_thresh_mps=self.cfg.queue_speed_threshold_kph / 3.6,
+            back_tol=2.0 * self.cfg.sigma_z + 5.0,
+        )
+        for row, i in enumerate(idxs):
+            results[i] = {"segments": seg_lists[row]}
+
+    def _match_long(self, traces, idxs, results):
+        """Stream traces longer than the largest bucket through fixed
+        [B, W]-windows with carried Viterbi state (ops/viterbi.TraceCarry):
+        one compile regardless of trace length, no HMM restart at window
+        boundaries."""
+        import jax
+        import jax.numpy as jnp
+
+        from ..ops.viterbi import initial_carry_batch
+
+        W = self.cfg.length_buckets[-1] if self.cfg.length_buckets else 256
+        cap = self._device_cap(W)  # rows per device batch for this window
+
+        # longest-first so rows in one group need similar chunk counts
+        order = sorted(idxs, key=lambda i: -len(traces[i]["trace"]))
+        for g in range(0, len(order), cap):
+            group = order[g : g + cap]
+            B = len(group)
+            T_max = max(len(traces[i]["trace"]) for i in group)
+            n_chunks = -(-T_max // W)
+            px, py, tm, valid, times = self._fill_rows(traces, group, n_chunks * W)
+            px, py, tm, valid = self._pad_pow2(px, py, tm, valid)
+            B_pad = px.shape[0]
+
+            carry = initial_carry_batch(B_pad, self.cfg.beam_k)
+            edges, offs, brks = [], [], []
+            for c in range(n_chunks):
+                sl = slice(c * W, (c + 1) * W)
+                cm, carry = self._jit_match_carry(
+                    self._dg, self._du,
+                    jnp.asarray(px[:, sl]), jnp.asarray(py[:, sl]),
+                    jnp.asarray(tm[:, sl]), jnp.asarray(valid[:, sl]),
+                    self._params, self.cfg.beam_k, carry,
+                )
+                edges.append(np.asarray(cm.edge))
+                offs.append(np.asarray(cm.offset))
+                brks.append(np.asarray(cm.breaks))
+            edge = np.concatenate(edges, axis=1)
+            offset = np.concatenate(offs, axis=1)
+            breaks = np.concatenate(brks, axis=1)
+            self._associate_and_store(group, edge, offset, breaks, times, results)
 
     def match(self, trace: dict) -> dict:
         return self.match_many([trace])[0]

@@ -134,9 +134,47 @@ def angle_diff(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     return jnp.mod(d + jnp.pi, 2.0 * jnp.pi) - jnp.pi
 
 
-def match_trace(dg: DeviceGraph, du: DeviceUBODT, px, py, times, valid, p: MatchParams, k: int) -> MatchResult:
+class TraceCarry(NamedTuple):
+    """Viterbi state carried across chunks of one long trace (the sequence
+    axis analogue of carrying attention state between blocks).  The next
+    chunk's first transition runs from these candidates instead of an HMM
+    restart, so a trace of any length streams through fixed [T]-window
+    compiles with state intact (the reference's incremental-matching
+    contract: shape_used trims consumed points and keeps a rolling tail,
+    reporter_service.py:83-92, Batch.java:73-80)."""
+
+    scores: jnp.ndarray  # [K] running viterbi scores at the last valid point
+    edge: jnp.ndarray  # [K] i32 candidate edges at the last valid point
+    offset: jnp.ndarray  # [K] f32 offsets along those edges
+    x: jnp.ndarray  # f32 last valid point position
+    y: jnp.ndarray
+    t: jnp.ndarray  # f32 last valid point time
+    active: jnp.ndarray  # bool: False = no live state (first chunk / all-pad)
+    # slot the previous chunk's backtrace *committed* at the seam point.  The
+    # next chunk re-checks that its own first choice is route-reachable from
+    # this committed slot and raises a truthful break flag if not (the beam
+    # transition below propagates scores from all slots, so the committed one
+    # need not be the argmax source).
+    committed: jnp.ndarray  # i32, -1 = none
+
+    @classmethod
+    def inactive(cls, k: int) -> "TraceCarry":
+        return cls(
+            scores=jnp.full((k,), NEG_INF, jnp.float32),
+            edge=jnp.full((k,), -1, jnp.int32),
+            offset=jnp.zeros((k,), jnp.float32),
+            x=jnp.float32(0.0), y=jnp.float32(0.0), t=jnp.float32(0.0),
+            active=jnp.array(False),
+            committed=jnp.int32(-1),
+        )
+
+
+def match_trace(dg: DeviceGraph, du: DeviceUBODT, px, py, times, valid, p: MatchParams, k: int,
+                carry: "TraceCarry | None" = None):
     """Match one trace of T (padded) points.  px/py/times/valid: [T].
-    vmap over batch."""
+    vmap over batch.  With ``carry`` (static presence), the first step
+    transitions from the carried candidate beam instead of restarting, and
+    the updated carry is returned: (MatchResult, TraceCarry)."""
     T = px.shape[0]
     cand = find_candidates_batch(dg, px, py, k, p.search_radius)  # [T, K]
 
@@ -173,15 +211,40 @@ def match_trace(dg: DeviceGraph, du: DeviceUBODT, px, py, times, valid, p: Match
         chosen_route = jnp.where(connected, route[best_src, jnp.arange(route.shape[1])], jnp.inf)
         return new_scores, (new_scores, backptr, broke & valid_t, chosen_route)
 
-    init_scores = emis[0]
+    if carry is None:
+        init_scores = emis[0]
+        first_break = jnp.array(True)
+        first_route = jnp.full((k,), jnp.inf)
+    else:
+        # first step transitions from the carried beam (chunk boundary)
+        src_c = Candidates(
+            edge=carry.edge, offset=carry.offset,
+            dist=jnp.zeros((k,), jnp.float32),
+            cx=jnp.zeros((k,), jnp.float32), cy=jnp.zeros((k,), jnp.float32),
+        )
+        dst_c = jax.tree_util.tree_map(lambda a: a[0], cand)
+        gc0 = jnp.hypot(px[0] - carry.x, py[0] - carry.y)
+        dt0 = times[0] - carry.t
+        logp0, route0 = transition_matrix(dg, du, src_c, dst_c, gc0, dt0, p)
+        total0 = carry.scores[:, None] + logp0  # [K src, K dst]
+        best_src0 = jnp.argmax(total0, axis=0)
+        best_val0 = jnp.max(total0, axis=0)
+        connected0 = best_val0 > NEG_INF / 2
+        broke0 = (gc0 > p.breakage_distance) | ~jnp.any(connected0) | ~carry.active
+        init_scores = jnp.where(broke0, emis[0], best_val0 + emis[0])
+        first_break = broke0
+        first_route = jnp.where(
+            connected0 & ~broke0,
+            route0[best_src0, jnp.arange(k)], jnp.inf,
+        )
     xs = (logp_all, route_all, emis[1:], gc, valid[1:])
     _, (all_scores, all_backptr, all_broke, all_route) = jax.lax.scan(step, init_scores, xs)
 
     # prepend step 0
     scores_mat = jnp.concatenate([init_scores[None], all_scores], axis=0)  # [T, K]
     backptr = jnp.concatenate([jnp.full((1, k), -1, all_backptr.dtype), all_backptr], axis=0)
-    breaks = jnp.concatenate([jnp.array([True]), all_broke], axis=0) & valid
-    route_in = jnp.concatenate([jnp.full((1, k), jnp.inf), all_route], axis=0)  # [T, K]
+    breaks = jnp.concatenate([first_break[None], all_broke], axis=0) & valid
+    route_in = jnp.concatenate([first_route[None], all_route], axis=0)  # [T, K]
 
     # ----- backtrace (reverse scan) -----
     # segment boundaries: step t is a segment start if breaks[t]; padded steps
@@ -213,7 +276,44 @@ def match_trace(dg: DeviceGraph, du: DeviceUBODT, px, py, times, valid, p: Match
     chosen_route = jnp.take_along_axis(route_in, jnp.maximum(idx, 0)[:, None], axis=1)[:, 0]
     chosen_route = jnp.where((idx >= 0) & ~breaks, chosen_route, jnp.inf)
 
-    return MatchResult(cand=cand, idx=idx, breaks=breaks, route_dist=chosen_route, score=chosen_score)
+    result = MatchResult(cand=cand, idx=idx, breaks=breaks, route_dist=chosen_route, score=chosen_score)
+    if carry is None:
+        return result
+
+    # seam consistency check: the committed choice of the previous chunk must
+    # actually reach this chunk's first chosen candidate, else the "no break"
+    # claim at the seam is a lie and association would hit a defensive split
+    # with times silently dropped.  Flag it truthfully instead.
+    seam_ok = jnp.where(
+        (carry.committed >= 0) & (idx[0] >= 0) & ~breaks[0],
+        logp0[jnp.maximum(carry.committed, 0), jnp.maximum(idx[0], 0)] > NEG_INF / 2,
+        True,
+    )
+    breaks = breaks.at[0].set(breaks[0] | (~seam_ok & valid[0]))
+    result = result._replace(breaks=breaks)
+
+    # carry out: beam state at the last valid point (padded steps froze the
+    # scores, so scores_mat[T-1] is already that state).  Renormalise by the
+    # running max (argmax-invariant) so float32 magnitude cannot grow without
+    # bound over an arbitrarily long streamed trace.
+    last = (T - 1) - jnp.argmax(valid[::-1])  # index of last valid point
+    any_valid = jnp.any(valid)
+    safe_last = jnp.where(any_valid, last, 0)
+    out_scores = scores_mat[T - 1]
+    smax = jnp.max(out_scores)
+    out_scores = jnp.where(
+        (out_scores > NEG_INF / 2) & (smax > NEG_INF / 2),
+        out_scores - smax, NEG_INF,
+    )
+    carry_out = TraceCarry(
+        scores=out_scores,
+        edge=cand.edge[safe_last],
+        offset=cand.offset[safe_last],
+        x=px[safe_last], y=py[safe_last], t=times[safe_last],
+        active=any_valid,
+        committed=jnp.where(any_valid, idx[safe_last], jnp.int32(-1)).astype(jnp.int32),
+    )
+    return result, carry_out
 
 
 def match_batch(dg: DeviceGraph, du: DeviceUBODT, px, py, times, valid, p: MatchParams, k: int) -> MatchResult:
@@ -236,8 +336,28 @@ class CompactMatch(NamedTuple):
 def match_batch_compact(dg: DeviceGraph, du: DeviceUBODT, px, py, times, valid, p: MatchParams, k: int) -> CompactMatch:
     """match_batch + on-device gather of the chosen candidate per point."""
     res = match_batch(dg, du, px, py, times, valid, p, k)
+    return _compact(res)
+
+
+def _compact(res: MatchResult) -> CompactMatch:
     sel = jnp.maximum(res.idx, 0)[..., None]  # [B, T, 1]
     edge = jnp.take_along_axis(res.cand.edge, sel, axis=-1)[..., 0]
     offset = jnp.take_along_axis(res.cand.offset, sel, axis=-1)[..., 0]
     edge = jnp.where(res.idx >= 0, edge, -1)
     return CompactMatch(edge=edge, offset=offset, breaks=res.breaks)
+
+
+def match_batch_carry(dg: DeviceGraph, du: DeviceUBODT, px, py, times, valid,
+                      p: MatchParams, k: int, carry: TraceCarry):
+    """One chunk of B long traces with carried state.  px/py/times/valid:
+    [B, T]; carry leaves have leading [B].  Returns (CompactMatch, carry')."""
+    res, carry_out = jax.vmap(
+        match_trace, in_axes=(None, None, 0, 0, 0, 0, None, None, 0)
+    )(dg, du, px, py, times, valid, p, k, carry)
+    return _compact(res), carry_out
+
+
+def initial_carry_batch(b: int, k: int) -> TraceCarry:
+    """Inactive carry for a batch of b traces."""
+    one = TraceCarry.inactive(k)
+    return jax.tree_util.tree_map(lambda a: jnp.broadcast_to(a, (b,) + a.shape), one)
