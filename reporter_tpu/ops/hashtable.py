@@ -31,8 +31,11 @@ def ubodt_lookup(u: DeviceUBODT, src: jnp.ndarray, dst: jnp.ndarray):
     """Vectorised probe.  src/dst: any (broadcastable) int32 shape.
 
     Returns (dist, time, first_edge): dist/time = +inf and first_edge = -1 on
-    miss.
+    miss.  When ``u.shard_axis`` is set the table leaves are local slot-range
+    slices inside a shard_map and the result is resolved with collectives.
     """
+    if u.shard_axis is not None:
+        return _ubodt_lookup_sharded(u, src, dst)
     h = device_pair_hash(src, dst, u.mask)
     dist = jnp.full(h.shape, jnp.inf, jnp.float32)
     time = jnp.full(h.shape, jnp.inf, jnp.float32)
@@ -47,4 +50,39 @@ def ubodt_lookup(u: DeviceUBODT, src: jnp.ndarray, dst: jnp.ndarray):
         time = jnp.where(hit, u.table_time[idx], time)
         first = jnp.where(hit, u.table_first_edge[idx], first)
         found = found | hit | (ts == -1)  # empty slot terminates the chain
+    return dist, time, first
+
+
+def _ubodt_lookup_sharded(u: DeviceUBODT, src: jnp.ndarray, dst: jnp.ndarray):
+    """Probe a slot-range-sharded table from inside a shard_map.
+
+    Each rank probes the global chain but only reads slots in its local
+    range; keys are unique, so at most one rank hits and a pmin/pmax over the
+    shard axis resolves every query exactly.  Communication is three small
+    collectives per lookup batch, riding the ICI — the table itself never
+    moves.  (Early-exit on empty slots is dropped: correctness comes from key
+    uniqueness, and a fixed probe count keeps the loop unrolled and fused.)
+    """
+    import jax
+
+    L = u.table_src.shape[0]  # local slice length
+    lo = jax.lax.axis_index(u.shard_axis) * L
+    h = device_pair_hash(src, dst, u.mask)
+    dist = jnp.full(h.shape, jnp.inf, jnp.float32)
+    time = jnp.full(h.shape, jnp.inf, jnp.float32)
+    first = jnp.full(h.shape, -1, jnp.int32)
+    for p in range(u.max_probes):
+        idx = (h + p) & u.mask
+        loc = idx - lo
+        inr = (loc >= 0) & (loc < L)
+        sl = jnp.where(inr, loc, 0)
+        ts = jnp.where(inr, u.table_src[sl], -2)  # -2 matches nothing
+        td = jnp.where(inr, u.table_dst[sl], -2)
+        hit = (ts == src) & (td == dst)
+        dist = jnp.where(hit, u.table_dist[sl], dist)
+        time = jnp.where(hit, u.table_time[sl], time)
+        first = jnp.where(hit, u.table_first_edge[sl], first)
+    dist = jax.lax.pmin(dist, u.shard_axis)
+    time = jax.lax.pmin(time, u.shard_axis)
+    first = jax.lax.pmax(first, u.shard_axis)
     return dist, time, first

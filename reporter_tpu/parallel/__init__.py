@@ -1,3 +1,17 @@
-from .mesh import make_mesh, sharded_match_fn, match_and_histogram
+from .mesh import (
+    graph_sharded_match_fn,
+    make_mesh,
+    make_mesh2,
+    match_and_histogram,
+    check_ubodt_shardable,
+    sharded_match_fn,
+)
 
-__all__ = ["make_mesh", "sharded_match_fn", "match_and_histogram"]
+__all__ = [
+    "graph_sharded_match_fn",
+    "make_mesh",
+    "make_mesh2",
+    "match_and_histogram",
+    "check_ubodt_shardable",
+    "sharded_match_fn",
+]

@@ -30,6 +30,7 @@ from ..tiles.arrays import DeviceGraph
 from ..tiles.ubodt import DeviceUBODT
 
 BATCH_AXIS = "dp"
+GRAPH_AXIS = "gp"
 
 
 def make_mesh(n_devices: Optional[int] = None, devices: Optional[Sequence] = None) -> Mesh:
@@ -45,6 +46,22 @@ def make_mesh(n_devices: Optional[int] = None, devices: Optional[Sequence] = Non
     import numpy as np
 
     return Mesh(np.asarray(devices), (BATCH_AXIS,))
+
+
+def make_mesh2(n_dp: int, n_gp: int, devices: Optional[Sequence] = None) -> Mesh:
+    """2-D mesh: batch ("dp") x graph-shard ("gp").  Lay gp innermost so the
+    per-probe pmin/pmax collectives ride adjacent-chip ICI links."""
+    if devices is None:
+        devices = jax.devices()
+    need = n_dp * n_gp
+    if need > len(devices):
+        raise ValueError(
+            "asked for a %dx%d mesh but only %d device(s) are visible"
+            % (n_dp, n_gp, len(devices))
+        )
+    import numpy as np
+
+    return Mesh(np.asarray(devices[:need]).reshape(n_dp, n_gp), (BATCH_AXIS, GRAPH_AXIS))
 
 
 class SegmentHistogram(NamedTuple):
@@ -136,3 +153,55 @@ def sharded_match_fn(mesh: Mesh, k: int, num_segments: int):
         in_shardings=(repl, repl, batched, batched, batched, batched, repl),
         out_shardings=(batched, repl),
     )
+
+
+def graph_sharded_match_fn(mesh: Mesh, k: int, num_segments: int):
+    """Graph-sharded variant for regions whose UBODT does not fit one chip's
+    HBM: the route-distance table is split in slot ranges over the "gp" mesh
+    axis (1/N of the table per chip) while the trace batch is sharded over
+    "dp".  Probes stay local to each gp rank and resolve with pmin/pmax over
+    the ICI (ops/hashtable._ubodt_lookup_sharded); Viterbi compute is
+    replicated across gp ranks of one dp shard — HBM scaling is the point,
+    matching how the reference scales tile extracts across machines rather
+    than fitting the planet in one process (SURVEY.md L0).
+
+    Returns a jitted (dg, du, px, py, times, valid, params) -> (MatchResult,
+    SegmentHistogram); du's table leaves must be length-divisible by the gp
+    axis size (check_ubodt_shardable).
+    """
+
+    def body(dg, du, px, py, times, valid, p):
+        du_local = du.with_shard_axis(GRAPH_AXIS)
+        res, hist = match_and_histogram(
+            dg, du_local, px, py, times, valid, p, k, num_segments
+        )
+        # full-batch histogram: reduce over the batch shards; gp ranks hold
+        # identical values already (same rows, same decode)
+        hist = jax.tree_util.tree_map(
+            lambda a: jax.lax.psum(a, BATCH_AXIS), hist
+        )
+        return res, hist
+
+    # pytree-prefix specs: one spec covers every leaf of that argument/result
+    sm = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(P(), P(GRAPH_AXIS), P(BATCH_AXIS), P(BATCH_AXIS),
+                  P(BATCH_AXIS), P(BATCH_AXIS), P()),
+        out_specs=(P(BATCH_AXIS), P()),
+        check_vma=False,
+    )
+    return jax.jit(sm)
+
+
+def check_ubodt_shardable(ubodt, n_gp: int):
+    """The sharded probe slices the table into n_gp equal slot ranges; the
+    power-of-two table size must divide evenly (it does whenever n_gp is a
+    power of two <= size).  Returns the table unchanged."""
+    size = len(ubodt.table_src)
+    if size % n_gp:
+        raise ValueError(
+            "UBODT table size %d not divisible by gp=%d (use a power-of-two "
+            "gp axis)" % (size, n_gp)
+        )
+    return ubodt

@@ -49,11 +49,16 @@ def pair_hash(src, dst, mask):
 
 
 class DeviceUBODT:
-    """Pytree whose table arrays are leaves and whose (mask, max_probes) are
-    static aux data, so probe loops unroll at trace time."""
+    """Pytree whose table arrays are leaves and whose (mask, max_probes,
+    shard_axis) are static aux data, so probe loops unroll at trace time.
+
+    ``shard_axis`` names a mesh axis when the table arrays are 1/N slot-range
+    slices inside a shard_map (parallel/mesh.py graph sharding): the device
+    prober then masks probes to the local slot range and resolves hits with
+    pmin/pmax collectives over that axis.  None = whole table resident."""
 
     def __init__(self, table_src, table_dst, table_dist, table_time, table_first_edge,
-                 mask: int, max_probes: int):
+                 mask: int, max_probes: int, shard_axis=None):
         self.table_src = table_src
         self.table_dst = table_dst
         self.table_dist = table_dist
@@ -61,11 +66,18 @@ class DeviceUBODT:
         self.table_first_edge = table_first_edge
         self.mask = int(mask)
         self.max_probes = int(max_probes)
+        self.shard_axis = shard_axis
+
+    def with_shard_axis(self, axis: str) -> "DeviceUBODT":
+        return DeviceUBODT(
+            self.table_src, self.table_dst, self.table_dist, self.table_time,
+            self.table_first_edge, self.mask, self.max_probes, shard_axis=axis,
+        )
 
     def tree_flatten(self):
         return (
             (self.table_src, self.table_dst, self.table_dist, self.table_time, self.table_first_edge),
-            (self.mask, self.max_probes),
+            (self.mask, self.max_probes, self.shard_axis),
         )
 
     @classmethod

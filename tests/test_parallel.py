@@ -81,3 +81,51 @@ def test_histogram_semantics(setup):
     # visited segment once, so no count can exceed the number of traces
     tc = np.asarray(hist.trace_count)
     assert tc.max() == 1.0 and tc.sum() >= 1.0
+
+
+def test_graph_sharded_matches_unsharded(setup):
+    """UBODT sharded over gp: decode and histogram must agree with the
+    single-device path (probes resolve exactly via pmin/pmax)."""
+    import jax
+    import jax.numpy as jnp
+
+    from reporter_tpu.ops.viterbi import MatchParams
+    from reporter_tpu.parallel import (
+        graph_sharded_match_fn,
+        make_mesh2,
+        match_and_histogram,
+        check_ubodt_shardable,
+    )
+
+    arrays, ubodt = setup
+    cfg = MatcherConfig()
+    p = MatchParams.from_config(cfg)
+    dg = arrays.to_device()
+    du = check_ubodt_shardable(ubodt, 4).to_device()
+    S = len(arrays.seg_ids)
+
+    px, py, times, valid = make_batch(arrays, B=8, T=12)
+    args = tuple(jnp.asarray(a) for a in (px, py, times, valid))
+
+    mesh = make_mesh2(2, 4)
+    fn = graph_sharded_match_fn(mesh, K, S)
+    res_s, hist_s = fn(dg, du, *args, p)
+
+    res_r, hist_r = jax.jit(
+        match_and_histogram, static_argnums=(7, 8)
+    )(dg, du, *args, p, K, S)
+
+    np.testing.assert_array_equal(np.asarray(res_s.idx), np.asarray(res_r.idx))
+    np.testing.assert_array_equal(np.asarray(res_s.breaks), np.asarray(res_r.breaks))
+    for a, b in zip(hist_s, hist_r):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-5)
+
+
+def test_graph_sharded_rejects_bad_axis(setup):
+    from reporter_tpu.parallel import check_ubodt_shardable
+
+    arrays, ubodt = setup
+    size = len(ubodt.table_src)
+    bad = 3 if size % 3 else 5
+    with pytest.raises(ValueError):
+        check_ubodt_shardable(ubodt, bad)
