@@ -35,6 +35,9 @@ class MatcherConfig:
     # arrays, so the binding bound is on points (B*T), with a row cap on top
     max_device_batch: int = 2048
     max_device_points: int = 2048 * 64
+    # pallas Viterbi forward (ops/viterbi_pallas.py): None = auto (TPU with
+    # beam_k == 8), True/False = force.  $REPORTER_PALLAS overrides.
+    use_pallas: Optional[bool] = None
     # report() business-logic default (reporter_service.py:54-58)
     threshold_sec: int = 15
     mode: str = "auto"

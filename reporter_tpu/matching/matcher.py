@@ -30,6 +30,13 @@ from .config import MatcherConfig
 log = logging.getLogger(__name__)
 
 
+def _pad_rows(pad: int, *arrays):
+    """Append ``pad`` all-zero (= all-invalid) rows to each [B, ...] array."""
+    return tuple(
+        np.concatenate([a, np.zeros((pad,) + a.shape[1:], a.dtype)]) for a in arrays
+    )
+
+
 class SegmentMatcher:
     def __init__(
         self,
@@ -64,6 +71,8 @@ class SegmentMatcher:
     # -- backends ----------------------------------------------------------
 
     def _init_jax(self):
+        import os
+
         import jax
 
         from ..ops.viterbi import MatchParams, match_batch_carry, match_batch_compact
@@ -71,8 +80,31 @@ class SegmentMatcher:
         self._dg = self.arrays.to_device()
         self._du = self.ubodt.to_device()
         self._params = MatchParams.from_config(self.cfg)
-        self._jit_match_compact = jax.jit(match_batch_compact, static_argnums=(7,))
         self._jit_match_carry = jax.jit(match_batch_carry, static_argnums=(7,))
+
+        use_pallas = self.cfg.use_pallas
+        env = os.environ.get("REPORTER_PALLAS", "").strip().lower()
+        if env:
+            use_pallas = env not in ("0", "false", "no", "off")
+        if use_pallas is None:  # auto: the kernel is specialised for K == 8
+            use_pallas = (
+                jax.devices()[0].platform == "tpu" and self.cfg.beam_k == 8
+            )
+        self._pallas = bool(use_pallas) and self.cfg.beam_k == 8
+        if self._pallas:
+            from ..ops.viterbi_pallas import match_batch_compact_pallas
+
+            # off-TPU (forced-on for tests) the kernel runs interpreted
+            interp = jax.devices()[0].platform != "tpu"
+
+            def _compact_pallas(dg, du, px, py, tm, v, p, k):
+                return match_batch_compact_pallas(
+                    dg, du, px, py, tm, v, p, k, interpret=interp
+                )
+
+            self._jit_match_compact = jax.jit(_compact_pallas, static_argnums=(7,))
+        else:
+            self._jit_match_compact = jax.jit(match_batch_compact, static_argnums=(7,))
 
     def _init_cpu(self):
         from ..baseline.cpu_matcher import CPUViterbiMatcher
@@ -84,13 +116,24 @@ class SegmentMatcher:
         if self.backend == "jax":
             import jax.numpy as jnp
 
+            B = px.shape[0]
+            if getattr(self, "_pallas", False) and B % 128:
+                # the pallas forward needs a lane-width batch multiple; pad
+                # with all-invalid rows and slice off below
+                px, py, times, valid = _pad_rows(
+                    128 - B % 128, px, py, times, valid
+                )
             res = self._jit_match_compact(
                 self._dg, self._du,
                 jnp.asarray(px, jnp.float32), jnp.asarray(py, jnp.float32),
                 jnp.asarray(times, jnp.float32),
                 jnp.asarray(valid, bool), self._params, self.cfg.beam_k,
             )
-            return np.asarray(res.edge), np.asarray(res.offset), np.asarray(res.breaks)
+            return (
+                np.asarray(res.edge)[:B],
+                np.asarray(res.offset)[:B],
+                np.asarray(res.breaks)[:B],
+            )
         else:
             return self._cpu.run_batch(px, py, times, valid)
 
@@ -180,9 +223,7 @@ class SegmentMatcher:
             B_pad <<= 1
         if B_pad == B:
             return px, py, tm, valid
-        pad = B_pad - B
-        z = lambda a: np.concatenate([a, np.zeros((pad,) + a.shape[1:], a.dtype)])
-        return z(px), z(py), z(tm), z(valid)
+        return _pad_rows(B_pad - B, px, py, tm, valid)
 
     def _associate_and_store(self, idxs, edge, offset, breaks, times, results):
         """Wire-format association for B rows (edge may carry pow2 pad rows;

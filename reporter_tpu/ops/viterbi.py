@@ -174,7 +174,11 @@ def match_trace(dg: DeviceGraph, du: DeviceUBODT, px, py, times, valid, p: Match
     """Match one trace of T (padded) points.  px/py/times/valid: [T].
     vmap over batch.  With ``carry`` (static presence), the first step
     transitions from the carried candidate beam instead of restarting, and
-    the updated carry is returned: (MatchResult, TraceCarry)."""
+    the updated carry is returned: (MatchResult, TraceCarry).
+
+    ``valid`` must be a contiguous True-prefix (all-False allowed): padding
+    lives only at trace tails; traces with interior gaps are split host-side
+    (the reference's inactivity-gap split, simple_reporter.py:149-163)."""
     T = px.shape[0]
     cand = find_candidates_batch(dg, px, py, k, p.search_radius)  # [T, K]
 
@@ -246,30 +250,7 @@ def match_trace(dg: DeviceGraph, du: DeviceUBODT, px, py, times, valid, p: Match
     breaks = jnp.concatenate([first_break[None], all_broke], axis=0) & valid
     route_in = jnp.concatenate([first_route[None], all_route], axis=0)  # [T, K]
 
-    # ----- backtrace (reverse scan) -----
-    # segment boundaries: step t is a segment start if breaks[t]; padded steps
-    # pass the chain through untouched.
-    def back(carry, inputs):
-        nxt_idx = carry  # chosen slot at t+1, or -1
-        scores_t, backptr_next, valid_next, valid_t = inputs
-        # if next step is padded or unmatched: choose local argmax (chain restart)
-        local = jnp.argmax(scores_t)
-        local = jnp.where(scores_t[local] > NEG_INF / 2, local, -1)
-        from_next = jnp.where(nxt_idx >= 0, backptr_next[jnp.where(nxt_idx >= 0, nxt_idx, 0)], -1)
-        idx_t = jnp.where(valid_next & (nxt_idx >= 0) & (from_next >= 0), from_next, local)
-        idx_t = jnp.where(valid_t, idx_t, -1)
-        return idx_t, idx_t
-
-    last_local = jnp.argmax(scores_mat[T - 1])
-    last_idx = jnp.where((scores_mat[T - 1, last_local] > NEG_INF / 2) & valid[T - 1], last_local, -1)
-    ys = (
-        scores_mat[: T - 1][::-1],
-        backptr[1:][::-1],
-        valid[1:][::-1],
-        valid[: T - 1][::-1],
-    )
-    _, idx_rev = jax.lax.scan(back, last_idx, ys)
-    idx = jnp.concatenate([idx_rev[::-1], last_idx[None]], axis=0)  # [T]
+    idx = backtrace(scores_mat, backptr, valid)  # [T]
 
     chosen_score = jnp.take_along_axis(scores_mat, jnp.maximum(idx, 0)[:, None], axis=1)[:, 0]
     chosen_score = jnp.where(idx >= 0, chosen_score, NEG_INF)
@@ -314,6 +295,35 @@ def match_trace(dg: DeviceGraph, du: DeviceUBODT, px, py, times, valid, p: Match
         committed=jnp.where(any_valid, idx[safe_last], jnp.int32(-1)).astype(jnp.int32),
     )
     return result, carry_out
+
+
+def backtrace(scores_mat: jnp.ndarray, backptr: jnp.ndarray, valid: jnp.ndarray) -> jnp.ndarray:
+    """Reverse scan over stored backpointers for one trace.  scores_mat/backptr
+    [T, K], valid [T] -> chosen slot per point [T] (-1 unmatched).  Segment
+    boundaries: padded or unmatched successors restart the chain at the local
+    argmax.  Shared by the lax.scan forward and the pallas forward."""
+    T = scores_mat.shape[0]
+
+    def back(carry, inputs):
+        nxt_idx = carry  # chosen slot at t+1, or -1
+        scores_t, backptr_next, valid_next, valid_t = inputs
+        local = jnp.argmax(scores_t)
+        local = jnp.where(scores_t[local] > NEG_INF / 2, local, -1)
+        from_next = jnp.where(nxt_idx >= 0, backptr_next[jnp.where(nxt_idx >= 0, nxt_idx, 0)], -1)
+        idx_t = jnp.where(valid_next & (nxt_idx >= 0) & (from_next >= 0), from_next, local)
+        idx_t = jnp.where(valid_t, idx_t, -1)
+        return idx_t, idx_t
+
+    last_local = jnp.argmax(scores_mat[T - 1])
+    last_idx = jnp.where((scores_mat[T - 1, last_local] > NEG_INF / 2) & valid[T - 1], last_local, -1)
+    ys = (
+        scores_mat[: T - 1][::-1],
+        backptr[1:][::-1],
+        valid[1:][::-1],
+        valid[: T - 1][::-1],
+    )
+    _, idx_rev = jax.lax.scan(back, last_idx, ys)
+    return jnp.concatenate([idx_rev[::-1], last_idx[None]], axis=0)  # [T]
 
 
 def match_batch(dg: DeviceGraph, du: DeviceUBODT, px, py, times, valid, p: MatchParams, k: int) -> MatchResult:

@@ -1,0 +1,236 @@
+"""Pallas TPU kernel for the Viterbi forward recursion.
+
+The lax.scan forward in ops/viterbi.py launches T-1 tiny [K]x[K,K] max-plus
+steps per trace; under vmap each step is a [B, K] x [B, K, K] contraction —
+small, sequential, and launch/latency-bound on TPU.  This kernel runs the
+whole recursion on-chip: a (B/128, T-1) grid streams the per-step transition
+blocks HBM->VMEM (auto double-buffered by the pipeline), the running scores
+live in a VMEM scratch tile that persists across the T axis of the grid, and
+one grid step does the full 128-trace max-plus tournament as [K*K=64, 128]
+VPU ops (lanes = traces, sublanes = flattened src-major (src, dst) pairs).
+One-hot MXU matmuls implement the repeat/tile broadcasts.
+
+Semantics are bit-compatible with the scan path (tests diff them exactly):
+step validity and breakage-distance are folded into the inputs by
+``_fold_masks`` (invalid step -> identity transition + zero emission =
+freeze; too-far step -> all-NEG_INF transition = restart), so the kernel
+itself is a pure max-plus recursion.  Restricted to beam K == 8 (the f32
+sublane tile); other K falls back to the scan path.
+
+Reference boundary: this replaces the Meili Viterbi decode hot loop
+(reporter_service.py:240 Match()) -- see ops/viterbi.py for the HMM model.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ..tiles.arrays import DeviceGraph
+from ..tiles.ubodt import DeviceUBODT
+from .candidates import find_candidates_batch
+from .viterbi import (
+    NEG_INF,
+    MatchParams,
+    MatchResult,
+    backtrace,
+    transition_matrix,
+)
+
+BLK = 128  # traces per block (the lane width)
+K = 8  # beam width this kernel is specialised for (f32 sublane tile)
+
+
+def _viterbi_fwd_kernel(emis0_ref, logp_ref, route_ref, emis_ref,
+                        scores_out_ref, backptr_ref, route_out_ref,
+                        scores_ref):
+    """One (b_block, t) grid step: scores[K, BLK] -> scores'[K, BLK]."""
+    t = pl.program_id(1)
+
+    @pl.when(t == 0)
+    def _():
+        scores_ref[:] = emis0_ref[:]
+
+    scores = scores_ref[:]  # [K, BLK]
+    logp = logp_ref[0]  # [K*K, BLK], row r = src*K + dst
+    route = route_ref[0]  # [K*K, BLK]
+    emis_t = emis_ref[0]  # [K, BLK]
+
+    # rep[r] = scores[r // K]: repeat-each-K via a constant one-hot matmul
+    rows = lax.broadcasted_iota(jnp.int32, (K * K, K), 0)
+    cols = lax.broadcasted_iota(jnp.int32, (K * K, K), 1)
+    oh_rep = (rows // K == cols).astype(jnp.float32)  # [K*K, K]
+    rep = jnp.dot(oh_rep, scores, preferred_element_type=jnp.float32)
+
+    total = rep + logp  # [K*K, BLK]
+    src_of_row = lax.broadcasted_iota(jnp.int32, (K * K, BLK), 0) // K
+
+    # max/argmax over src by tournament halving (src-major rows: top half =
+    # lower src of the pair, same dst pattern).  Tie-break on the carried src
+    # index, not bracket position, to reproduce jnp.argmax's lowest-index
+    # rule exactly (brackets interleave, so >= alone would diverge on ties).
+    vals, idx = total, src_of_row
+    h = K * K
+    while h > K:
+        h //= 2
+        top_v, bot_v = vals[:h], vals[h:]
+        top_i, bot_i = idx[:h], idx[h:]
+        keep = (top_v > bot_v) | ((top_v == bot_v) & (top_i < bot_i))
+        vals = jnp.where(keep, top_v, bot_v)
+        idx = jnp.where(keep, top_i, bot_i)
+    best_val, best_src = vals, idx  # [K, BLK], rows = dst
+
+    connected = best_val > NEG_INF / 2
+    any_conn = jnp.max(connected.astype(jnp.float32), axis=0, keepdims=True)
+    broke = any_conn < 0.5  # [1, BLK]
+
+    new_scores = jnp.where(broke, emis_t, best_val + emis_t)
+    backptr = jnp.where(broke | ~connected, -1, best_src)
+
+    # route_sel[dst] = route[best_src[dst]*K + dst]: tile best_src to rows
+    # (tiled[r] = best_src[r % K]) with a one-hot matmul, mask, max-reduce
+    oh_tile = (rows % K == cols).astype(jnp.float32)  # [K*K, K]
+    tiled_best = jnp.dot(oh_tile, best_src.astype(jnp.float32),
+                         preferred_element_type=jnp.float32)
+    mask = src_of_row.astype(jnp.float32) == tiled_best
+    rvals = jnp.where(mask, route, -jnp.inf)
+    h = K * K
+    while h > K:
+        h //= 2
+        rvals = jnp.maximum(rvals[:h], rvals[h:])
+    route_sel = rvals  # [K, BLK]
+
+    scores_ref[:] = new_scores
+    scores_out_ref[0] = new_scores
+    backptr_ref[0] = backptr
+    route_out_ref[0] = route_sel
+
+
+def _fold_masks(logp_all, emis, gc, valid, k, p):
+    """Fold step validity and breakage distance into the kernel inputs.
+
+    invalid step  -> identity transition + zero emission (scores freeze)
+    too-far step  -> all-NEG_INF transition (forces a restart)
+    """
+    far = gc > p.breakage_distance  # [B, T-1]
+    logp_all = jnp.where(far[..., None, None], NEG_INF, logp_all)
+    eye = jnp.where(jnp.eye(k, dtype=bool), 0.0, NEG_INF)
+    valid_t = valid[:, 1:]
+    logp_all = jnp.where(valid_t[..., None, None], logp_all, eye)
+    emis_in = jnp.where(valid[..., None], emis, 0.0)
+    return logp_all, emis_in
+
+
+def viterbi_forward_pallas(logp_all, route_all, emis_in, interpret=False):
+    """logp_all/route_all [B, T-1, K, K] (masks already folded), emis_in
+    [B, T, K] -> (scores [B, T-1, K], backptr [B, T-1, K], route_sel
+    [B, T-1, K]).  B must be a BLK multiple (caller pads)."""
+    B, Tm1 = logp_all.shape[0], logp_all.shape[1]
+    k = logp_all.shape[2]
+    assert k == K, "pallas forward is specialised for beam K == 8"
+    assert B % BLK == 0
+
+    logp_k = logp_all.transpose(1, 2, 3, 0).reshape(Tm1, K * K, B)
+    route_k = route_all.transpose(1, 2, 3, 0).reshape(Tm1, K * K, B)
+    emis_t = emis_in[:, 1:].transpose(1, 2, 0)  # [T-1, K, B]
+    emis0 = emis_in[:, 0].transpose(1, 0)  # [K, B]
+
+    grid = (B // BLK, Tm1)
+    out_shape = [
+        jax.ShapeDtypeStruct((Tm1, K, B), jnp.float32),  # scores
+        jax.ShapeDtypeStruct((Tm1, K, B), jnp.int32),  # backptr
+        jax.ShapeDtypeStruct((Tm1, K, B), jnp.float32),  # route_sel
+    ]
+    step_spec = lambda rows: pl.BlockSpec((1, rows, BLK), lambda b, t: (t, 0, b))
+    scores, backptr, route_sel = pl.pallas_call(
+        _viterbi_fwd_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((K, BLK), lambda b, t: (0, b)),  # emis0
+            step_spec(K * K),  # logp
+            step_spec(K * K),  # route
+            step_spec(K),  # emis_t
+        ],
+        out_specs=[step_spec(K), step_spec(K), step_spec(K)],
+        out_shape=out_shape,
+        scratch_shapes=[pltpu.VMEM((K, BLK), jnp.float32)],
+        interpret=interpret,
+    )(emis0, logp_k, route_k, emis_t)
+
+    return (
+        scores.transpose(2, 0, 1),
+        backptr.transpose(2, 0, 1),
+        route_sel.transpose(2, 0, 1),
+    )
+
+
+def match_batch_pallas(dg: DeviceGraph, du: DeviceUBODT, px, py, times, valid,
+                       p: MatchParams, k: int, interpret: bool = False) -> MatchResult:
+    """Drop-in for ops.viterbi.match_batch with the forward recursion on the
+    pallas kernel.  px/py/times/valid: [B, T], B a multiple of 128 (the
+    matcher pads); identical results to the scan path.
+
+    ``valid`` rows must be contiguous True-prefixes (all-False allowed) —
+    the contract of every kernel path here: padding exists only at trace
+    tails, and traces with interior gaps are split host-side before
+    matching, mirroring the reference's inactivity-gap splitting
+    (simple_reporter.py:149-163).  Interior holes are undefined behavior in
+    both the scan and pallas paths (the scan's frozen scores would pair with
+    the hole point's garbage candidates on exit)."""
+    B, T = px.shape
+    cand = find_candidates_batch(dg, px, py, k, p.search_radius)  # [B, T, K]
+
+    emis = -0.5 * jnp.square(cand.dist / p.sigma_z)
+    emis = jnp.where(jnp.isfinite(cand.dist), emis, NEG_INF)
+    emis = jnp.where(valid[..., None], emis, NEG_INF)
+
+    gc = jnp.hypot(px[:, 1:] - px[:, :-1], py[:, 1:] - py[:, :-1])  # [B, T-1]
+    dts = times[:, 1:] - times[:, :-1]
+
+    src_c = jax.tree_util.tree_map(lambda a: a[:, :-1], cand)
+    dst_c = jax.tree_util.tree_map(lambda a: a[:, 1:], cand)
+    tm_b = jax.vmap(transition_matrix, in_axes=(None, None, 0, 0, 0, 0, None))
+    logp_all, route_all = jax.vmap(tm_b, in_axes=(None, None, 0, 0, 0, 0, None))(
+        dg, du, src_c, dst_c, gc, dts, p
+    )  # [B, T-1, K, K]
+
+    logp_in, emis_in = _fold_masks(logp_all, emis, gc, valid, k, p)
+    scores, kernel_bp, route_sel = viterbi_forward_pallas(
+        logp_in, route_all, emis_in, interpret=interpret
+    )
+
+    valid_t = valid[:, 1:]  # [B, T-1]
+    backptr_t = jnp.where(valid_t[..., None], kernel_bp, -2)
+    broke_t = jnp.all(kernel_bp == -1, axis=-1) & valid_t
+    route_t = jnp.where(kernel_bp >= 0, route_sel, jnp.inf)
+
+    scores_mat = jnp.concatenate([emis[:, :1], scores], axis=1)  # [B, T, K]
+    backptr = jnp.concatenate(
+        [jnp.full((B, 1, k), -1, backptr_t.dtype), backptr_t], axis=1
+    )
+    breaks = jnp.concatenate(
+        [jnp.ones((B, 1), bool), broke_t], axis=1
+    ) & valid
+    route_in = jnp.concatenate([jnp.full((B, 1, k), jnp.inf), route_t], axis=1)
+
+    idx = jax.vmap(backtrace)(scores_mat, backptr, valid)  # [B, T]
+
+    chosen_score = jnp.take_along_axis(scores_mat, jnp.maximum(idx, 0)[..., None], axis=2)[..., 0]
+    chosen_score = jnp.where(idx >= 0, chosen_score, NEG_INF)
+    chosen_route = jnp.take_along_axis(route_in, jnp.maximum(idx, 0)[..., None], axis=2)[..., 0]
+    chosen_route = jnp.where((idx >= 0) & ~breaks, chosen_route, jnp.inf)
+
+    return MatchResult(cand=cand, idx=idx, breaks=breaks,
+                       route_dist=chosen_route, score=chosen_score)
+
+
+def match_batch_compact_pallas(dg, du, px, py, times, valid, p, k,
+                               interpret: bool = False):
+    """Pallas forward + on-device gather of the chosen candidate per point."""
+    from .viterbi import _compact
+
+    res = match_batch_pallas(dg, du, px, py, times, valid, p, k, interpret=interpret)
+    return _compact(res)

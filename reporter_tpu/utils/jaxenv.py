@@ -41,10 +41,17 @@ def ensure_platform(platforms: Optional[str] = None) -> str:
         from jax._src import xla_bridge
 
         factories = getattr(xla_bridge, "_backend_factories", None)
+        aliases = getattr(xla_bridge, "_platform_aliases", None)
         if isinstance(factories, dict):
             for name in list(factories):
                 if name not in allowed:
                     factories.pop(name, None)
+                    # keep the platform *name* known: MLIR lowering-rule
+                    # registration (e.g. importing pallas TPU for interpret
+                    # mode on CPU) validates against known_platforms(), which
+                    # unions factory names with alias values
+                    if isinstance(aliases, dict) and name not in aliases:
+                        aliases[name] = name
                     log.debug("dropped jax backend factory %r (not in %s)", name, sorted(allowed))
     except Exception:  # pragma: no cover - internal API drift
         log.warning("could not prune jax backend factories", exc_info=True)

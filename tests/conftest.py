@@ -32,6 +32,10 @@ try:  # defensive: internal API
     for _name in list(getattr(xla_bridge, "_backend_factories", {})):
         if _name != "cpu":
             xla_bridge._backend_factories.pop(_name, None)
+            # keep the platform name known: pallas-TPU interpret-mode tests
+            # import lowering registrations that validate known_platforms()
+            if _name not in xla_bridge._platform_aliases:
+                xla_bridge._platform_aliases[_name] = _name
 except Exception:  # pragma: no cover
     pass
 
