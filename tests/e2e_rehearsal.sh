@@ -1,0 +1,111 @@
+#!/usr/bin/env bash
+# Full-pipeline rehearsal, the tests/circle.sh equivalent (reference
+# tests/circle.sh:1-113): boot the matching service, replay probe records
+# through the stream runtime, and assert anonymised time-quantised tiles
+# land in the results dir; then run the batch pipeline over the same records
+# as an archive and assert its tiles too.  No Kafka/S3/docker needed -- the
+# stream runtime reads stdin and the batch archive is a local dir (the
+# transports are swappable; kafka_io adds the broker).
+#
+# Usage: tests/e2e_rehearsal.sh [workdir]
+set -euo pipefail
+
+cd "$(dirname "$0")/.."
+export PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}"
+export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+
+WORK="${1:-$(mktemp -d /tmp/reporter-e2e.XXXXXX)}"
+PORT=18021
+mkdir -p "$WORK/results" "$WORK/archive" "$WORK/batch_out"
+echo "rehearsal workdir: $WORK"
+
+# ---- config + synthetic probes -------------------------------------------
+cat > "$WORK/config.json" <<EOF
+{
+  "network": {"type": "grid", "rows": 8, "cols": 8, "spacing_m": 200},
+  "matcher": {"sigma_z": 4.07, "beta": 3.0, "search_radius": 50.0},
+  "backend": "jax",
+  "batch": {"max_batch": 64, "max_wait_ms": 5}
+}
+EOF
+
+python - "$WORK" <<'EOF'
+# probes as sv rows "uuid|epoch|lat|lon|acc", one file per vehicle in the
+# archive dir and one merged stream file
+import os, sys
+from reporter_tpu.utils.jaxenv import ensure_platform
+ensure_platform()
+from reporter_tpu.synth import TraceSynthesizer
+from reporter_tpu.tiles.arrays import build_graph_arrays
+from reporter_tpu.tiles.network import grid_city
+
+work = sys.argv[1]
+city = grid_city(rows=8, cols=8, spacing_m=200.0)  # == service config
+arrays = build_graph_arrays(city, cell_size=100.0)
+synth = TraceSynthesizer(arrays, seed=42)
+rows = []
+for i, s in enumerate(synth.batch(12, 30, dt=5.0, sigma=5.0)):
+    lines = [
+        "veh-%02d|%d|%.7f|%.7f|5" % (i, p["time"], p["lat"], p["lon"])
+        for p in s.trace["trace"]
+    ]
+    with open(os.path.join(work, "archive", "part-%02d.csv" % i), "w") as f:
+        f.write("\n".join(lines) + "\n")
+    rows.extend(lines)
+with open(os.path.join(work, "stream.sv"), "w") as f:
+    f.write("\n".join(rows) + "\n")
+print("wrote %d probe rows" % len(rows))
+EOF
+
+# ---- boot the matching service -------------------------------------------
+python -m reporter_tpu.serve "$WORK/config.json" "127.0.0.1:$PORT" \
+    > "$WORK/serve.log" 2>&1 &
+SERVE_PID=$!
+trap 'kill $SERVE_PID 2>/dev/null || true' EXIT
+
+UP=0
+for _ in $(seq 1 120); do
+    python - <<EOF && UP=1 && break || sleep 1
+import socket, sys
+s = socket.socket()
+s.settimeout(1)
+sys.exit(0 if s.connect_ex(("127.0.0.1", $PORT)) == 0 else 1)
+EOF
+done
+if [ "$UP" != 1 ]; then
+    echo "FAIL: service never started; tail of serve.log:"
+    tail -20 "$WORK/serve.log"
+    exit 1
+fi
+echo "service up (pid $SERVE_PID)"
+
+# ---- streaming path: stdin -> windows -> /report -> anonymised tiles -----
+python -m reporter_tpu.stream \
+    --format ',sv,\|,0,2,3,1,4' \
+    --reporter-url "http://127.0.0.1:$PORT/report" \
+    --privacy 1 --quantisation 3600 --flush-interval 5 \
+    --source RHRSL --output "$WORK/results" \
+    < "$WORK/stream.sv"
+
+TILES=$(find "$WORK/results" -type f | wc -l)
+echo "stream tiles written: $TILES"
+test "$TILES" -ge 1 || { echo "FAIL: no stream tiles"; exit 1; }
+for f in $(find "$WORK/results" -type f); do
+    test -s "$f" || { echo "FAIL: empty tile $f"; exit 1; }
+done
+
+# ---- batch path: archive dir -> 3 resumable phases -> tiles --------------
+python -m reporter_tpu.batch \
+    --src "$WORK/archive" \
+    --src-valuer 'lambda l: (lambda c: (c[0], c[1], c[2], c[3], c[4]))(l.split("|"))' \
+    --src-time-pattern '' \
+    --match-config "$WORK/config.json" \
+    --dest "dir:$WORK/batch_out" \
+    --privacy 1 --quantisation 3600 --source-id RHRSL \
+    --concurrency 1
+
+BTILES=$(find "$WORK/batch_out" -type f | wc -l)
+echo "batch tiles written: $BTILES"
+test "$BTILES" -ge 1 || { echo "FAIL: no batch tiles"; exit 1; }
+
+echo "e2e rehearsal OK (stream: $TILES tiles, batch: $BTILES tiles)"

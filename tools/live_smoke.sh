@@ -1,0 +1,30 @@
+#!/usr/bin/env bash
+# Live smoke test: replay recorded /report requests against a deployed
+# service URL with bounded parallelism, failing on any non-2xx/timeout --
+# the tests/live.sh equivalent (reference tests/live.sh:20-32).
+#
+# Usage: tools/live_smoke.sh <service_url> <requests.jsonl> [parallelism]
+#   requests.jsonl: one /report JSON body per line
+set -euo pipefail
+
+URL="${1:?usage: live_smoke.sh <service_url> <requests.jsonl> [parallelism]}"
+REQS="${2:?need a requests.jsonl file}"
+PAR="${3:-4}"
+
+post_one() {
+    curl -sf --max-time 3 --retry 3 -X POST \
+        -H 'Content-Type: application/json' \
+        --data-binary "$1" "$2/report" > /dev/null
+}
+export -f post_one
+
+COUNT=$(wc -l < "$REQS")
+echo "replaying $COUNT requests against $URL (parallelism $PAR)"
+# GNU parallel only -- moreutils' parallel shares the name but not the
+# syntax; the xargs fallback needs -d '\n' so JSON quotes survive
+if parallel --version 2>/dev/null | grep -q "GNU parallel"; then
+    parallel -j "$PAR" post_one {} "$URL" :::: "$REQS"
+else
+    xargs -d '\n' -P "$PAR" -I {} bash -c 'post_one "$@"' _ {} "$URL" < "$REQS"
+fi
+echo "live smoke OK: $COUNT requests served"
