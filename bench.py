@@ -101,7 +101,8 @@ def main():
     wall = time.time() - t0
     tps = n_traces * reps / wall
 
-    # kernel-only throughput for the curious
+    # kernel-only throughput: the same compact kernel the matcher dispatches
+    # (pallas on TPU, lax.scan elsewhere)
     import jax.numpy as jnp
 
     B = n_traces
@@ -114,18 +115,30 @@ def main():
         x, y = arrays.proj.to_xy([p["lat"] for p in pts], [p["lon"] for p in pts])
         px[i], py[i] = x, y
         tm[i] = np.asarray([p["time"] for p in pts]) - pts[0]["time"]
-    from reporter_tpu.ops.viterbi import MatchParams, match_batch
+    from reporter_tpu.ops.viterbi import match_batch
 
-    jit_match = jax.jit(match_batch, static_argnums=(7,))
+    from reporter_tpu.matching.matcher import _pad_rows
+
     dg, du, p = matcher._dg, matcher._du, matcher._params
+    jit_compact = matcher._jit_match_compact
+    if B % 128 and getattr(matcher, "_pallas", False):
+        px, py, tm, valid = _pad_rows(128 - B % 128, px, py, tm, valid)
     args = (dg, du, jnp.asarray(px), jnp.asarray(py), jnp.asarray(tm), jnp.asarray(valid), p)
-    jax.block_until_ready(jit_match(*args, cfg.beam_k))
+    jax.block_until_ready(jit_compact(*args, cfg.beam_k))
     t0 = time.time()
     for _ in range(reps):
-        res = jit_match(*args, cfg.beam_k)
-    jax.block_until_ready(res)
+        cres = jit_compact(*args, cfg.beam_k)
+    jax.block_until_ready(cres)
     kernel_tps = B * reps / (time.time() - t0)
-    sys.stderr.write("bench: kernel-only %.1f traces/s; end-to-end %.1f traces/s\n" % (kernel_tps, tps))
+    sys.stderr.write(
+        "bench: kernel-only %.1f traces/s (%s forward); end-to-end %.1f traces/s\n"
+        % (kernel_tps, "pallas" if getattr(matcher, "_pallas", False) else "scan", tps)
+    )
+
+    # decode for the agreement check below (full MatchResult, reference path)
+    jit_match = jax.jit(match_batch, static_argnums=(7,))
+    res = jit_match(dg, du, jnp.asarray(px[:B]), jnp.asarray(py[:B]),
+                    jnp.asarray(tm[:B]), jnp.asarray(valid[:B]), p, cfg.beam_k)
 
     # accuracy: segment agreement vs ground truth
     edge = np.asarray(res.idx)

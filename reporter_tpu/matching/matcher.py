@@ -29,6 +29,10 @@ from .config import MatcherConfig
 
 log = logging.getLogger(__name__)
 
+# chunks allowed in flight on the device while the host associates earlier
+# ones; each pins ~max_device_points of input + a [B, T] compact result
+PIPELINE_DEPTH = 3
+
 
 def _pad_rows(pad: int, *arrays):
     """Append ``pad`` all-zero (= all-invalid) rows to each [B, ...] array."""
@@ -111,15 +115,16 @@ class SegmentMatcher:
 
         self._cpu = CPUViterbiMatcher(self.arrays, self.ubodt, self.cfg)
 
-    def _run_batch(self, px: np.ndarray, py: np.ndarray, times: np.ndarray, valid: np.ndarray):
-        """[B, T] padded batch -> per-point (edge, offset, break) numpy arrays."""
+    def _dispatch_batch(self, px: np.ndarray, py: np.ndarray, times: np.ndarray, valid: np.ndarray):
+        """Queue one [B, T] padded batch on the backend without blocking.
+        Returns an opaque handle for _collect_batch."""
         if self.backend == "jax":
             import jax.numpy as jnp
 
             B = px.shape[0]
             if getattr(self, "_pallas", False) and B % 128:
                 # the pallas forward needs a lane-width batch multiple; pad
-                # with all-invalid rows and slice off below
+                # with all-invalid rows and slice off at collect
                 px, py, times, valid = _pad_rows(
                     128 - B % 128, px, py, times, valid
                 )
@@ -129,13 +134,23 @@ class SegmentMatcher:
                 jnp.asarray(times, jnp.float32),
                 jnp.asarray(valid, bool), self._params, self.cfg.beam_k,
             )
+            return ("jax", B, res)
+        return ("cpu", self._cpu.run_batch(px, py, times, valid))
+
+    def _collect_batch(self, handle):
+        """Block on a _dispatch_batch handle -> (edge, offset, break) numpy."""
+        if handle[0] == "jax":
+            _, B, res = handle
             return (
                 np.asarray(res.edge)[:B],
                 np.asarray(res.offset)[:B],
                 np.asarray(res.breaks)[:B],
             )
-        else:
-            return self._cpu.run_batch(px, py, times, valid)
+        return handle[1]
+
+    def _run_batch(self, px: np.ndarray, py: np.ndarray, times: np.ndarray, valid: np.ndarray):
+        """[B, T] padded batch -> per-point (edge, offset, break) numpy arrays."""
+        return self._collect_batch(self._dispatch_batch(px, py, times, valid))
 
     # -- public API --------------------------------------------------------
 
@@ -171,10 +186,28 @@ class SegmentMatcher:
             chunks.extend(
                 (blen, idxs[i : i + cap]) for i in range(0, len(idxs), cap)
             )
+        # pipeline: keep a few chunks in flight on the device (jax dispatch
+        # is async) so host association of chunk i overlaps device compute of
+        # the next ones.  Depth is bounded -- each in-flight chunk pins its
+        # input buffers on the device, so unbounded queueing would defeat the
+        # max_device_points HBM bound.
+        from collections import deque
+
+        pending: deque = deque()
+
+        def drain_one():
+            idxs_, handle_, times_ = pending.popleft()
+            edge, offset, breaks = self._collect_batch(handle_)
+            self._associate_and_store(idxs_, edge, offset, breaks, times_, results)
+
         for blen, idxs in chunks:
             px, py, tm, valid, times = self._fill_rows(traces, idxs, blen)
-            edge, offset, breaks = self._run_batch(*self._pad_pow2(px, py, tm, valid))
-            self._associate_and_store(idxs, edge, offset, breaks, times, results)
+            handle = self._dispatch_batch(*self._pad_pow2(px, py, tm, valid))
+            pending.append((idxs, handle, times))
+            if len(pending) > PIPELINE_DEPTH:
+                drain_one()
+        while pending:
+            drain_one()
         return results  # type: ignore[return-value]
 
     def _device_cap(self, blen: int) -> int:

@@ -34,6 +34,11 @@ def main(argv=None) -> int:
     ap.add_argument("--bootstrap", default=None, help="kafka bootstrap servers")
     ap.add_argument("--topic", default="raw")
     ap.add_argument("--duration", type=float, default=None, help="seconds to run")
+    ap.add_argument("--checkpoint", default=None,
+                    help="state snapshot file: restored at boot, written on "
+                         "an interval and at close (the Kafka state-store "
+                         "durability equivalent)")
+    ap.add_argument("--checkpoint-interval", type=float, default=60.0)
     args = ap.parse_args(argv)
 
     logging.basicConfig(
@@ -54,19 +59,33 @@ def main(argv=None) -> int:
         microbatch_size=args.microbatch,
     )
 
+    from .checkpoint import Checkpointer, load_file
+
+    ckpt = Checkpointer(pipeline, args.checkpoint, args.checkpoint_interval)
+    if args.checkpoint:
+        load_file(pipeline, args.checkpoint)
+
     if args.bootstrap:
         from .kafka_io import run_pipeline
 
         run_pipeline(
-            pipeline, args.topic, args.bootstrap, duration_sec=args.duration
+            pipeline, args.topic, args.bootstrap, duration_sec=args.duration,
+            on_tick=ckpt.maybe_save,
+            # coordinate offset commits with snapshots so a crash replays
+            # from the restored state instead of dropping the gap
+            manual_commit=bool(args.checkpoint),
         )
+        ckpt.save()
     else:
         start = time.time()
         for line in sys.stdin:
-            pipeline.feed(line.rstrip("\n"), int(time.time() * 1000))
+            now_ms = int(time.time() * 1000)
+            pipeline.feed(line.rstrip("\n"), now_ms)
+            ckpt.maybe_save(now_ms)
             if args.duration is not None and time.time() - start > args.duration:
                 break
         pipeline.close(int(time.time() * 1000))
+        ckpt.save()
     return 0
 
 

@@ -1,0 +1,140 @@
+"""Stream-state checkpoint/restore.
+
+The reference's streaming state — in-flight per-vehicle batches and the
+anonymiser's tile slices — lives in Kafka Streams state stores and survives
+restarts via changelog topics (SURVEY.md §5 checkpoint/resume:
+BatchingProcessor.java:20-22, AnonymisingProcessor.java:47-59).  This
+framework's stream runtime is broker-agnostic (stdin or Kafka transport), so
+durability is a local snapshot instead: the same binary serdes the wire
+format uses (Batch.pack / Segment.pack, the Batch.java:92-146 and
+Segment.java:76-129 layouts) wrapped in a JSON envelope, written atomically.
+
+Wire-up: ``python -m reporter_tpu.stream --checkpoint state.ckpt
+[--checkpoint-interval 60]`` restores at boot when the file exists and
+snapshots on every interval tick and at close.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import logging
+import os
+from typing import Optional
+
+from .batch import Batch
+from .segment import pack_list, unpack_list
+
+log = logging.getLogger(__name__)
+
+VERSION = 1
+
+
+def _b64(data: bytes) -> str:
+    return base64.b64encode(data).decode()
+
+
+def _unb64(s: str) -> bytes:
+    return base64.b64decode(s.encode())
+
+
+def snapshot(pipeline) -> dict:
+    """Serialise a StreamPipeline's mutable state."""
+    batcher = pipeline.batcher
+    anon = pipeline.anonymiser
+    return {
+        "version": VERSION,
+        "formatted": pipeline.formatted,
+        "dropped": pipeline.dropped,
+        "batcher": {
+            "store": {k: _b64(b.pack()) for k, b in batcher.store.items()},
+            "ready": list(batcher._ready),
+            "reported_pairs": batcher.reported_pairs,
+        },
+        "anonymiser": {
+            "map": [[list(tile), idx] for tile, idx in anon.map.items()],
+            "slices": {name: _b64(pack_list(segs)) for name, segs in anon.slices.items()},
+            "last_flush_ms": anon._last_flush_ms,
+            "tiles_flushed": anon.tiles_flushed,
+        },
+    }
+
+
+def restore(pipeline, state: dict) -> None:
+    """Load a snapshot into a freshly-built StreamPipeline (in place)."""
+    if state.get("version") != VERSION:
+        raise ValueError("unsupported checkpoint version %r" % (state.get("version"),))
+    pipeline.formatted = int(state.get("formatted", 0))
+    pipeline.dropped = int(state.get("dropped", 0))
+
+    b = state.get("batcher", {})
+    batcher = pipeline.batcher
+    batcher.store = {k: Batch.unpack(_unb64(v)) for k, v in b.get("store", {}).items()}
+    batcher._ready = [k for k in b.get("ready", []) if k in batcher.store]
+    batcher.reported_pairs = int(b.get("reported_pairs", 0))
+
+    a = state.get("anonymiser", {})
+    anon = pipeline.anonymiser
+    anon.map = {tuple(tile): int(idx) for tile, idx in a.get("map", [])}
+    anon.slices = {
+        name: unpack_list(_unb64(v)) for name, v in a.get("slices", {}).items()
+    }
+    anon._last_flush_ms = a.get("last_flush_ms")
+    anon.tiles_flushed = int(a.get("tiles_flushed", 0))
+
+
+def save_file(pipeline, path: str) -> None:
+    """Atomic snapshot-to-disk (tmp + rename)."""
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(snapshot(pipeline), f, separators=(",", ":"))
+    os.replace(tmp, path)
+    log.debug("checkpointed stream state to %s", path)
+
+
+def load_file(pipeline, path: str) -> bool:
+    """Restore from ``path`` if it exists.  Returns True when state was
+    loaded."""
+    if not os.path.exists(path):
+        return False
+    with open(path) as f:
+        state = json.load(f)
+    restore(pipeline, state)
+    log.info(
+        "restored stream state from %s: %d in-flight vehicles, %d tile slices",
+        path, len(pipeline.batcher.store), len(pipeline.anonymiser.slices),
+    )
+    return True
+
+
+class Checkpointer:
+    """Interval-driven snapshots for the stream CLI loop."""
+
+    def __init__(self, pipeline, path: Optional[str], interval_sec: float = 60.0):
+        self.pipeline = pipeline
+        self.path = path
+        self.interval_ms = int(interval_sec * 1000)
+        self._last_ms: Optional[int] = None
+
+    def maybe_save(self, timestamp_ms: int) -> bool:
+        """Snapshot if the interval elapsed.  Returns True when a snapshot
+        landed (the Kafka loop commits offsets only then)."""
+        if not self.path:
+            return False
+        if self._last_ms is None or timestamp_ms - self._last_ms >= self.interval_ms:
+            self._last_ms = timestamp_ms
+            return self.save()
+        return False
+
+    def save(self) -> bool:
+        """Best-effort: a failed snapshot (full disk, lost mount) must not
+        take the stream down -- log and keep running, like the anonymiser's
+        store failures."""
+        if not self.path:
+            return False
+        try:
+            save_file(self.pipeline, self.path)
+            return True
+        except OSError:
+            log.exception("stream checkpoint to %s failed; continuing", self.path)
+            return False

@@ -84,15 +84,24 @@ def run_pipeline(
     group: str = "reporter-tpu",
     duration_sec: Optional[float] = None,
     tick_sec: float = 30.0,
+    on_tick: Optional[Callable[[int], None]] = None,
+    manual_commit: bool = False,
 ) -> None:
     """Consume a raw topic and drive the StreamPipeline until duration (or
-    forever)."""
+    forever).
+
+    ``manual_commit=True`` turns off auto-commit and commits offsets only
+    *after* each ``on_tick`` (i.e. after a state snapshot lands): on crash
+    the consumer replays from the last snapshot's offsets instead of losing
+    the window between auto-commit and snapshot — at-least-once, the same
+    guarantee Kafka Streams changelogs give the reference."""
     kafka = _require_kafka()
     consumer = kafka.KafkaConsumer(
         topic,
         bootstrap_servers=bootstrap,
         group_id=group,
         value_deserializer=lambda b: b.decode("utf-8", "replace"),
+        enable_auto_commit=not manual_commit,
         # bounded poll so ticks fire on an idle topic (the reference's
         # punctuate is wall-clock driven, not message driven)
         consumer_timeout_ms=int(tick_sec * 1000),
@@ -110,6 +119,11 @@ def run_pipeline(
         now = time.time()
         if now - last_tick >= tick_sec:
             pipeline.tick(int(now * 1000))
+            saved = on_tick(int(now * 1000)) if on_tick is not None else None
+            # commit only when a snapshot actually landed: on crash the
+            # consumer replays exactly from the restored state
+            if manual_commit and (on_tick is None or saved):
+                consumer.commit()
             last_tick = now
         if duration_sec is not None and now - start > duration_sec:
             break
