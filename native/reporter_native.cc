@@ -290,7 +290,9 @@ inline bool ubodt_path_edges(const UbodtView& u, const int32_t* edge_to,
   out->clear();
   if (src == dst) return true;
   int32_t node = src;
-  for (int64_t it = 0; it <= guard; ++it) {
+  // `it < guard` with guard = num_rows + 1 gives exactly num_rows + 1 hops,
+  // the same give-up bound as the Python oracle UBODT.path_edges
+  for (int64_t it = 0; it < guard; ++it) {
     int32_t fe = ubodt_first_edge(u, node, dst);
     if (fe < 0) return false;
     out->push_back(fe);
@@ -579,6 +581,209 @@ int32_t rn_associate_batch(
   // way range end per record (way_start is sized out_cap + 1 by the caller)
   way_start[sink.n_rec] = sink.n_way;
   return 0;
+}
+
+}  // extern "C"
+
+// ---------------------------------------------------------------------------
+// UBODT builder: parallel bounded Dijkstra from every node, the preprocessing
+// that replaces Meili's on-line route search (tiles/ubodt.py module docs; the
+// reference pays this cost per match inside Valhalla C++,
+// reporter_service.py:240).  This is the fast path tiles/ubodt.build_ubodt
+// promises for big regions; the pure-Python builder remains the oracle.
+// Arithmetic mirrors Python _bounded_dijkstra exactly (double accumulation
+// over float32 inputs, min-heap pop order with node-id tie-break) so the row
+// stream — and therefore the packed hash table — is identical.
+
+#include <algorithm>
+#include <atomic>
+#include <limits>
+#include <queue>
+#include <thread>
+
+namespace {
+
+struct UbodtRow {
+  int32_t src;
+  int32_t dst;
+  float dist;
+  float time;
+  int32_t first_edge;
+};
+
+struct UbodtBuildResult {
+  std::vector<UbodtRow> rows;
+};
+
+// Scratch reused across sources within one thread: dense arrays with a
+// touched-list reset, so per-source cost is O(frontier), not O(N).
+struct DijkstraScratch {
+  std::vector<double> dist;
+  std::vector<double> time;
+  std::vector<int32_t> first;
+  std::vector<uint8_t> done;
+  std::vector<int32_t> touched;
+
+  explicit DijkstraScratch(int64_t n)
+      : dist(n, -1.0), time(n, 0.0), first(n, -1), done(n, 0) {}
+
+  void reset() {
+    for (int32_t n : touched) {
+      dist[n] = -1.0;
+      time[n] = 0.0;
+      first[n] = -1;
+      done[n] = 0;
+    }
+    touched.clear();
+  }
+};
+
+void bounded_dijkstra(int32_t src, double delta, const int32_t* out_start,
+                      const int32_t* out_edges, const int32_t* edge_to,
+                      const float* edge_len, const float* edge_speed,
+                      DijkstraScratch* s, std::vector<UbodtRow>* out) {
+  s->reset();
+  using QE = std::pair<double, int32_t>;  // (dist, node): ties pop lower node
+  std::priority_queue<QE, std::vector<QE>, std::greater<QE>> heap;
+  s->dist[src] = 0.0;
+  s->time[src] = 0.0;
+  s->first[src] = -1;
+  s->touched.push_back(src);
+  heap.push({0.0, src});
+  while (!heap.empty()) {
+    auto [d, n] = heap.top();
+    heap.pop();
+    if (s->done[n]) continue;
+    s->done[n] = 1;
+    out->push_back({src, n, (float)d, (float)s->time[n], s->first[n]});
+    for (int32_t k = out_start[n]; k < out_start[n + 1]; ++k) {
+      int32_t e = out_edges[k];
+      int32_t m = edge_to[e];
+      double nd = d + (double)edge_len[e];
+      double cur = s->done[m] ? -1.0 : s->dist[m];
+      if (nd <= delta && (cur < 0.0 ? !s->done[m] : nd < cur)) {
+        if (s->dist[m] < 0.0 && !s->done[m]) s->touched.push_back(m);
+        s->dist[m] = nd;
+        s->time[m] =
+            s->time[n] + (double)edge_len[e] /
+                             std::max((double)edge_speed[e], 0.1);
+        s->first[m] = (n == src) ? e : s->first[n];
+        heap.push({nd, m});
+      }
+    }
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// Builds all rows within `delta` metres over `num_threads` workers (<=0 means
+// hardware concurrency).  Returns an opaque handle and sets *out_rows; the
+// caller then calls rn_ubodt_fetch to copy rows out (which frees the handle).
+// Row order is deterministic (source-ascending, per-source pop order) and
+// identical to tiles/ubodt.build_ubodt's Python loop.
+void* rn_ubodt_build(int64_t num_nodes, const int32_t* out_start,
+                     const int32_t* out_edges, const int32_t* edge_to,
+                     const float* edge_len, const float* edge_speed,
+                     double delta, int32_t num_threads, int64_t* out_rows) {
+  if (num_threads <= 0) {
+    unsigned hc = std::thread::hardware_concurrency();
+    num_threads = hc ? (int32_t)hc : 4;
+  }
+  if ((int64_t)num_threads > num_nodes) num_threads = (int32_t)std::max<int64_t>(num_nodes, 1);
+
+  constexpr int64_t kChunk = 64;  // sources per work unit
+  int64_t n_chunks = (num_nodes + kChunk - 1) / kChunk;
+  std::vector<std::vector<UbodtRow>> chunk_rows((size_t)n_chunks);
+  std::atomic<int64_t> next_chunk{0};
+
+  auto worker = [&]() {
+    DijkstraScratch scratch(num_nodes);
+    for (;;) {
+      int64_t c = next_chunk.fetch_add(1);
+      if (c >= n_chunks) break;
+      std::vector<UbodtRow>& rows = chunk_rows[(size_t)c];
+      int64_t lo = c * kChunk;
+      int64_t hi = std::min(lo + kChunk, num_nodes);
+      for (int64_t srcn = lo; srcn < hi; ++srcn)
+        bounded_dijkstra((int32_t)srcn, delta, out_start, out_edges, edge_to,
+                         edge_len, edge_speed, &scratch, &rows);
+    }
+  };
+
+  std::vector<std::thread> threads;
+  threads.reserve((size_t)num_threads);
+  for (int32_t t = 0; t < num_threads; ++t) threads.emplace_back(worker);
+  for (auto& t : threads) t.join();
+
+  auto* res = new UbodtBuildResult();
+  int64_t total = 0;
+  for (auto& cr : chunk_rows) total += (int64_t)cr.size();
+  res->rows.reserve((size_t)total);
+  for (auto& cr : chunk_rows) {
+    res->rows.insert(res->rows.end(), cr.begin(), cr.end());
+    cr.clear();
+    cr.shrink_to_fit();
+  }
+  *out_rows = total;
+  return res;
+}
+
+// Copies the built rows into caller-sized arrays and frees the handle.
+void rn_ubodt_fetch(void* handle, int32_t* src, int32_t* dst, float* dist,
+                    float* time, int32_t* first_edge) {
+  auto* res = static_cast<UbodtBuildResult*>(handle);
+  int64_t n = (int64_t)res->rows.size();
+  for (int64_t i = 0; i < n; ++i) {
+    const UbodtRow& r = res->rows[(size_t)i];
+    src[i] = r.src;
+    dst[i] = r.dst;
+    dist[i] = r.dist;
+    time[i] = r.time;
+    first_edge[i] = r.first_edge;
+  }
+  delete res;
+}
+
+// Linear-probe packing, identical to tiles/ubodt.ubodt_from_rows' inner loop
+// (same pair_hash, same insertion order => bit-identical table).  `size` must
+// be a power of two.  Fills the five table arrays (pre-sized to `size`) and
+// returns the max probe length used, or -1 when it would exceed
+// max_probe_limit (caller doubles `size` and retries, as the Python packer
+// does).
+int64_t rn_ubodt_pack(int64_t n_rows, const int32_t* src, const int32_t* dst,
+                      const float* dist, const float* time, const int32_t* fe,
+                      int64_t size, int64_t max_probe_limit, int32_t* t_src,
+                      int32_t* t_dst, float* t_dist, float* t_time,
+                      int32_t* t_fe) {
+  const int64_t mask = size - 1;
+  const float inf = std::numeric_limits<float>::infinity();
+  for (int64_t i = 0; i < size; ++i) {
+    t_src[i] = -1;
+    t_dst[i] = -1;
+    t_dist[i] = inf;
+    t_time[i] = inf;
+    t_fe[i] = -1;
+  }
+  int64_t max_probe = 0;
+  for (int64_t r = 0; r < n_rows; ++r) {
+    uint32_t h = pair_hash((uint32_t)src[r], (uint32_t)dst[r], mask);
+    for (int64_t p = 0; p < size; ++p) {
+      int64_t i = (h + p) & mask;
+      if (t_src[i] == -1) {
+        t_src[i] = src[r];
+        t_dst[i] = dst[r];
+        t_dist[i] = dist[r];
+        t_time[i] = time[r];
+        t_fe[i] = fe[r];
+        if (p + 1 > max_probe) max_probe = p + 1;
+        break;
+      }
+    }
+    if (max_probe > max_probe_limit) return -1;
+  }
+  return max_probe;
 }
 
 }  // extern "C"

@@ -9,8 +9,8 @@ numpy + Python loops with no batching.  Used to
   - measure the single-process CPU traces/sec that bench.py's vs_baseline
     figure is computed against
 
-Keep the math in lock-step with ops/viterbi.py; tests/test_backend_diff.py
-asserts the two backends agree on the chosen edges.
+Keep the math in lock-step with ops/viterbi.py; the backend diff test in
+tests/test_matcher.py asserts the two backends agree on the chosen edges.
 """
 
 from __future__ import annotations

@@ -204,7 +204,7 @@ class SegmentMatcher:
             px, py, tm, valid, times = self._fill_rows(traces, idxs, blen)
             handle = self._dispatch_batch(*self._pad_pow2(px, py, tm, valid))
             pending.append((idxs, handle, times))
-            if len(pending) > PIPELINE_DEPTH:
+            if len(pending) >= PIPELINE_DEPTH:
                 drain_one()
         while pending:
             drain_one()

@@ -72,6 +72,20 @@ def _configure(lib: ctypes.CDLL) -> ctypes.CDLL:
     ]
     lib.rn_abi_version.restype = ctypes.c_uint32
     lib.rn_abi_version.argtypes = []
+    lib.rn_ubodt_build.restype = ctypes.c_void_p
+    lib.rn_ubodt_build.argtypes = [
+        ctypes.c_int64, _i32p, _i32p, _i32p, _f32p, _f32p,
+        ctypes.c_double, ctypes.c_int32, ctypes.POINTER(ctypes.c_int64),
+    ]
+    lib.rn_ubodt_fetch.restype = None
+    lib.rn_ubodt_fetch.argtypes = [
+        ctypes.c_void_p, _i32p, _i32p, _f32p, _f32p, _i32p,
+    ]
+    lib.rn_ubodt_pack.restype = ctypes.c_int64
+    lib.rn_ubodt_pack.argtypes = [
+        ctypes.c_int64, _i32p, _i32p, _f32p, _f32p, _i32p,
+        ctypes.c_int64, ctypes.c_int64, _i32p, _i32p, _f32p, _f32p, _i32p,
+    ]
     lib.rn_associate_batch.restype = ctypes.c_int32
     lib.rn_associate_batch.argtypes = [
         # graph
@@ -106,6 +120,34 @@ def get_lib(force_rebuild: bool = False) -> Optional[ctypes.CDLL]:
         except OSError as e:
             log.warning("native library load failed: %s", e)
             _lib = None
+        except AttributeError as e:
+            # a stale .so that predates newly-added symbols but passes the
+            # mtime check (archive/copy with preserved timestamps): force one
+            # rebuild; if that still fails, fall back to Python ("the native
+            # tier accelerates, never gates")
+            log.warning("native library missing symbol (%s); rebuilding", e)
+            _lib = None
+            try:
+                os.remove(_LIB)
+                if _build():
+                    # dlopen caches by path, so re-loading _LIB would return
+                    # the stale mapping; load the rebuilt .so under a unique
+                    # temp name (unlinked after load -- the mapping survives)
+                    import shutil
+                    import tempfile
+
+                    fd, tmp = tempfile.mkstemp(
+                        suffix=".so", prefix="reporter_native_"
+                    )
+                    os.close(fd)
+                    shutil.copy2(_LIB, tmp)
+                    try:
+                        _lib = _configure(ctypes.CDLL(tmp))
+                    finally:
+                        os.unlink(tmp)
+            except Exception as e2:
+                log.warning("native rebuild failed, using Python fallbacks: %s", e2)
+                _lib = None
         return _lib
 
 

@@ -71,11 +71,13 @@ def main(argv=None) -> int:
         run_pipeline(
             pipeline, args.topic, args.bootstrap, duration_sec=args.duration,
             on_tick=ckpt.maybe_save,
+            # the final (post-close) snapshot happens inside run_pipeline so
+            # its offset commit can be conditioned on the snapshot landing
+            on_close=ckpt.save,
             # coordinate offset commits with snapshots so a crash replays
             # from the restored state instead of dropping the gap
             manual_commit=bool(args.checkpoint),
         )
-        ckpt.save()
     else:
         start = time.time()
         for line in sys.stdin:

@@ -135,6 +135,9 @@ class Checkpointer:
         try:
             save_file(self.pipeline, self.path)
             return True
-        except OSError:
+        except Exception:
+            # not just OSError: serialisation of corrupt in-flight state
+            # (struct.error, TypeError from json.dump) must not kill the
+            # stream either -- the offsets simply stay uncommitted
             log.exception("stream checkpoint to %s failed; continuing", self.path)
             return False

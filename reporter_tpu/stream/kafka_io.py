@@ -85,6 +85,7 @@ def run_pipeline(
     duration_sec: Optional[float] = None,
     tick_sec: float = 30.0,
     on_tick: Optional[Callable[[int], None]] = None,
+    on_close: Optional[Callable[[], bool]] = None,
     manual_commit: bool = False,
 ) -> None:
     """Consume a raw topic and drive the StreamPipeline until duration (or
@@ -94,7 +95,13 @@ def run_pipeline(
     *after* each ``on_tick`` (i.e. after a state snapshot lands): on crash
     the consumer replays from the last snapshot's offsets instead of losing
     the window between auto-commit and snapshot — at-least-once, the same
-    guarantee Kafka Streams changelogs give the reference."""
+    guarantee Kafka Streams changelogs give the reference.
+
+    ``on_close`` is the un-gated final snapshot (e.g. Checkpointer.save):
+    after ``pipeline.close`` the loop takes one last snapshot and commits
+    only when it lands, so the committed offsets always correspond to the
+    state on disk — including on graceful shutdown, where an interval-gated
+    ``on_tick`` may decline to snapshot."""
     kafka = _require_kafka()
     consumer = kafka.KafkaConsumer(
         topic,
@@ -106,28 +113,61 @@ def run_pipeline(
         # punctuate is wall-clock driven, not message driven)
         consumer_timeout_ms=int(tick_sec * 1000),
     )
+    import signal
+    import threading
+
+    # graceful shutdown must reach the final snapshot+commit below, so route
+    # SIGTERM (docker stop, k8s) through the same KeyboardInterrupt path as
+    # Ctrl-C; handler installation only works from the main thread
+    prev_term = None
+    if threading.current_thread() is threading.main_thread():
+        def _on_term(signum, frame):
+            raise KeyboardInterrupt
+        prev_term = signal.signal(signal.SIGTERM, _on_term)
+
     start = time.time()
     last_tick = start
-    while True:
-        for msg in consumer:
-            ts_ms = msg.timestamp if msg.timestamp and msg.timestamp > 0 else int(
-                time.time() * 1000
-            )
-            pipeline.feed(msg.value, ts_ms)
-            if time.time() - last_tick >= tick_sec:
+    graceful = False
+    try:
+        while True:
+            for msg in consumer:
+                ts_ms = msg.timestamp if msg.timestamp and msg.timestamp > 0 else int(
+                    time.time() * 1000
+                )
+                pipeline.feed(msg.value, ts_ms)
+                if time.time() - last_tick >= tick_sec:
+                    break
+            now = time.time()
+            if now - last_tick >= tick_sec:
+                pipeline.tick(int(now * 1000))
+                saved = on_tick(int(now * 1000)) if on_tick is not None else None
+                # commit only when a snapshot actually landed: on crash the
+                # consumer replays exactly from the restored state
+                if manual_commit and (on_tick is None or saved):
+                    consumer.commit()
+                last_tick = now
+            if duration_sec is not None and now - start > duration_sec:
                 break
-        now = time.time()
-        if now - last_tick >= tick_sec:
-            pipeline.tick(int(now * 1000))
-            saved = on_tick(int(now * 1000)) if on_tick is not None else None
-            # commit only when a snapshot actually landed: on crash the
-            # consumer replays exactly from the restored state
-            if manual_commit and (on_tick is None or saved):
+        graceful = True
+    except KeyboardInterrupt:
+        graceful = True
+        log.info("interrupted; flushing final state before exit")
+    finally:
+        if prev_term is not None:
+            signal.signal(signal.SIGTERM, prev_term)
+        # the final snapshot + commit happen ONLY on graceful exit (duration
+        # expiry, SIGTERM, Ctrl-C).  A crash mid-feed must commit nothing:
+        # state may be partially mutated, and at-least-once means the next
+        # boot replays from the last consistent snapshot's offsets.
+        if graceful:
+            pipeline.close(int(time.time() * 1000))
+            # final snapshot AFTER close (close may flush tiles / mutate
+            # state), then commit only if it landed: the persisted state and
+            # the committed offsets stay in lockstep on graceful shutdown
+            saved = on_close() if on_close is not None else None
+            if manual_commit and (on_close is None or saved):
                 consumer.commit()
-            last_tick = now
-        if duration_sec is not None and now - start > duration_sec:
-            break
-    pipeline.close(int(time.time() * 1000))
+        consumer.close()
 
 
 def print_topic(topic: str, bootstrap: str, limit: Optional[int] = None) -> None:

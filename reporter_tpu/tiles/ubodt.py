@@ -219,9 +219,25 @@ def build_ubodt(
     delta: float = 3000.0,
     load_factor: float = 0.5,
     max_probe_limit: int = 64,
+    num_threads: int = 0,
+    use_native: bool = True,
 ) -> UBODT:
-    """Build the table from GraphArrays (pure Python; the native C++ builder in
-    native/ is the fast path for big regions)."""
+    """Build the table from GraphArrays.
+
+    Fast path: ``rn_ubodt_build`` in native/reporter_native.cc -- a parallel
+    bounded Dijkstra over all sources (num_threads <= 0 means all cores)
+    followed by native hash packing.  The pure-Python loop below is the
+    oracle and the no-compiler fallback; the two produce bit-identical
+    tables (tests/test_ubodt.py diffs them).  The reference pays this route
+    search per match inside Valhalla C++ (reporter_service.py:240); here it
+    is preprocessing so match time stays pure gathers."""
+    if use_native:
+        built = _native_build_rows(arrays, delta, num_threads)
+        if built is not None:
+            src, dst, dist, tm, fe = built
+            return ubodt_from_columns(
+                src, dst, dist, tm, fe, delta, load_factor, max_probe_limit
+            ).attach_graph(arrays.edge_to)
     rows: List[Tuple[int, int, float, float, int]] = []
     for src in range(arrays.num_nodes):
         for dst, d, tm, fe in _bounded_dijkstra(
@@ -229,7 +245,137 @@ def build_ubodt(
             arrays.edge_len, arrays.edge_speed,
         ):
             rows.append((src, dst, d, tm, fe))
-    return ubodt_from_rows(rows, delta, load_factor, max_probe_limit).attach_graph(arrays.edge_to)
+    return ubodt_from_rows(
+        rows, delta, load_factor, max_probe_limit, use_native=use_native
+    ).attach_graph(arrays.edge_to)
+
+
+def _native_build_rows(arrays, delta: float, num_threads: int):
+    """(src, dst, dist, time, first_edge) numpy columns via the C++ builder,
+    or None when the native library is unavailable."""
+    try:
+        from ..native import get_lib
+    except ImportError:  # pragma: no cover
+        return None
+    lib = get_lib()
+    if lib is None or not hasattr(lib, "rn_ubodt_build"):
+        return None
+    import ctypes
+
+    out_start = np.ascontiguousarray(arrays.out_start, np.int32)
+    out_edges = np.ascontiguousarray(arrays.out_edges, np.int32)
+    edge_to = np.ascontiguousarray(arrays.edge_to, np.int32)
+    edge_len = np.ascontiguousarray(arrays.edge_len, np.float32)
+    edge_speed = np.ascontiguousarray(arrays.edge_speed, np.float32)
+    n_rows = ctypes.c_int64(0)
+    handle = lib.rn_ubodt_build(
+        arrays.num_nodes, out_start, out_edges, edge_to, edge_len, edge_speed,
+        float(delta), int(num_threads), ctypes.byref(n_rows),
+    )
+    if not handle:  # pragma: no cover - allocation failure
+        return None
+    n = n_rows.value
+    src = np.empty(n, np.int32)
+    dst = np.empty(n, np.int32)
+    dist = np.empty(n, np.float32)
+    tm = np.empty(n, np.float32)
+    fe = np.empty(n, np.int32)
+    lib.rn_ubodt_fetch(handle, src, dst, dist, tm, fe)
+    return src, dst, dist, tm, fe
+
+
+def _pack_python(src, dst, dist, time, first_edge, size, max_probe_limit,
+                 tsrc, tdst, tdist, ttime, tfe) -> int:
+    """Python twin of rn_ubodt_pack: fill the pre-initialised table arrays,
+    return max probe length, or -1 when max_probe_limit is exceeded."""
+    mask = size - 1
+    max_probe = 0
+    for r in range(len(src)):
+        h = int(pair_hash(np.int64(src[r]), np.int64(dst[r]), mask))
+        for p in range(size):
+            i = (h + p) & mask
+            if tsrc[i] == EMPTY:
+                tsrc[i] = src[r]
+                tdst[i] = dst[r]
+                tdist[i] = dist[r]
+                ttime[i] = time[r]
+                tfe[i] = first_edge[r]
+                max_probe = max(max_probe, p + 1)
+                break
+        if max_probe > max_probe_limit:
+            return -1
+    return max_probe
+
+
+def ubodt_from_columns(
+    src: np.ndarray,
+    dst: np.ndarray,
+    dist: np.ndarray,
+    time: np.ndarray,
+    first_edge: np.ndarray,
+    delta: float,
+    load_factor: float = 0.5,
+    max_probe_limit: int = 64,
+    use_native: bool = True,
+) -> UBODT:
+    """Pack row columns into the hash table.  The single home of the sizing
+    and grow-on-probe-overflow policy; the probe/insert inner loop runs in
+    C++ (rn_ubodt_pack) when available and ``use_native``, else in
+    _pack_python -- both produce bit-identical tables."""
+    n = int(len(src))
+    src = np.ascontiguousarray(src, np.int32)
+    dst = np.ascontiguousarray(dst, np.int32)
+    dist = np.ascontiguousarray(dist, np.float32)
+    time = np.ascontiguousarray(time, np.float32)
+    first_edge = np.ascontiguousarray(first_edge, np.int32)
+    lib = None
+    if use_native:
+        try:
+            from ..native import get_lib
+
+            lib = get_lib()
+        except ImportError:  # pragma: no cover
+            lib = None
+        if lib is not None and not hasattr(lib, "rn_ubodt_pack"):
+            lib = None
+
+    size = 1
+    while size < max(int(n / load_factor), 8):
+        size <<= 1
+    while True:
+        if lib is not None:
+            # rn_ubodt_pack initialises every slot itself; skip the dead
+            # Python-side pre-fill (size can be tens of millions of slots)
+            tsrc = np.empty(size, np.int32)
+            tdst = np.empty(size, np.int32)
+            tdist = np.empty(size, np.float32)
+            ttime = np.empty(size, np.float32)
+            tfe = np.empty(size, np.int32)
+            max_probe = lib.rn_ubodt_pack(
+                n, src, dst, dist, time, first_edge, size, max_probe_limit,
+                tsrc, tdst, tdist, ttime, tfe,
+            )
+        else:
+            tsrc = np.full(size, EMPTY, np.int32)
+            tdst = np.full(size, EMPTY, np.int32)
+            tdist = np.full(size, np.inf, np.float32)
+            ttime = np.full(size, np.inf, np.float32)
+            tfe = np.full(size, -1, np.int32)
+            max_probe = _pack_python(
+                src, dst, dist, time, first_edge, size, max_probe_limit,
+                tsrc, tdst, tdist, ttime, tfe,
+            )
+        if max_probe >= 0:
+            break
+        size <<= 1
+        log.info("ubodt: max probe length exceeded %d, growing table to %d",
+                 max_probe_limit, size)
+    log.info("ubodt: %d rows, table size %d, max probes %d", n, size, max_probe)
+    return UBODT(
+        delta=delta, table_src=tsrc, table_dst=tdst, table_dist=tdist,
+        table_time=ttime, table_first_edge=tfe, mask=size - 1,
+        max_probes=int(max_probe), num_rows=n,
+    )
 
 
 def ubodt_from_rows(
@@ -237,52 +383,18 @@ def ubodt_from_rows(
     delta: float,
     load_factor: float = 0.5,
     max_probe_limit: int = 64,
+    use_native: bool = True,
 ) -> UBODT:
-    """Pack (src, dst, dist, time, first_edge) rows into the hash table.
-    Shared by the Python builder above and the native C++ builder's output."""
-    n = len(rows)
-    size = 1
-    while size < max(int(n / load_factor), 8):
-        size <<= 1
-
-    while True:
-        mask = size - 1
-        tsrc = np.full(size, EMPTY, np.int32)
-        tdst = np.full(size, EMPTY, np.int32)
-        tdist = np.full(size, np.inf, np.float32)
-        ttime = np.full(size, np.inf, np.float32)
-        tfe = np.full(size, -1, np.int32)
-        max_probe = 0
-        ok = True
-        for src, dst, d, tm, fe in rows:
-            h = int(pair_hash(np.int64(src), np.int64(dst), mask))
-            for p in range(size):
-                i = (h + p) & mask
-                if tsrc[i] == EMPTY:
-                    tsrc[i] = src
-                    tdst[i] = dst
-                    tdist[i] = d
-                    ttime[i] = tm
-                    tfe[i] = fe
-                    max_probe = max(max_probe, p + 1)
-                    break
-            if max_probe > max_probe_limit:
-                ok = False
-                break
-        if ok:
-            break
-        size <<= 1
-        log.info("ubodt: max probe length exceeded %d, growing table to %d", max_probe_limit, size)
-
-    log.info("ubodt: %d rows, table size %d, max probes %d", n, size, max_probe)
-    return UBODT(
-        delta=delta,
-        table_src=tsrc,
-        table_dst=tdst,
-        table_dist=tdist,
-        table_time=ttime,
-        table_first_edge=tfe,
-        mask=mask,
-        max_probes=max_probe,
-        num_rows=n,
+    """Pack (src, dst, dist, time, first_edge) row tuples into the hash
+    table.  Thin column-conversion wrapper over ubodt_from_columns, which
+    owns the sizing/growth policy."""
+    if rows:
+        srcs, dsts, dists, times, fes = zip(*rows)
+    else:
+        srcs = dsts = dists = times = fes = ()
+    return ubodt_from_columns(
+        np.asarray(srcs, np.int32), np.asarray(dsts, np.int32),
+        np.asarray(dists, np.float32), np.asarray(times, np.float32),
+        np.asarray(fes, np.int32), delta, load_factor, max_probe_limit,
+        use_native=use_native,
     )

@@ -10,6 +10,20 @@ init blocks forever -- hanging a service that only asked for CPU.
 set excludes a registered factory, the factory is dropped before first
 backend use.  Call it from every entry point (service CLI, batch pipeline,
 bench) before touching jax arrays.
+
+RISK: ``jax._src.xla_bridge._backend_factories`` / ``_platform_aliases`` are
+private and may be renamed or restructured in a future jax release.  The
+function is written to DEGRADE, not break, when that happens: every access
+is getattr/try-guarded, and on drift it logs a warning and returns with the
+factories untouched.  The observable regression in that case is only the
+original hang-on-dead-tunnel, and the fallback plan is:
+  1. set JAX_PLATFORMS=cpu AND run the entry point under a watchdog
+     (bench.py's subprocess probe pattern) so a blocked plugin init is
+     detected and the process restarted with the plugin env removed, or
+  2. strip the PJRT plugin env vars (PJRT_NAMES_AND_LIBRARY_PATHS, the
+     plugin entry-point packages) from the child environment entirely.
+tests/test_matcher.py and the service boot path exercise ensure_platform on
+every CI run, so an API drift surfaces as a logged warning there first.
 """
 
 from __future__ import annotations
