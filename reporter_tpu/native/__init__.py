@@ -34,21 +34,25 @@ _i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
 _i32p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
 
 
-def _build() -> bool:
-    if not os.path.exists(_SRC):
-        return False
-    if os.path.exists(_LIB) and os.path.getmtime(_LIB) >= os.path.getmtime(_SRC):
-        return True
+def _compile(out_path: str) -> bool:
     try:
         subprocess.run(
             ["g++", "-O3", "-fPIC", "-shared", "-std=c++17", "-Wall",
-             "-o", _LIB, _SRC],
+             "-o", out_path, _SRC],
             check=True, capture_output=True, timeout=120,
         )
         return True
     except Exception as e:
         log.warning("native build failed, using Python fallbacks: %s", e)
         return False
+
+
+def _build() -> bool:
+    if not os.path.exists(_SRC):
+        return False
+    if os.path.exists(_LIB) and os.path.getmtime(_LIB) >= os.path.getmtime(_SRC):
+        return True
+    return _compile(_LIB)
 
 
 def _configure(lib: ctypes.CDLL) -> ctypes.CDLL:
@@ -122,29 +126,27 @@ def get_lib(force_rebuild: bool = False) -> Optional[ctypes.CDLL]:
             _lib = None
         except AttributeError as e:
             # a stale .so that predates newly-added symbols but passes the
-            # mtime check (archive/copy with preserved timestamps): force one
-            # rebuild; if that still fails, fall back to Python ("the native
-            # tier accelerates, never gates")
+            # mtime check (archive/copy with preserved timestamps): rebuild
+            # to a temp path -- the stale library is only replaced on a
+            # successful compile, so a host without a compiler keeps the old
+            # symbols working ("the native tier accelerates, never gates").
+            # The temp path is also what gets dlopened: dlopen caches by
+            # path, so re-loading _LIB would return the stale mapping.
             log.warning("native library missing symbol (%s); rebuilding", e)
             _lib = None
             try:
-                os.remove(_LIB)
-                if _build():
-                    # dlopen caches by path, so re-loading _LIB would return
-                    # the stale mapping; load the rebuilt .so under a unique
-                    # temp name (unlinked after load -- the mapping survives)
-                    import shutil
-                    import tempfile
+                import shutil
+                import tempfile
 
-                    fd, tmp = tempfile.mkstemp(
-                        suffix=".so", prefix="reporter_native_"
-                    )
-                    os.close(fd)
-                    shutil.copy2(_LIB, tmp)
+                tmpdir = tempfile.mkdtemp(prefix="reporter_native_")
+                tmp = os.path.join(tmpdir, "libreporter_native_rebuilt.so")
+                if _compile(tmp):
+                    _lib = _configure(ctypes.CDLL(tmp))
                     try:
-                        _lib = _configure(ctypes.CDLL(tmp))
-                    finally:
-                        os.unlink(tmp)
+                        shutil.copy2(tmp, _LIB)  # persist for other processes
+                    except OSError:
+                        log.warning("could not refresh %s on disk", _LIB)
+                shutil.rmtree(tmpdir, ignore_errors=True)
             except Exception as e2:
                 log.warning("native rebuild failed, using Python fallbacks: %s", e2)
                 _lib = None

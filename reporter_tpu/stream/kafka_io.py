@@ -116,18 +116,26 @@ def run_pipeline(
     import signal
     import threading
 
-    # graceful shutdown must reach the final snapshot+commit below, so route
-    # SIGTERM (docker stop, k8s) through the same KeyboardInterrupt path as
-    # Ctrl-C; handler installation only works from the main thread
-    prev_term = None
+    # Graceful shutdown (docker stop SIGTERM, Ctrl-C SIGINT) must reach the
+    # final snapshot+commit below -- but a signal must never interrupt
+    # pipeline.feed mid-mutation and then have the half-applied state
+    # snapshotted and committed past.  So the handlers only SET A FLAG; the
+    # loop checks it between messages, making shutdown deterministic.
+    # Handler installation only works from the main thread; elsewhere a
+    # raised KeyboardInterrupt still exits, but lands in the no-commit path.
+    stop_requested = False
+    prev_handlers = []
     if threading.current_thread() is threading.main_thread():
-        def _on_term(signum, frame):
-            raise KeyboardInterrupt
-        prev_term = signal.signal(signal.SIGTERM, _on_term)
+        def _on_signal(signum, frame):
+            nonlocal stop_requested
+            stop_requested = True
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            prev_handlers.append((sig, signal.signal(sig, _on_signal)))
 
     start = time.time()
     last_tick = start
     graceful = False
+    interrupted = False
     try:
         while True:
             for msg in consumer:
@@ -135,8 +143,11 @@ def run_pipeline(
                     time.time() * 1000
                 )
                 pipeline.feed(msg.value, ts_ms)
-                if time.time() - last_tick >= tick_sec:
+                if stop_requested or time.time() - last_tick >= tick_sec:
                     break
+            if stop_requested:
+                log.info("shutdown requested; flushing final state")
+                break
             now = time.time()
             if now - last_tick >= tick_sec:
                 pipeline.tick(int(now * 1000))
@@ -150,22 +161,23 @@ def run_pipeline(
                 break
         graceful = True
     except KeyboardInterrupt:
-        graceful = True
-        log.info("interrupted; flushing final state before exit")
+        # async interrupt (no flag handler installed): the current message
+        # may be half-applied, so snapshot but do NOT commit -- on reboot the
+        # interrupted window replays onto the restored state (dupes allowed,
+        # loss not)
+        interrupted = True
+        log.info("interrupted mid-loop; snapshotting without offset commit")
     finally:
-        if prev_term is not None:
-            signal.signal(signal.SIGTERM, prev_term)
-        # the final snapshot + commit happen ONLY on graceful exit (duration
-        # expiry, SIGTERM, Ctrl-C).  A crash mid-feed must commit nothing:
-        # state may be partially mutated, and at-least-once means the next
-        # boot replays from the last consistent snapshot's offsets.
-        if graceful:
+        for sig, h in prev_handlers:
+            signal.signal(sig, h)
+        if graceful or interrupted:
             pipeline.close(int(time.time() * 1000))
             # final snapshot AFTER close (close may flush tiles / mutate
-            # state), then commit only if it landed: the persisted state and
-            # the committed offsets stay in lockstep on graceful shutdown
+            # state), then commit only if it landed AND the exit was
+            # deterministic: persisted state and committed offsets stay in
+            # lockstep.  A crash commits nothing.
             saved = on_close() if on_close is not None else None
-            if manual_commit and (on_close is None or saved):
+            if graceful and manual_commit and (on_close is None or saved):
                 consumer.commit()
         consumer.close()
 

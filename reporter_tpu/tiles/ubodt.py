@@ -250,15 +250,23 @@ def build_ubodt(
     ).attach_graph(arrays.edge_to)
 
 
-def _native_build_rows(arrays, delta: float, num_threads: int):
-    """(src, dst, dist, time, first_edge) numpy columns via the C++ builder,
-    or None when the native library is unavailable."""
+def _get_native(symbol: str):
+    """The loaded native library when it exports ``symbol``, else None."""
     try:
         from ..native import get_lib
     except ImportError:  # pragma: no cover
         return None
     lib = get_lib()
-    if lib is None or not hasattr(lib, "rn_ubodt_build"):
+    if lib is None or not hasattr(lib, symbol):
+        return None
+    return lib
+
+
+def _native_build_rows(arrays, delta: float, num_threads: int):
+    """(src, dst, dist, time, first_edge) numpy columns via the C++ builder,
+    or None when the native library is unavailable."""
+    lib = _get_native("rn_ubodt_build")
+    if lib is None:
         return None
     import ctypes
 
@@ -328,16 +336,7 @@ def ubodt_from_columns(
     dist = np.ascontiguousarray(dist, np.float32)
     time = np.ascontiguousarray(time, np.float32)
     first_edge = np.ascontiguousarray(first_edge, np.int32)
-    lib = None
-    if use_native:
-        try:
-            from ..native import get_lib
-
-            lib = get_lib()
-        except ImportError:  # pragma: no cover
-            lib = None
-        if lib is not None and not hasattr(lib, "rn_ubodt_pack"):
-            lib = None
+    lib = _get_native("rn_ubodt_pack") if use_native else None
 
     size = 1
     while size < max(int(n / load_factor), 8):
