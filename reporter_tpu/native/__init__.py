@@ -55,43 +55,37 @@ def _build() -> bool:
     return _compile(_LIB)
 
 
-def _configure(lib: ctypes.CDLL) -> ctypes.CDLL:
-    lib.rn_tile_write.restype = ctypes.c_int
-    lib.rn_tile_write.argtypes = [
+# every exported symbol with (restype, argtypes); configuration is tolerant
+# of symbols a stale .so predates -- callers hasattr-check before use, so a
+# partially-configured library still accelerates everything it exports
+_SYMBOLS = {
+    "rn_tile_write": (ctypes.c_int, [
         ctypes.c_char_p, ctypes.c_uint32, _f64p, _f64p, ctypes.c_uint32,
         _u32p, _u32p, _f32p, _u8p, _u8p, _i64p, _i64p, _u32p,
         ctypes.c_uint32, _f64p, _f64p,
-    ]
-    lib.rn_tile_header.restype = ctypes.c_int
-    lib.rn_tile_header.argtypes = [ctypes.c_char_p, _u32p]
-    lib.rn_tile_read.restype = ctypes.c_int
-    lib.rn_tile_read.argtypes = [
+    ]),
+    "rn_tile_header": (ctypes.c_int, [ctypes.c_char_p, _u32p]),
+    "rn_tile_read": (ctypes.c_int, [
         ctypes.c_char_p, _f64p, _f64p, _u32p, _u32p, _f32p, _u8p, _u8p,
         _i64p, _i64p, _u32p, _f64p, _f64p,
-    ]
-    lib.rn_parse_shard.restype = ctypes.c_int64
-    lib.rn_parse_shard.argtypes = [
+    ]),
+    "rn_parse_shard": (ctypes.c_int64, [
         ctypes.c_char_p, ctypes.c_int64, _f64p, _f64p, _i64p, _i32p,
         _i64p, _i32p, ctypes.c_int64,
-    ]
-    lib.rn_abi_version.restype = ctypes.c_uint32
-    lib.rn_abi_version.argtypes = []
-    lib.rn_ubodt_build.restype = ctypes.c_void_p
-    lib.rn_ubodt_build.argtypes = [
+    ]),
+    "rn_abi_version": (ctypes.c_uint32, []),
+    "rn_ubodt_build": (ctypes.c_void_p, [
         ctypes.c_int64, _i32p, _i32p, _i32p, _f32p, _f32p,
         ctypes.c_double, ctypes.c_int32, ctypes.POINTER(ctypes.c_int64),
-    ]
-    lib.rn_ubodt_fetch.restype = None
-    lib.rn_ubodt_fetch.argtypes = [
+    ]),
+    "rn_ubodt_fetch": (None, [
         ctypes.c_void_p, _i32p, _i32p, _f32p, _f32p, _i32p,
-    ]
-    lib.rn_ubodt_pack.restype = ctypes.c_int64
-    lib.rn_ubodt_pack.argtypes = [
+    ]),
+    "rn_ubodt_pack": (ctypes.c_int64, [
         ctypes.c_int64, _i32p, _i32p, _f32p, _f32p, _i32p,
         ctypes.c_int64, ctypes.c_int64, _i32p, _i32p, _f32p, _f32p, _i32p,
-    ]
-    lib.rn_associate_batch.restype = ctypes.c_int32
-    lib.rn_associate_batch.argtypes = [
+    ]),
+    "rn_associate_batch": (ctypes.c_int32, [
         # graph
         _i32p, _i32p, _f32p, _i32p, _f32p, _u8p, _i64p, _i64p, _f32p,
         # ubodt
@@ -103,8 +97,22 @@ def _configure(lib: ctypes.CDLL) -> ctypes.CDLL:
         # outputs
         ctypes.c_int64, ctypes.c_int64, _i64p, _u8p, _i64p, _f64p, _f64p,
         _f64p, _u8p, _f64p, _i32p, _i32p, _i64p, _i64p,
-    ]
-    return lib
+    ]),
+}
+
+
+def _configure(lib: ctypes.CDLL):
+    """Configure all exported symbols.  Returns (lib, missing_names)."""
+    missing = []
+    for name, (restype, argtypes) in _SYMBOLS.items():
+        try:
+            fn = getattr(lib, name)
+        except AttributeError:
+            missing.append(name)
+            continue
+        fn.restype = restype
+        fn.argtypes = argtypes
+    return lib, missing
 
 
 def get_lib(force_rebuild: bool = False) -> Optional[ctypes.CDLL]:
@@ -120,20 +128,20 @@ def get_lib(force_rebuild: bool = False) -> Optional[ctypes.CDLL]:
         if not _build():
             return None
         try:
-            _lib = _configure(ctypes.CDLL(_LIB))
+            _lib, missing = _configure(ctypes.CDLL(_LIB))
         except OSError as e:
             log.warning("native library load failed: %s", e)
             _lib = None
-        except AttributeError as e:
+            return _lib
+        if missing:
             # a stale .so that predates newly-added symbols but passes the
             # mtime check (archive/copy with preserved timestamps): rebuild
-            # to a temp path -- the stale library is only replaced on a
-            # successful compile, so a host without a compiler keeps the old
-            # symbols working ("the native tier accelerates, never gates").
-            # The temp path is also what gets dlopened: dlopen caches by
-            # path, so re-loading _LIB would return the stale mapping.
-            log.warning("native library missing symbol (%s); rebuilding", e)
-            _lib = None
+            # to a temp path and dlopen THAT (dlopen caches by path, so
+            # re-loading _LIB would return the stale mapping).  If the
+            # rebuild fails -- e.g. no compiler on this host -- the stale
+            # library stays loaded and keeps accelerating every symbol it
+            # does export ("the native tier accelerates, never gates").
+            log.warning("native library missing symbols %s; rebuilding", missing)
             try:
                 import shutil
                 import tempfile
@@ -141,15 +149,24 @@ def get_lib(force_rebuild: bool = False) -> Optional[ctypes.CDLL]:
                 tmpdir = tempfile.mkdtemp(prefix="reporter_native_")
                 tmp = os.path.join(tmpdir, "libreporter_native_rebuilt.so")
                 if _compile(tmp):
-                    _lib = _configure(ctypes.CDLL(tmp))
-                    try:
-                        shutil.copy2(tmp, _LIB)  # persist for other processes
-                    except OSError:
-                        log.warning("could not refresh %s on disk", _LIB)
+                    fresh, still_missing = _configure(ctypes.CDLL(tmp))
+                    if not still_missing:
+                        _lib = fresh
+                        try:
+                            # atomic same-directory replace: concurrent
+                            # dlopens never see a torn file, and the old
+                            # inode stays intact under existing mappings
+                            side = _LIB + ".new"
+                            shutil.copy2(tmp, side)
+                            os.replace(side, _LIB)
+                        except OSError:
+                            log.warning("could not refresh %s on disk", _LIB)
                 shutil.rmtree(tmpdir, ignore_errors=True)
             except Exception as e2:
-                log.warning("native rebuild failed, using Python fallbacks: %s", e2)
-                _lib = None
+                log.warning(
+                    "native rebuild failed (%s); keeping stale library's "
+                    "exported symbols", e2,
+                )
         return _lib
 
 

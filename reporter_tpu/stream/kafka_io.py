@@ -109,9 +109,10 @@ def run_pipeline(
         group_id=group,
         value_deserializer=lambda b: b.decode("utf-8", "replace"),
         enable_auto_commit=not manual_commit,
-        # bounded poll so ticks fire on an idle topic (the reference's
-        # punctuate is wall-clock driven, not message driven)
-        consumer_timeout_ms=int(tick_sec * 1000),
+        # short poll bound so that on an idle topic (a) wall-clock ticks
+        # still fire (the reference's punctuate is time-driven) and (b) a
+        # SIGTERM shutdown flag is noticed well inside docker's 10 s grace
+        consumer_timeout_ms=int(min(tick_sec, 1.0) * 1000),
     )
     import signal
     import threading
@@ -135,7 +136,6 @@ def run_pipeline(
     start = time.time()
     last_tick = start
     graceful = False
-    interrupted = False
     try:
         while True:
             for msg in consumer:
@@ -161,23 +161,23 @@ def run_pipeline(
                 break
         graceful = True
     except KeyboardInterrupt:
-        # async interrupt (no flag handler installed): the current message
-        # may be half-applied, so snapshot but do NOT commit -- on reboot the
-        # interrupted window replays onto the restored state (dupes allowed,
-        # loss not)
-        interrupted = True
-        log.info("interrupted mid-loop; snapshotting without offset commit")
+        # async interrupt (no flag handler installed, e.g. a non-main
+        # thread): the current message may be half-applied.  Snapshotting
+        # now would overwrite the last CONSISTENT interval snapshot with the
+        # half-mutated state, so treat it exactly like a crash: no close, no
+        # snapshot, no commit -- reboot restores the last good snapshot and
+        # replays from its offsets (dupes allowed, loss and corruption not).
+        log.info("async interrupt; exiting without snapshot or commit")
     finally:
         for sig, h in prev_handlers:
             signal.signal(sig, h)
-        if graceful or interrupted:
+        if graceful:
             pipeline.close(int(time.time() * 1000))
             # final snapshot AFTER close (close may flush tiles / mutate
-            # state), then commit only if it landed AND the exit was
-            # deterministic: persisted state and committed offsets stay in
-            # lockstep.  A crash commits nothing.
+            # state), then commit only if it landed: persisted state and
+            # committed offsets stay in lockstep.  A crash commits nothing.
             saved = on_close() if on_close is not None else None
-            if graceful and manual_commit and (on_close is None or saved):
+            if manual_commit and (on_close is None or saved):
                 consumer.commit()
         consumer.close()
 

@@ -114,6 +114,7 @@ class TraceSynthesizer:
         need_time = n_points * dt
         edges: List[int] = []
         cur = int(self.rng.integers(0, a.num_nodes))
+        consecutive_fails = 0
         for _ in range(max_tries):
             total_time = sum(
                 float(a.edge_len[e]) / max(float(a.edge_speed[e]), 0.1) for e in edges
@@ -125,7 +126,18 @@ class TraceSynthesizer:
                 continue
             leg = self.route(cur, dst)
             if not leg:
+                # real graphs have sink nodes (oneway dead-ends, motorway
+                # tails).  A stuck START is re-drawn immediately; a sink
+                # reached MID-chain can't continue either, so after a few
+                # failed destinations the whole chain restarts from a fresh
+                # start node rather than burning every remaining try.
+                consecutive_fails += 1
+                if not edges or consecutive_fails >= 8:
+                    edges = []
+                    cur = int(self.rng.integers(0, a.num_nodes))
+                    consecutive_fails = 0
                 continue
+            consecutive_fails = 0
             edges.extend(leg)
             cur = dst
         xy, ts, eids = self.walk(edges, dt, t0=0.0) if edges else (np.zeros((0, 2)), np.zeros(0), np.zeros(0, np.int64))
