@@ -357,7 +357,20 @@ inline double queue_length(const std::vector<Pin>& pins, double entry,
   return q;
 }
 
-struct RecordSink {
+// One association record, ways kept separately (variable length).
+struct RecCore {
+  uint8_t has_seg;
+  int64_t seg_id;
+  double t0, t1, len;
+  uint8_t internal;
+  double qlen;
+  int32_t bshape, eshape;
+};
+
+// Caller-backed sink: writes straight into the ctypes output arrays with
+// capacity checks (the original single-thread protocol: -1 -> caller grows
+// the caps and retries).
+struct CallerSink {
   int64_t out_cap;
   int64_t way_cap;
   int64_t n_rec = 0;
@@ -375,14 +388,53 @@ struct RecordSink {
   int32_t* end_shape;
   int64_t* way_start;
   int64_t* way_ids;
+
+  bool add(const RecCore& rc, const std::vector<int64_t>& ways) {
+    if (n_rec >= out_cap || n_way + (int64_t)ways.size() > way_cap) {
+      overflow = true;
+      return false;
+    }
+    int64_t r = n_rec;
+    way_start[r] = n_way;
+    for (int64_t w : ways) way_ids[n_way++] = w;
+    has_seg[r] = rc.has_seg;
+    segment_id[r] = rc.seg_id;
+    start_time[r] = rc.t0;
+    end_time[r] = rc.t1;
+    length[r] = rc.len;
+    internal_flag[r] = rc.internal;
+    queue_len[r] = rc.qlen;
+    begin_shape[r] = rc.bshape;
+    end_shape[r] = rc.eshape;
+    n_rec++;
+    return true;
+  }
+};
+
+// Growable per-thread sink for the multithreaded entry: no overflow is
+// possible, results are merged serially afterwards.
+struct DynSink {
+  std::vector<RecCore> recs;
+  std::vector<int64_t> way_off;  // per record: start into ways
+  std::vector<int64_t> ways;
+  bool overflow = false;  // never set; keeps the template interface uniform
+
+  bool add(const RecCore& rc, const std::vector<int64_t>& w) {
+    way_off.push_back((int64_t)ways.size());
+    ways.insert(ways.end(), w.begin(), w.end());
+    recs.push_back(rc);
+    return true;
+  }
 };
 
 // _segment_records over one finished path.
+template <class Sink>
 void emit_records(const std::vector<Span>& spans, const std::vector<Pin>& pins,
                   const int32_t* edge_seg, const float* edge_seg_off,
                   const uint8_t* edge_internal, const int64_t* edge_way,
                   const int64_t* seg_ids, const float* seg_len,
-                  double queue_thresh_mps, RecordSink* sink) {
+                  double queue_thresh_mps, Sink* sink,
+                  std::vector<int64_t>* way_scratch) {
   size_t i = 0;
   size_t n = spans.size();
   while (i < n) {
@@ -399,36 +451,26 @@ void emit_records(const std::vector<Span>& spans, const std::vector<Pin>& pins,
     double entry_route = first.route_start;
     double exit_route = last.route_start + (last.exit_off - last.enter_off);
 
-    if (sink->n_rec >= sink->out_cap) {
-      sink->overflow = true;
-      return;
-    }
-    int64_t r = sink->n_rec;
-
     // way ids: dedup preserving order (tiny sets; O(g^2) is fine)
-    sink->way_start[r] = sink->n_way;
+    std::vector<int64_t>& ways = *way_scratch;
+    ways.clear();
     for (size_t g = i; g < j; ++g) {
       int64_t w = edge_way[spans[g].edge];
       if (w < 0) continue;
       bool seen = false;
-      for (int64_t q = sink->way_start[r]; q < sink->n_way; ++q)
-        if (sink->way_ids[q] == w) {
+      for (int64_t q : ways)
+        if (q == w) {
           seen = true;
           break;
         }
-      if (seen) continue;
-      if (sink->n_way >= sink->way_cap) {
-        sink->overflow = true;
-        return;
-      }
-      sink->way_ids[sink->n_way++] = w;
+      if (!seen) ways.push_back(w);
     }
 
-    sink->internal_flag[r] = internal ? 1 : 0;
-    sink->queue_len[r] =
-        queue_length(pins, entry_route, exit_route, queue_thresh_mps);
-    sink->begin_shape[r] = shape_index_at(pins, entry_route);
-    sink->end_shape[r] = shape_index_at(pins, exit_route);
+    RecCore rc;
+    rc.internal = internal ? 1 : 0;
+    rc.qlen = queue_length(pins, entry_route, exit_route, queue_thresh_mps);
+    rc.bshape = shape_index_at(pins, entry_route);
+    rc.eshape = shape_index_at(pins, exit_route);
 
     if (seg >= 0 && !internal) {
       double seg_total = (double)seg_len[seg];
@@ -436,21 +478,134 @@ void emit_records(const std::vector<Span>& spans, const std::vector<Pin>& pins,
       double seg_exit = (double)edge_seg_off[last.edge] + last.exit_off;
       bool at_start = seg_entry <= 1e-3;
       bool at_end = seg_exit >= seg_total - 1e-3;
-      sink->has_seg[r] = 1;
-      sink->segment_id[r] = seg_ids[seg];
-      sink->start_time[r] = at_start ? time_at(pins, entry_route) : -1.0;
-      sink->end_time[r] = at_end ? time_at(pins, exit_route) : -1.0;
-      sink->length[r] = (at_start && at_end) ? seg_total : -1.0;
+      rc.has_seg = 1;
+      rc.seg_id = seg_ids[seg];
+      rc.t0 = at_start ? time_at(pins, entry_route) : -1.0;
+      rc.t1 = at_end ? time_at(pins, exit_route) : -1.0;
+      rc.len = (at_start && at_end) ? seg_total : -1.0;
     } else {
-      sink->has_seg[r] = 0;
-      sink->segment_id[r] = -1;
-      sink->start_time[r] = time_at(pins, entry_route);
-      sink->end_time[r] = time_at(pins, exit_route);
-      sink->length[r] = -1.0;
+      rc.has_seg = 0;
+      rc.seg_id = -1;
+      rc.t0 = time_at(pins, entry_route);
+      rc.t1 = time_at(pins, exit_route);
+      rc.len = -1.0;
     }
-    sink->n_rec++;
+    if (!sink->add(rc, ways)) return;
     i = j;
   }
+}
+
+// Inputs shared by every row of one association batch.
+struct AssocInputs {
+  const int32_t* edge_from;
+  const int32_t* edge_to;
+  const float* edge_len;
+  const int32_t* edge_seg;
+  const float* edge_seg_off;
+  const uint8_t* edge_internal;
+  const int64_t* edge_way;
+  const int64_t* seg_ids;
+  const float* seg_len;
+  UbodtView u;
+  int64_t ubodt_rows;
+  int64_t T;
+  const int32_t* m_edge;
+  const float* m_offset;
+  const uint8_t* m_break;
+  const double* m_time;
+  const int32_t* n_points;
+  double queue_thresh_mps;
+  double back_tol;
+};
+
+// Per-thread scratch reused across rows.
+struct AssocScratch {
+  std::vector<Span> spans;
+  std::vector<Pin> pins;
+  std::vector<int32_t> mid;
+  std::vector<int64_t> ways;
+};
+
+// Walk one trace row into records.  Mirrors matching/segments.py exactly.
+template <class Sink>
+void associate_one_row(const AssocInputs& in, int64_t b, Sink* sink,
+                       AssocScratch* sc) {
+  const int32_t* edge = in.m_edge + b * in.T;
+  const float* off = in.m_offset + b * in.T;
+  const uint8_t* brk = in.m_break + b * in.T;
+  const double* tim = in.m_time + b * in.T;
+  int64_t n = in.n_points[b];
+
+  std::vector<Span>& spans = sc->spans;
+  std::vector<Pin>& pins = sc->pins;
+  std::vector<int32_t>& mid = sc->mid;
+  spans.clear();
+  pins.clear();
+  double route_pos = 0.0;
+  bool have_prev = false;
+
+  auto flush = [&]() {
+    if (!spans.empty())
+      emit_records(spans, pins, in.edge_seg, in.edge_seg_off, in.edge_internal,
+                   in.edge_way, in.seg_ids, in.seg_len, in.queue_thresh_mps,
+                   sink, &sc->ways);
+    spans.clear();
+    pins.clear();
+    route_pos = 0.0;
+  };
+
+  for (int64_t t = 0; t < n && !sink->overflow; ++t) {
+    int32_t e_cur = edge[t];
+    double o_cur = (double)off[t];
+    double tm = tim[t];
+    if (e_cur < 0) {  // unmatched: close the current path
+      flush();
+      have_prev = false;
+      continue;
+    }
+    if (!have_prev || brk[t]) {
+      flush();
+      spans.push_back({e_cur, o_cur, o_cur, 0.0});
+      pins.push_back({0.0, tm, (int32_t)t});
+      route_pos = 0.0;
+      have_prev = true;
+      continue;
+    }
+
+    Span& cur = spans.back();
+    int32_t e_prev = cur.edge;
+    bool same_edge = e_cur == e_prev;
+    if (same_edge && o_cur >= cur.exit_off) {
+      route_pos += o_cur - cur.exit_off;
+      cur.exit_off = o_cur;
+    } else if (same_edge && cur.exit_off - o_cur <= in.back_tol) {
+      // small backward jitter: keep position, pin the time only
+    } else {
+      // leave prev edge through its end, route to current edge's start
+      int32_t nd_to = in.edge_to[e_prev];
+      int32_t nd_from = in.edge_from[e_cur];
+      if (!ubodt_path_edges(in.u, in.edge_to, nd_to, nd_from,
+                            in.ubodt_rows + 1, &mid)) {
+        // no route (should have been a break) -- split defensively
+        flush();
+        spans.push_back({e_cur, o_cur, o_cur, 0.0});
+        pins.push_back({0.0, tm, (int32_t)t});
+        route_pos = 0.0;
+        continue;
+      }
+      Span& cur2 = spans.back();  // flush() above may not run; re-take ref
+      route_pos += (double)in.edge_len[e_prev] - cur2.exit_off;
+      cur2.exit_off = (double)in.edge_len[e_prev];
+      for (int32_t me : mid) {
+        spans.push_back({me, 0.0, (double)in.edge_len[me], route_pos});
+        route_pos += (double)in.edge_len[me];
+      }
+      spans.push_back({e_cur, 0.0, o_cur, route_pos});
+      route_pos += o_cur;
+    }
+    pins.push_back({route_pos, tm, (int32_t)t});
+  }
+  flush();
 }
 
 }  // namespace
@@ -482,8 +637,12 @@ int32_t rn_associate_batch(
     double* rec_length, uint8_t* rec_internal, double* rec_queue_len,
     int32_t* rec_begin_shape, int32_t* rec_end_shape, int64_t* way_start,
     int64_t* way_ids_out) {
-  UbodtView u = {t_src, t_dst, t_first_edge, mask, max_probes};
-  RecordSink sink;
+  AssocInputs in = {edge_from, edge_to,  edge_len, edge_seg, edge_seg_off,
+                    edge_internal, edge_way, seg_ids,  seg_len,
+                    {t_src, t_dst, t_first_edge, mask, max_probes},
+                    ubodt_rows, T, m_edge, m_offset, m_break, m_time,
+                    n_points, queue_thresh_mps, back_tol};
+  CallerSink sink;
   sink.out_cap = out_cap;
   sink.way_cap = way_cap;
   sink.has_seg = rec_has_seg;
@@ -498,88 +657,126 @@ int32_t rn_associate_batch(
   sink.way_start = way_start;
   sink.way_ids = way_ids_out;
 
-  std::vector<Span> spans;
-  std::vector<Pin> pins;
-  std::vector<int32_t> mid;
-
+  AssocScratch sc;
   for (int64_t b = 0; b < B; ++b) {
-    const int32_t* edge = m_edge + b * T;
-    const float* off = m_offset + b * T;
-    const uint8_t* brk = m_break + b * T;
-    const double* tim = m_time + b * T;
-    int64_t n = n_points[b];
-
-    spans.clear();
-    pins.clear();
-    double route_pos = 0.0;
-    bool have_prev = false;
-
-    auto flush = [&]() {
-      if (!spans.empty())
-        emit_records(spans, pins, edge_seg, edge_seg_off, edge_internal,
-                     edge_way, seg_ids, seg_len, queue_thresh_mps, &sink);
-      spans.clear();
-      pins.clear();
-      route_pos = 0.0;
-    };
-
-    for (int64_t t = 0; t < n && !sink.overflow; ++t) {
-      int32_t e_cur = edge[t];
-      double o_cur = (double)off[t];
-      double tm = tim[t];
-      if (e_cur < 0) {  // unmatched: close the current path
-        flush();
-        have_prev = false;
-        continue;
-      }
-      if (!have_prev || brk[t]) {
-        flush();
-        spans.push_back({e_cur, o_cur, o_cur, 0.0});
-        pins.push_back({0.0, tm, (int32_t)t});
-        route_pos = 0.0;
-        have_prev = true;
-        continue;
-      }
-
-      Span& cur = spans.back();
-      int32_t e_prev = cur.edge;
-      bool same_edge = e_cur == e_prev;
-      if (same_edge && o_cur >= cur.exit_off) {
-        route_pos += o_cur - cur.exit_off;
-        cur.exit_off = o_cur;
-      } else if (same_edge && cur.exit_off - o_cur <= back_tol) {
-        // small backward jitter: keep position, pin the time only
-      } else {
-        // leave prev edge through its end, route to current edge's start
-        int32_t nd_to = edge_to[e_prev];
-        int32_t nd_from = edge_from[e_cur];
-        if (!ubodt_path_edges(u, edge_to, nd_to, nd_from, ubodt_rows + 1,
-                              &mid)) {
-          // no route (should have been a break) -- split defensively
-          flush();
-          spans.push_back({e_cur, o_cur, o_cur, 0.0});
-          pins.push_back({0.0, tm, (int32_t)t});
-          route_pos = 0.0;
-          continue;
-        }
-        Span& cur2 = spans.back();  // flush() above may not run; re-take ref
-        route_pos += (double)edge_len[e_prev] - cur2.exit_off;
-        cur2.exit_off = (double)edge_len[e_prev];
-        for (int32_t me : mid) {
-          spans.push_back({me, 0.0, (double)edge_len[me], route_pos});
-          route_pos += (double)edge_len[me];
-        }
-        spans.push_back({e_cur, 0.0, o_cur, route_pos});
-        route_pos += o_cur;
-      }
-      pins.push_back({route_pos, tm, (int32_t)t});
-    }
-    flush();
+    associate_one_row(in, b, &sink, &sc);
     rec_start[b] = sink.n_rec;
     if (sink.overflow) return -1;
   }
   // way range end per record (way_start is sized out_cap + 1 by the caller)
   way_start[sink.n_rec] = sink.n_way;
+  return 0;
+}
+
+}  // extern "C"
+
+#include <thread>
+
+extern "C" {
+
+// Multithreaded association (VERDICT r02 next #3): rows are independent, so
+// they are partitioned over `num_threads` workers (<=0 -> hardware
+// concurrency, capped at 16 and at B), each emitting into a growable
+// per-thread sink; a serial merge then lays the records out in row order,
+// bit-identical to the single-thread entry.  The ctypes call releases the
+// GIL, so the Python service thread stays responsive while this runs.
+// Returns 0 on success; -1 when the merged output exceeds out_cap/way_cap,
+// with *needed_rec / *needed_way set to the exact sizes so the caller can
+// resize once and retry.
+int32_t rn_associate_batch_mt(
+    // graph
+    const int32_t* edge_from, const int32_t* edge_to, const float* edge_len,
+    const int32_t* edge_seg, const float* edge_seg_off,
+    const uint8_t* edge_internal, const int64_t* edge_way,
+    const int64_t* seg_ids, const float* seg_len,
+    // ubodt
+    const int32_t* t_src, const int32_t* t_dst, const int32_t* t_first_edge,
+    int64_t mask, int32_t max_probes, int64_t ubodt_rows,
+    // matches
+    int64_t B, int64_t T, const int32_t* m_edge, const float* m_offset,
+    const uint8_t* m_break, const double* m_time, const int32_t* n_points,
+    // params
+    double queue_thresh_mps, double back_tol, int32_t num_threads,
+    // outputs
+    int64_t out_cap, int64_t way_cap, int64_t* rec_start, uint8_t* rec_has_seg,
+    int64_t* rec_segment_id, double* rec_start_time, double* rec_end_time,
+    double* rec_length, uint8_t* rec_internal, double* rec_queue_len,
+    int32_t* rec_begin_shape, int32_t* rec_end_shape, int64_t* way_start,
+    int64_t* way_ids_out, int64_t* needed_rec, int64_t* needed_way) {
+  AssocInputs in = {edge_from, edge_to,  edge_len, edge_seg, edge_seg_off,
+                    edge_internal, edge_way, seg_ids,  seg_len,
+                    {t_src, t_dst, t_first_edge, mask, max_probes},
+                    ubodt_rows, T, m_edge, m_offset, m_break, m_time,
+                    n_points, queue_thresh_mps, back_tol};
+  if (num_threads <= 0) {
+    unsigned hc = std::thread::hardware_concurrency();
+    num_threads = hc ? (int32_t)hc : 4;
+    if (num_threads > 16) num_threads = 16;
+  }
+  if ((int64_t)num_threads > B) num_threads = (int32_t)(B > 0 ? B : 1);
+
+  // contiguous row ranges per thread; each sink also records per-row record
+  // counts so the merge can rebuild rec_start exactly
+  std::vector<DynSink> sinks((size_t)num_threads);
+  std::vector<std::vector<int64_t>> row_end((size_t)num_threads);
+  int64_t rows_per = (B + num_threads - 1) / num_threads;
+
+  auto work = [&](int32_t ti) {
+    int64_t b0 = (int64_t)ti * rows_per;
+    if (b0 >= B) return;  // ceil-divided ranges can leave late threads empty
+    int64_t b1 = b0 + rows_per < B ? b0 + rows_per : B;
+    DynSink& sink = sinks[(size_t)ti];
+    std::vector<int64_t>& ends = row_end[(size_t)ti];
+    ends.reserve((size_t)(b1 - b0));
+    AssocScratch sc;
+    for (int64_t b = b0; b < b1; ++b) {
+      associate_one_row(in, b, &sink, &sc);
+      ends.push_back((int64_t)sink.recs.size());
+    }
+  };
+
+  if (num_threads == 1) {
+    work(0);
+  } else {
+    std::vector<std::thread> threads;
+    threads.reserve((size_t)num_threads);
+    for (int32_t ti = 0; ti < num_threads; ++ti) threads.emplace_back(work, ti);
+    for (auto& t : threads) t.join();
+  }
+
+  int64_t total_rec = 0, total_way = 0;
+  for (const DynSink& s : sinks) {
+    total_rec += (int64_t)s.recs.size();
+    total_way += (int64_t)s.ways.size();
+  }
+  *needed_rec = total_rec;
+  *needed_way = total_way;
+  if (total_rec > out_cap || total_way > way_cap) return -1;
+
+  int64_t r = 0, w = 0, row = 0;
+  for (int32_t ti = 0; ti < num_threads; ++ti) {
+    const DynSink& s = sinks[(size_t)ti];
+    int64_t base_r = r;
+    for (size_t k = 0; k < s.recs.size(); ++k, ++r) {
+      const RecCore& rc = s.recs[k];
+      rec_has_seg[r] = rc.has_seg;
+      rec_segment_id[r] = rc.seg_id;
+      rec_start_time[r] = rc.t0;
+      rec_end_time[r] = rc.t1;
+      rec_length[r] = rc.len;
+      rec_internal[r] = rc.internal;
+      rec_queue_len[r] = rc.qlen;
+      rec_begin_shape[r] = rc.bshape;
+      rec_end_shape[r] = rc.eshape;
+      int64_t w0 = s.way_off[k];
+      int64_t w1 = k + 1 < s.way_off.size() ? s.way_off[k + 1]
+                                            : (int64_t)s.ways.size();
+      way_start[r] = w;
+      for (int64_t q = w0; q < w1; ++q) way_ids_out[w++] = s.ways[(size_t)q];
+    }
+    for (int64_t end : row_end[(size_t)ti]) rec_start[row++] = base_r + end;
+  }
+  way_start[r] = w;
   return 0;
 }
 

@@ -103,6 +103,14 @@ def associate_segments_batch(
 
     out_cap = int(m_edge.size) * 2 + 64 * B + 64
     way_cap = out_cap * 2
+    use_mt = hasattr(lib, "rn_associate_batch_mt")
+    import ctypes as _ct
+    import os as _os
+
+    try:
+        n_threads = int(_os.environ.get("REPORTER_ASSOC_THREADS", "0"))
+    except ValueError:
+        n_threads = 0  # malformed knob must not gate association
     while True:
         rec_start = np.zeros(B + 1, np.int64)
         has_seg = np.zeros(out_cap, np.uint8)
@@ -116,6 +124,26 @@ def associate_segments_batch(
         eshape = np.zeros(out_cap, np.int32)
         way_start = np.zeros(out_cap + 1, np.int64)
         way_ids = np.zeros(way_cap, np.int64)
+        if use_mt:
+            # rows fan out over C++ threads (ctypes releases the GIL); on
+            # overflow the exact needed sizes come back so one retry suffices
+            need_rec = _ct.c_int64(0)
+            need_way = _ct.c_int64(0)
+            rc = lib.rn_associate_batch_mt(
+                g_from, g_to, g_len, g_seg, g_seg_off, g_internal, g_way,
+                s_ids, s_len, t_src, t_dst, t_fe, int(ubodt.mask),
+                int(ubodt.max_probes), int(ubodt.num_rows), B, T, m_edge,
+                m_off, m_brk, m_tim, n_pts, float(queue_thresh_mps),
+                float(back_tol), n_threads, out_cap, way_cap,
+                rec_start[1:], has_seg, seg_id, t0, t1, length, internal,
+                qlen, bshape, eshape, way_start, way_ids,
+                _ct.byref(need_rec), _ct.byref(need_way),
+            )
+            if rc == 0:
+                break
+            out_cap = max(out_cap * 2, int(need_rec.value))
+            way_cap = max(way_cap * 2, int(need_way.value))
+            continue
         rc = lib.rn_associate_batch(
             g_from, g_to, g_len, g_seg, g_seg_off, g_internal, g_way, s_ids,
             s_len, t_src, t_dst, t_fe, int(ubodt.mask), int(ubodt.max_probes),

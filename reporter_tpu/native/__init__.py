@@ -98,6 +98,20 @@ _SYMBOLS = {
         ctypes.c_int64, ctypes.c_int64, _i64p, _u8p, _i64p, _f64p, _f64p,
         _f64p, _u8p, _f64p, _i32p, _i32p, _i64p, _i64p,
     ]),
+    "rn_associate_batch_mt": (ctypes.c_int32, [
+        # graph
+        _i32p, _i32p, _f32p, _i32p, _f32p, _u8p, _i64p, _i64p, _f32p,
+        # ubodt
+        _i32p, _i32p, _i32p, ctypes.c_int64, ctypes.c_int32, ctypes.c_int64,
+        # matches
+        ctypes.c_int64, ctypes.c_int64, _i32p, _f32p, _u8p, _f64p, _i32p,
+        # params
+        ctypes.c_double, ctypes.c_double, ctypes.c_int32,
+        # outputs
+        ctypes.c_int64, ctypes.c_int64, _i64p, _u8p, _i64p, _f64p, _f64p,
+        _f64p, _u8p, _f64p, _i32p, _i32p, _i64p, _i64p,
+        ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
+    ]),
 }
 
 

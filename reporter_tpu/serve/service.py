@@ -52,15 +52,33 @@ class MicroBatcher:
     waits up to ``max_wait_ms`` to fill ``max_batch`` slots, runs
     matcher.match_many once, and resolves the futures.  Batching across
     requests is what keeps the TPU busy when clients send one trace per call.
+
+    The worker is split in two stages (VERDICT r02 next #3): the dispatch
+    thread only forms batches and queues device work
+    (matcher.match_many_async), while a separate finisher thread blocks on
+    the device and runs host segment association.  Association of batch N
+    therefore overlaps device compute of batch N+1 instead of stalling the
+    dispatch loop.  The hand-off queue is bounded to keep device-pinned
+    input memory in check (backpressure on dispatch, not unbounded queueing).
+
+    Device-memory bound: each undrained async call can pin up to
+    matcher.PIPELINE_DEPTH chunks, and (max_inflight + 2) calls can overlap
+    in the worst case (one dispatching, max_inflight queued, one finishing)
+    -- so size max_device_points for (max_inflight + 2) * PIPELINE_DEPTH
+    chunks, not PIPELINE_DEPTH alone.
     """
 
-    def __init__(self, matcher: SegmentMatcher, max_batch: int = 64, max_wait_ms: float = 10.0):
+    def __init__(self, matcher: SegmentMatcher, max_batch: int = 64, max_wait_ms: float = 10.0,
+                 max_inflight: int = 2):
         self.matcher = matcher
         self.max_batch = max_batch
         self.max_wait = max_wait_ms / 1000.0
         self._q: "queue.Queue[Tuple[dict, Future]]" = queue.Queue()
+        self._finish_q: "queue.Queue[tuple]" = queue.Queue(maxsize=max_inflight)
         self._thread = threading.Thread(target=self._worker, daemon=True)
         self._thread.start()
+        self._finisher = threading.Thread(target=self._finish_worker, daemon=True)
+        self._finisher.start()
 
     def submit(self, trace: dict) -> Future:
         f: Future = Future()
@@ -73,6 +91,12 @@ class MicroBatcher:
     def match_many(self, traces: List[dict]) -> List[dict]:
         futures = [self.submit(t) for t in traces]
         return [f.result() for f in futures]
+
+    @staticmethod
+    def _fail_batch(batch, e: Exception) -> None:
+        for _, f in batch:
+            if f.set_running_or_notify_cancel():
+                f.set_exception(e)
 
     def _worker(self):
         import time as _time
@@ -92,16 +116,25 @@ class MicroBatcher:
                 except queue.Empty:
                     break
             try:
-                results = self.matcher.match_many([t for t, _ in batch])
+                finish = self.matcher.match_many_async([t for t, _ in batch])
+            except Exception as e:
+                log.exception("batch dispatch failed")
+                self._fail_batch(batch, e)
+                continue
+            self._finish_q.put((batch, finish))  # blocks when finisher lags
+
+    def _finish_worker(self):
+        while True:
+            batch, finish = self._finish_q.get()
+            try:
+                results = finish()
                 for (t, f), r in zip(batch, results):
                     if not f.set_running_or_notify_cancel():
                         continue
                     f.set_result(r)
             except Exception as e:  # resolve everything with the error
                 log.exception("batch match failed")
-                for _, f in batch:
-                    if f.set_running_or_notify_cancel():
-                        f.set_exception(e)
+                self._fail_batch(batch, e)
 
 
 class ReporterService:

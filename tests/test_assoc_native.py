@@ -93,3 +93,37 @@ def test_native_all_unmatched(setup):
         arrays, ubodt, edge, offset, breaks, tm, np.full(B, T, np.int32), lib=lib
     )
     assert out == [[], [], []]
+
+
+def test_native_mt_matches_single_thread(setup, monkeypatch):
+    """The multithreaded entry must produce byte-identical records for every
+    thread count, including uneven row partitions (B not divisible)."""
+    arrays, ubodt = setup
+    lib = get_lib()
+    if lib is None or not hasattr(lib, "rn_associate_batch_mt"):
+        pytest.skip("native mt entry unavailable")
+    cfg, edge, offset, breaks, abs_tm = _matched_batch(arrays, ubodt, B=13, T=24)
+    B, T = edge.shape
+    edge = edge.copy()
+    edge[0, 5] = -1  # flush paths in the first and last thread's ranges
+    edge[12, 20] = -1
+    n_pts = np.full(B, T, np.int32)
+    n_pts[6] = 11
+    kw = dict(
+        queue_thresh_mps=cfg.queue_speed_threshold_kph / 3.6,
+        back_tol=2.0 * cfg.sigma_z + 5.0,
+    )
+    outs = []
+    for threads in ("1", "3", "8", "32"):  # 32 > B exercises the B clamp
+        monkeypatch.setenv("REPORTER_ASSOC_THREADS", threads)
+        outs.append(
+            associate_segments_batch(
+                arrays, ubodt, edge, offset, breaks, abs_tm, n_pts, lib=lib, **kw
+            )
+        )
+    oracle = _fallback(
+        arrays, ubodt, edge, offset, breaks, abs_tm, n_pts,
+        kw["queue_thresh_mps"], kw["back_tol"],
+    )
+    for out in outs:
+        assert out == oracle

@@ -1,0 +1,60 @@
+#!/usr/bin/env python3
+"""Fast TPU reachability probe.
+
+Initialises the axon backend under a watchdog thread, runs one bf16
+matmul, prints a one-line JSON verdict, and exits 0 only if a non-CPU
+device executed it.  Used by tools/tpu_watch.py to decide whether the
+relay that just appeared is actually granting chips before committing to
+a full bench run.  Exit codes: 0 = TPU live, 2 = init timeout, 3 = init
+error, 4 = got CPU.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+
+TIMEOUT_S = float(os.environ.get("TPU_PROBE_TIMEOUT", "180"))
+
+
+def main() -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "axon")
+    result: dict = {}
+
+    def _init():
+        try:
+            import jax
+            import jax.numpy as jnp
+
+            devs = jax.devices()
+            result["platform"] = devs[0].platform
+            result["device"] = str(devs[0])
+            result["count"] = len(devs)
+            t0 = time.time()
+            x = jnp.ones((1024, 1024), jnp.bfloat16)
+            (x @ x).block_until_ready()
+            result["matmul_s"] = round(time.time() - t0, 2)
+        except Exception as e:  # noqa: BLE001
+            result["error"] = "%s: %s" % (type(e).__name__, e)
+
+    t = threading.Thread(target=_init, daemon=True)
+    t0 = time.time()
+    t.start()
+    t.join(TIMEOUT_S)
+    result["elapsed_s"] = round(time.time() - t0, 1)
+    print(json.dumps(result))
+    sys.stdout.flush()
+    if t.is_alive():
+        return 2
+    if "error" in result:
+        return 3
+    if result.get("platform") == "cpu":
+        return 4
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
