@@ -4,37 +4,53 @@
 Prints exactly ONE JSON line to stdout:
   {"metric": "traces_matched_per_sec_per_chip", "value": N,
    "unit": "traces/s", "vs_baseline": R, ...}
-with extra diagnostic fields (p50 per-trace latency, platform, which
-forward kernel ran, segment agreement, device memory footprint).
 
-Accelerator acquisition (VERDICT r01 #1): the TPU grant can take minutes to
-arrive through the tunnel, so the old 90 s throwaway-subprocess probe gave
-up and benched CPU.  Now the default backend is initialised IN-PROCESS
-under a watchdog thread with a long budget (BENCH_TPU_WAIT, default 600 s,
-progress lines every 30 s).  On success the device stays held by this very
-process for the whole bench.  On timeout the process re-execs itself for a
-fresh claim (BENCH_TPU_ATTEMPTS, default 2) before finally re-execing with
-JAX_PLATFORMS=cpu -- the fallback is explicit in the output, never silent.
+Three roles in one file (BENCH_ROLE env):
 
-Scenario (VERDICT r01 #5): metro-scale synthetic city -- >=50k edges,
-UBODT in the tens of millions of rows built by the native C++ builder at
-full delta=3000 m, mixed trace lengths (64/256/1024 points; the 1024-point
-cohort exceeds the largest length bucket and exercises carried-state
-streaming), noisy 5 s sampling.  The full public match path is timed
-(device Viterbi + host segment association); kernel-only and p50
-single-trace latency are measured separately.  The reference's operating
-point for comparison: one Meili C++ process per request thread
-(reporter_service.py:52, BASELINE.json config 1), measured here as the CPU
-oracle on the same scenario.
+  orchestrator (default)  never initialises a jax backend.  It launches the
+      CPU-oracle baseline subprocess IMMEDIATELY and, concurrently, the
+      device-worker subprocess -- so the reference-point measurement and the
+      scenario build overlap the accelerator wait instead of idling behind
+      it (VERDICT r02 next #1a).  It watches the axon loopback-relay ports
+      to DIAGNOSE a stalled grant (no listener = no chance of a grant; the
+      state is reported in the JSON instead of a bare timeout, #1b), kills
+      a hopeless attempt early, falls back to a CPU device run, and retries
+      the accelerator once more afterwards if the relay has appeared
+      (#1c/#1d).
+
+  device  acquires the backend under a watchdog thread while the metro
+      scenario builds on the main thread (the build is numpy+native C++;
+      jax is first touched after the grant).  Then: end-to-end throughput,
+      p50/p95 single-trace latency, per-cohort kernel-only throughput and
+      agreement, device utilisation, and -- on TPU -- scan-vs-pallas
+      on-chip parity and throughput (VERDICT r02 next #2).
+
+  baseline  the reference operating point: the single-process CPU oracle
+      (one Meili C++ engine per process, reporter_service.py:52,240;
+      BASELINE.json config 1) run for >= BENCH_BASELINE_SECS (default 60,
+      VERDICT r02 weak #3) on the same scenario.
+
+Scenario: metro-scale synthetic city -- >=50k edges, UBODT in the tens of
+millions of rows (native builder, full delta), mixed 64/256/1024-pt cohorts;
+the 1024-pt cohort exceeds the largest length bucket and exercises
+carried-state streaming.
+
+vs_baseline semantics (ADVICE r02): the headline "vs_baseline" is a
+POINTS/S ratio (work-normalised; the cpu subset's length mix differs
+slightly from the fleet's); "vs_baseline_traces" is the raw traces/s ratio;
+"vs_baseline_basis" names the basis.  p50/p95 latency is measured on the
+64-pt short cohort ("latency_cohort").
 """
 
 import json
 import os
+import subprocess
 import sys
+import tempfile
 import time
 
-WAIT_DEFAULT = 600.0  # seconds to wait for the accelerator grant, per attempt
-ATTEMPTS_DEFAULT = 2
+WAIT_DEFAULT = 600.0  # budget for the accelerator grant (relay present)
+GRACE_DEFAULT = 180.0  # budget when no relay is listening at all
 
 
 def _stderr(msg: str) -> None:
@@ -42,96 +58,24 @@ def _stderr(msg: str) -> None:
     sys.stderr.flush()
 
 
-def _reexec(env_updates: dict) -> None:
-    env = dict(os.environ)
-    env.update(env_updates)
-    os.execve(sys.executable, [sys.executable, os.path.abspath(__file__)] + sys.argv[1:], env)
+def _relay_ports_open():
+    from reporter_tpu.utils.relay import relay_ports_open
+
+    return relay_ports_open()
 
 
-def acquire_accelerator() -> str:
-    """Initialise jax's default backend in-process under a watchdog.
-
-    Returns the platform name once devices are live.  Never returns on
-    timeout: re-execs for a fresh claim attempt or the CPU fallback (a hung
-    PJRT init can't be cancelled in-process, so a clean process is the only
-    real retry)."""
-    # prune PJRT factories outside the selected platform set BEFORE first
-    # backend use: a dead non-selected plugin must not hang the selected
-    # backend's init (jaxenv.py module docs)
-    from reporter_tpu.utils.jaxenv import ensure_platform
-
-    ensure_platform()
-
-    plat_env = os.environ.get("JAX_PLATFORMS", "")
-    if plat_env == "cpu":
-        import jax
-
-        return jax.devices()[0].platform
-
-    wait_s = float(os.environ.get("BENCH_TPU_WAIT", str(WAIT_DEFAULT)))
-    attempts = int(os.environ.get("BENCH_TPU_ATTEMPTS", str(ATTEMPTS_DEFAULT)))
-    attempt = int(os.environ.get("BENCH_TPU_ATTEMPT", "1"))
-
-    import threading
-
-    result: dict = {}
-
-    def _init():
-        try:
-            import jax
-
-            devs = jax.devices()
-            result["platform"] = devs[0].platform
-            result["count"] = len(devs)
-        except Exception as e:  # noqa: BLE001 - report, don't crash the bench
-            result["error"] = "%s: %s" % (type(e).__name__, e)
-
-    t = threading.Thread(target=_init, daemon=True, name="accel-init")
-    start = time.time()
-    t.start()
-    while t.is_alive() and time.time() - start < wait_s:
-        t.join(timeout=30.0)
-        if t.is_alive():
-            _stderr(
-                "waiting for accelerator grant (%.0fs/%.0fs, attempt %d/%d)"
-                % (time.time() - start, wait_s, attempt, attempts)
-            )
-    if "platform" in result:
-        _stderr(
-            "accelerator acquired: %s (%d device(s), %.1fs, attempt %d)"
-            % (result["platform"], result["count"], time.time() - start, attempt)
-        )
-        return result["platform"]
-    if "error" in result:
-        _stderr("accelerator init failed: %s" % result["error"])
-    else:
-        _stderr("accelerator init still blocked after %.0fs" % wait_s)
-    if attempt < attempts:
-        _stderr("re-exec for fresh claim attempt %d/%d" % (attempt + 1, attempts))
-        _reexec({"BENCH_TPU_ATTEMPT": str(attempt + 1)})
-    _stderr("falling back to cpu (explicit; platform is reported in the JSON line)")
-    _reexec({"JAX_PLATFORMS": "cpu"})
-    raise AssertionError("unreachable")  # pragma: no cover
+# ---------------------------------------------------------------------------
+# shared scenario
 
 
-def main():
-    platform = acquire_accelerator()
-
-    import numpy as np
-    import jax
-    import jax.numpy as jnp
-
-    _stderr("running on %s (%d device(s))" % (platform, len(jax.devices())))
-
-    from reporter_tpu.matching import MatcherConfig, SegmentMatcher
+def build_scenario():
+    """Metro-scale city + UBODT + mixed trace cohorts.  numpy + native C++
+    only -- safe to run while the jax backend is still initialising."""
     from reporter_tpu.synth import TraceSynthesizer
-    from reporter_tpu.synth.generator import segment_agreement
     from reporter_tpu.tiles.arrays import build_graph_arrays
     from reporter_tpu.tiles.network import grid_city
     from reporter_tpu.tiles.ubodt import build_ubodt
 
-    # metro-scale synthetic city: >=50k edges at the default grid, UBODT at
-    # the full matcher delta (native C++ builder; no problem-shrinking)
     rows = cols = int(os.environ.get("BENCH_GRID", "120"))
     delta = float(os.environ.get("BENCH_DELTA", "3000"))
     t0 = time.time()
@@ -146,62 +90,160 @@ def main():
            (ubodt.mask + 1) * 20 / 1e6, time.time() - t0)
     )
 
-    cfg = MatcherConfig()
-
-    # mixed trace cohorts; the long cohort exceeds the largest length bucket
-    # and streams through carried-state chunks (ops/viterbi.py TraceCarry)
     n_short = int(os.environ.get("BENCH_TRACES", "192"))
     n_med = int(os.environ.get("BENCH_TRACES_MED", "48"))
     n_long = int(os.environ.get("BENCH_TRACES_LONG", "16"))
-    len_short, len_med, len_long = 64, 256, 1024
+    cohorts = []
     synth = TraceSynthesizer(arrays, seed=7)
     t0 = time.time()
-    s_short = synth.batch(n_short, len_short, dt=5.0, sigma=5.0)
-    s_med = synth.batch(n_med, len_med, dt=5.0, sigma=5.0)
+    cohorts.append(("short", 64, synth.batch(n_short, 64, dt=5.0, sigma=5.0)))
+    cohorts.append(("med", 256, synth.batch(n_med, 256, dt=5.0, sigma=5.0)))
     # long drives chain many route legs; raise the leg cap so they fit even
     # on small override grids
-    s_long = synth.batch(n_long, len_long, dt=5.0, sigma=5.0, max_tries=400)
-    straces = s_short + s_med + s_long
-    traces = [s.trace for s in straces]
-    n_traces = len(traces)
-    n_points_total = n_short * len_short + n_med * len_med + n_long * len_long
+    cohorts.append(("long", 1024, synth.batch(n_long, 1024, dt=5.0, sigma=5.0, max_tries=400)))
+    n_pts = sum(n * len(s) for _, n, s in cohorts)
     _stderr(
-        "synthesized %d traces (%dx%d + %dx%d + %dx%d = %d pts, %.1fs)"
-        % (n_traces, n_short, len_short, n_med, len_med, n_long, len_long,
-           n_points_total, time.time() - t0)
+        "synthesized %d traces (%d pts, %.1fs)"
+        % (sum(len(s) for _, _, s in cohorts), n_pts, time.time() - t0)
     )
+    return arrays, ubodt, cohorts
 
+
+def _cohort_xy(arrays, straces, T):
+    import numpy as np
+
+    B = len(straces)
+    px = np.zeros((B, T), np.float32)
+    py = np.zeros((B, T), np.float32)
+    tm = np.zeros((B, T), np.float32)
+    valid = np.ones((B, T), bool)
+    for i, s in enumerate(straces):
+        pts = s.trace["trace"]
+        x, y = arrays.proj.to_xy([p["lat"] for p in pts], [p["lon"] for p in pts])
+        px[i], py[i] = x, y
+        tm[i] = np.asarray([p["time"] for p in pts]) - pts[0]["time"]
+    return px, py, tm, valid
+
+
+# ---------------------------------------------------------------------------
+# device worker
+
+
+def _write_status(**kw):
+    path = os.environ.get("BENCH_STATUS_FILE")
+    if not path:
+        return
+    kw["t"] = round(time.time(), 1)
+    try:
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(kw, f)
+        os.replace(tmp, path)
+    except OSError:
+        pass
+
+
+def run_device() -> int:
+    from reporter_tpu.utils.jaxenv import ensure_platform
+
+    ensure_platform()
+    want = os.environ.get("JAX_PLATFORMS", "")
+    wait_s = float(os.environ.get("BENCH_ACQUIRE_WAIT", str(WAIT_DEFAULT)))
+
+    import threading
+
+    acquired: dict = {}
+
+    def _init():
+        try:
+            import jax
+
+            devs = jax.devices()
+            acquired["platform"] = devs[0].platform
+            acquired["count"] = len(devs)
+        except Exception as e:  # noqa: BLE001
+            acquired["error"] = "%s: %s" % (type(e).__name__, e)
+
+    t_start = time.time()
+    _write_status(phase="acquiring", platform=None)
+    init_thread = threading.Thread(target=_init, daemon=True, name="accel-init")
+    init_thread.start()
+
+    # scenario build overlaps the grant wait (numpy + native only)
+    arrays, ubodt, cohorts = build_scenario()
+    _write_status(phase="built", platform=acquired.get("platform"))
+
+    while init_thread.is_alive() and time.time() - t_start < wait_s:
+        init_thread.join(timeout=15.0)
+        if init_thread.is_alive():
+            _stderr("waiting for accelerator grant (%.0fs/%.0fs)"
+                    % (time.time() - t_start, wait_s))
+            _write_status(phase="acquiring_post_build", platform=None)
+    if "platform" not in acquired:
+        if "error" in acquired:
+            _stderr("accelerator init failed: %s" % acquired["error"])
+        else:
+            _stderr("accelerator init still blocked after %.0fs" % (time.time() - t_start))
+        _write_status(phase="failed", platform=None, error=acquired.get("error"))
+        return 3
+    platform = acquired["platform"]
+    acquire_s = time.time() - t_start
+    _stderr("accelerator acquired: %s (%d device(s), %.1fs; wanted %r)"
+            % (platform, acquired["count"], acquire_s, want))
+
+    # the CPU-oracle baseline must not share cores with warmup/compile or a
+    # CPU device run: wait for the orchestrator's go-file (written when the
+    # baseline's timed window is over) before any jax compute.  Bounded wait
+    # so a dead orchestrator can't hang the worker.
+    go_file = os.environ.get("BENCH_GO_FILE")
+    if go_file:
+        t0 = time.time()
+        while not os.path.exists(go_file) and time.time() - t0 < 420.0:
+            _write_status(phase="waiting_for_baseline", platform=platform)
+            time.sleep(1.0)
+        if not os.path.exists(go_file):
+            _stderr("go-file never appeared; benching anyway after 420s")
+    _write_status(phase="benching", platform=platform)
+
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    from reporter_tpu.matching import MatcherConfig, SegmentMatcher
+    from reporter_tpu.matching.matcher import _pad_rows
+    from reporter_tpu.synth.generator import segment_agreement
+
+    cfg = MatcherConfig()
     matcher = SegmentMatcher(arrays=arrays, ubodt=ubodt, config=cfg)
+    traces = [s.trace for _, _, ss in cohorts for s in ss]
+    n_traces = len(traces)
+    n_points_total = sum(T * len(ss) for _, T, ss in cohorts)
+    n_short = len(cohorts[0][2])
 
-    # device-resident bytes: graph + ubodt arrays pinned in HBM
     def _tree_bytes(tree) -> int:
-        return sum(
-            x.nbytes for x in jax.tree_util.tree_leaves(tree) if hasattr(x, "nbytes")
-        )
+        return sum(x.nbytes for x in jax.tree_util.tree_leaves(tree) if hasattr(x, "nbytes"))
 
     hbm_mb = (_tree_bytes(matcher._dg) + _tree_bytes(matcher._du)) / 1e6
     _stderr("device-resident graph+ubodt: %.0f MB" % hbm_mb)
 
-    # warmup/compile: full mixed set so every bucket shape is compiled before
-    # the timed loop
     t0 = time.time()
     matcher.match_many(traces)
     _stderr("warmup/compile %.1fs" % (time.time() - t0))
 
-    # end-to-end throughput (device viterbi + host segment association)
+    # end-to-end throughput (device viterbi + parallel host association)
     reps = int(os.environ.get("BENCH_REPS", "3"))
     t0 = time.time()
     for _ in range(reps):
-        results = matcher.match_many(traces)
-    wall = time.time() - t0
-    tps = n_traces * reps / wall
-    pps = n_points_total * reps / wall
+        matcher.match_many(traces)
+    e2e_wall = time.time() - t0
+    tps = n_traces * reps / e2e_wall
+    pps = n_points_total * reps / e2e_wall
 
-    # p50 per-trace latency (BASELINE.json secondary metric): single-trace
-    # calls through the same public path, at the streaming operating point
-    # (a ~64-pt window, BatchingProcessor-style flush)
+    # p50/p95 per-trace latency at the streaming operating point (~64-pt
+    # window, BatchingProcessor-style flush) -- short cohort only, named in
+    # the JSON (ADVICE r02)
     lat_reps = int(os.environ.get("BENCH_LAT_REPS", "40"))
-    matcher.match_many([traces[0]])  # compile the B=1 shape
+    matcher.match_many([traces[0]])
     lats = []
     for i in range(lat_reps):
         t0 = time.time()
@@ -209,92 +251,406 @@ def main():
         lats.append(time.time() - t0)
     p50_ms = float(np.percentile(np.asarray(lats), 50) * 1000.0)
     p95_ms = float(np.percentile(np.asarray(lats), 95) * 1000.0)
-    _stderr("per-trace latency p50 %.1f ms / p95 %.1f ms (%d reps)" % (p50_ms, p95_ms, lat_reps))
+    _stderr("per-trace latency p50 %.1f ms / p95 %.1f ms (%d reps, short cohort)"
+            % (p50_ms, p95_ms, lat_reps))
 
-    # kernel-only throughput on the short cohort: the same compact kernel the
-    # matcher dispatches (pallas on TPU, lax.scan elsewhere)
-    from reporter_tpu.matching.matcher import _pad_rows
-    from reporter_tpu.ops.viterbi import match_batch
-
-    B, T = n_short, len_short
-    px = np.zeros((B, T), np.float32)
-    py = np.zeros((B, T), np.float32)
-    tm = np.zeros((B, T), np.float32)
-    valid = np.ones((B, T), bool)
-    for i, s in enumerate(s_short):
-        pts = s.trace["trace"]
-        x, y = arrays.proj.to_xy([p["lat"] for p in pts], [p["lon"] for p in pts])
-        px[i], py[i] = x, y
-        tm[i] = np.asarray([p["time"] for p in pts]) - pts[0]["time"]
-
-    dg, du, p = matcher._dg, matcher._du, matcher._params
+    # kernel-only per cohort: the exact device programs the matcher
+    # dispatches, timed without host association.  Sums to the fleet's
+    # device time -> device_util = device_time / e2e wall (association and
+    # dispatch overhead are the rest).
+    dg, du, params = matcher._dg, matcher._du, matcher._params
     jit_compact = matcher._jit_match_compact
-    kpx, kpy, ktm, kvalid = px, py, tm, valid
-    if B % 128 and getattr(matcher, "_pallas", False):
-        kpx, kpy, ktm, kvalid = _pad_rows(128 - B % 128, px, py, tm, valid)
-    args = (dg, du, jnp.asarray(kpx), jnp.asarray(kpy), jnp.asarray(ktm),
-            jnp.asarray(kvalid), p)
-    jax.block_until_ready(jit_compact(*args, cfg.beam_k))
+    pallas_on = bool(getattr(matcher, "_pallas", False))
+
+    def _compact_args(px, py, tm, valid):
+        B = px.shape[0]
+        if pallas_on and B % 128:
+            px, py, tm, valid = _pad_rows(128 - B % 128, px, py, tm, valid)
+        return (dg, du, jnp.asarray(px), jnp.asarray(py), jnp.asarray(tm),
+                jnp.asarray(valid), params)
+
+    kernel_secs = 0.0
+    kernel_by_cohort = {}
+    cohort_xy = {}
+    for name, T, ss in cohorts:
+        px, py, tm, valid = _cohort_xy(arrays, ss, T)
+        cohort_xy[name] = (px, py, tm, valid)
+        if name == "long":
+            continue  # long runs through the carry kernel below
+        args = _compact_args(px, py, tm, valid)
+        jax.block_until_ready(jit_compact(*args, cfg.beam_k))
+        t0 = time.time()
+        for _ in range(reps):
+            r = jit_compact(*args, cfg.beam_k)
+        jax.block_until_ready(r)
+        dt = (time.time() - t0) / reps
+        kernel_secs += dt
+        kernel_by_cohort[name] = len(ss) / dt
+    # long cohort: W-window carry chunks, exactly like _match_long
+    from reporter_tpu.ops.viterbi import initial_carry_batch
+
+    name, T, ss = cohorts[2]
+    px, py, tm, valid = cohort_xy["long"]
+    W = cfg.length_buckets[-1]
+    n_chunks = T // W
+
+    def _long_pass(collect: bool = False):
+        carry = initial_carry_batch(px.shape[0], cfg.beam_k)
+        out = None
+        chunks = []
+        for c in range(n_chunks):
+            sl = slice(c * W, (c + 1) * W)
+            out, carry = matcher._jit_match_carry(
+                dg, du, jnp.asarray(px[:, sl]), jnp.asarray(py[:, sl]),
+                jnp.asarray(tm[:, sl]), jnp.asarray(valid[:, sl]),
+                params, cfg.beam_k, carry)
+            if collect:
+                chunks.append(np.asarray(out.edge))
+        if collect:
+            return np.concatenate(chunks, axis=1)
+        return out
+
+    jax.block_until_ready(_long_pass().edge)
     t0 = time.time()
     for _ in range(reps):
-        cres = jit_compact(*args, cfg.beam_k)
-    jax.block_until_ready(cres)
-    kernel_tps = B * reps / (time.time() - t0)
-    forward = "pallas" if getattr(matcher, "_pallas", False) else "scan"
-    _stderr(
-        "kernel-only %.1f traces/s (%s forward); end-to-end %.1f traces/s (%.0f pts/s)"
-        % (kernel_tps, forward, tps, pps)
-    )
+        r = _long_pass()
+    jax.block_until_ready(r.edge)
+    dt = (time.time() - t0) / reps
+    kernel_secs += dt
+    kernel_by_cohort["long"] = len(ss) / dt
 
-    # accuracy: segment agreement vs ground truth on the short cohort
-    jit_match = jax.jit(match_batch, static_argnums=(7,))
-    res = jit_match(dg, du, jnp.asarray(px), jnp.asarray(py), jnp.asarray(tm),
-                    jnp.asarray(valid), p, cfg.beam_k)
-    edge = np.asarray(res.idx)
-    cand_edge = np.asarray(res.cand.edge)
-    sel = np.maximum(edge, 0)
-    medge = cand_edge[np.arange(B)[:, None], np.arange(T)[None, :], sel]
-    medge = np.where(edge >= 0, medge, -1)
-    agr = float(np.mean([segment_agreement(arrays, medge[i], s_short[i]) for i in range(B)]))
-    _stderr("mean segment agreement vs truth: %.3f" % agr)
+    kernel_tps = n_traces / kernel_secs
+    device_util = min(1.0, kernel_secs / (e2e_wall / reps))
+    forward = "pallas" if pallas_on else "scan"
+    _stderr("kernel-only %.1f traces/s (%s forward); e2e %.1f traces/s (%.0f pts/s); "
+            "device util %.2f" % (kernel_tps, forward, tps, pps, device_util))
 
-    # CPU single-process baseline (reference operating point) on a subset
-    # with the same length mix
-    n_cpu = max(1, int(os.environ.get("BENCH_CPU_TRACES", "12")))
-    cpu_set = (traces[: max(n_cpu - 3, 1)]
-               + traces[n_short: n_short + 2]
-               + traces[n_short + n_med: n_short + n_med + 1])[:n_cpu]
-    cpum = SegmentMatcher(arrays=arrays, ubodt=ubodt, config=cfg, backend="cpu")
-    cpum.match_many(cpu_set[:1])  # warm lazy paths
-    t0 = time.time()
-    cpum.match_many(cpu_set)
-    cpu_wall = time.time() - t0
-    cpu_tps = len(cpu_set) / cpu_wall
-    cpu_points = sum(len(t["trace"]) for t in cpu_set)
-    cpu_pps = cpu_points / cpu_wall
-    _stderr(
-        "cpu baseline %.2f traces/s / %.0f pts/s (%d traces, %.1fs)"
-        % (cpu_tps, cpu_pps, len(cpu_set), cpu_wall)
-    )
+    # scan-vs-pallas on real hardware (VERDICT r02 next #2): bit-parity of
+    # matched edges + throughput of both forwards on the short cohort
+    pallas_info = None
+    if platform == "tpu" and cfg.beam_k == 8:
+        from reporter_tpu.ops.viterbi import match_batch_compact
+        from reporter_tpu.ops.viterbi_pallas import match_batch_compact_pallas
 
-    # the cpu subset's length mix differs slightly from the fleet's, so the
-    # speedup is normalised on points/s (work done), not traces/s
+        px, py, tm, valid = cohort_xy["short"]
+        pad = (-len(px)) % 128
+        if pad:
+            px, py, tm, valid = _pad_rows(pad, px, py, tm, valid)
+        args = (dg, du, jnp.asarray(px), jnp.asarray(py), jnp.asarray(tm),
+                jnp.asarray(valid), params)
+        jit_scan = jax.jit(match_batch_compact, static_argnums=(7,))
+        jit_pls = jax.jit(
+            lambda *a: match_batch_compact_pallas(*a[:7], a[7], interpret=False),
+            static_argnums=(7,))
+        try:
+            r_scan = jit_scan(*args, cfg.beam_k)
+            r_pls = jit_pls(*args, cfg.beam_k)
+            jax.block_until_ready((r_scan.edge, r_pls.edge))
+            agree = float(np.mean(np.asarray(r_scan.edge) == np.asarray(r_pls.edge)))
+            times = {}
+            for label, fn in (("scan", jit_scan), ("pallas", jit_pls)):
+                t0 = time.time()
+                for _ in range(reps):
+                    r = fn(*args, cfg.beam_k)
+                jax.block_until_ready(r.edge)
+                times[label] = len(px) * reps / (time.time() - t0)
+            pallas_info = {
+                "parity": round(agree, 6),
+                "scan_traces_per_sec": round(times["scan"], 1),
+                "pallas_traces_per_sec": round(times["pallas"], 1),
+            }
+            _stderr("pallas on-chip: parity %.4f, scan %.1f tr/s, pallas %.1f tr/s"
+                    % (agree, times["scan"], times["pallas"]))
+        except Exception as e:  # noqa: BLE001 - report, don't sink the bench
+            pallas_info = {"error": "%s: %s" % (type(e).__name__, e)}
+            _stderr("pallas on-chip check failed: %s" % (pallas_info["error"],))
+
+    # accuracy: segment agreement vs ground truth, every cohort (VERDICT r02
+    # weak #8) -- matched edges from the same compact/carry programs
+    agreement = {}
+    for cname, T, ss in cohorts:
+        px, py, tm, valid = cohort_xy[cname]
+        if cname == "long":
+            edge = _long_pass(collect=True)[: len(ss)]
+        else:
+            args = _compact_args(px, py, tm, valid)
+            edge = np.asarray(jit_compact(*args, cfg.beam_k).edge)[: len(ss)]
+        agreement[cname] = round(
+            float(np.mean([segment_agreement(arrays, edge[i], ss[i]) for i in range(len(ss))])), 4
+        )
+    agr_mean = float(np.mean(list(agreement.values())))
+    _stderr("segment agreement vs truth: %s (mean %.3f)" % (agreement, agr_mean))
+
     print(json.dumps({
-        "metric": "traces_matched_per_sec_per_chip",
+        "platform": platform,
+        "acquire_s": round(acquire_s, 1),
         "value": round(tps, 2),
-        "unit": "traces/s",
-        "vs_baseline": round(pps / cpu_pps, 2) if cpu_pps > 0 else None,
+        "points_per_sec": round(pps, 1),
         "p50_latency_ms": round(p50_ms, 2),
         "p95_latency_ms": round(p95_ms, 2),
-        "platform": platform,
+        "latency_cohort": "short64",
         "forward": forward,
         "kernel_traces_per_sec": round(kernel_tps, 1),
-        "agreement": round(agr, 4),
+        "kernel_by_cohort": {k: round(v, 1) for k, v in kernel_by_cohort.items()},
+        "device_util": round(device_util, 3),
+        "pallas": pallas_info,
+        "agreement": round(agr_mean, 4),
+        "agreement_by_cohort": agreement,
         "device_mb": round(hbm_mb, 1),
         "edges": int(arrays.num_edges),
         "ubodt_rows": int(ubodt.num_rows),
     }))
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# baseline worker
+
+
+def run_baseline() -> int:
+    from reporter_tpu.utils.jaxenv import ensure_platform
+
+    ensure_platform()
+    arrays, ubodt, cohorts = build_scenario()
+
+    from reporter_tpu.matching import MatcherConfig, SegmentMatcher
+
+    cfg = MatcherConfig()
+    cpum = SegmentMatcher(arrays=arrays, ubodt=ubodt, config=cfg, backend="cpu")
+
+    # cohort-proportional subset, looped until the time budget is spent --
+    # the multiplier the project is judged on must not rest on a sub-second
+    # sample (VERDICT r02 weak #3)
+    budget = float(os.environ.get("BENCH_BASELINE_SECS", "60"))
+    subset = ([s.trace for s in cohorts[0][2][:9]]
+              + [s.trace for s in cohorts[1][2][:2]]
+              + [s.trace for s in cohorts[2][2][:1]])
+    sub_pts = sum(len(t["trace"]) for t in subset)
+    cpum.match_many(subset[:1])  # warm lazy paths
+    t0 = time.time()
+    n_done = 0
+    pts_done = 0
+    while time.time() - t0 < budget:
+        cpum.match_many(subset)
+        n_done += len(subset)
+        pts_done += sub_pts
+    wall = time.time() - t0
+    _stderr("cpu baseline %.2f traces/s / %.0f pts/s (%d traces over %.1fs)"
+            % (n_done / wall, pts_done / wall, n_done, wall))
+    print(json.dumps({
+        "cpu_traces_per_sec": round(n_done / wall, 3),
+        "cpu_points_per_sec": round(pts_done / wall, 1),
+        "baseline_secs": round(wall, 1),
+        "baseline_traces": n_done,
+    }))
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# orchestrator
+
+
+def _spawn(role: str, env_updates: dict, status_file=None):
+    env = dict(os.environ)
+    env["BENCH_ROLE"] = role
+    if status_file:
+        env["BENCH_STATUS_FILE"] = status_file
+    env.update(env_updates)
+    return subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__)],
+        env=env, stdout=subprocess.PIPE, stderr=sys.stderr,
+    )
+
+
+def _finish(proc, timeout):
+    try:
+        out, _ = proc.communicate(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        out, _ = proc.communicate()
+    line = (out or b"").decode(errors="replace").strip().splitlines()
+    for ln in reversed(line):
+        try:
+            return proc.returncode, json.loads(ln)
+        except (json.JSONDecodeError, ValueError):
+            continue
+    return proc.returncode, None
+
+
+def _read_status(path):
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return {}
+
+
+class BaselineGate:
+    """Collects the baseline worker's result and releases the device
+    worker's bench phase (go-file) only after the baseline's timed window is
+    over -- CPU contention between the two would deflate the denominator of
+    the headline ratio."""
+
+    def __init__(self, proc, go_file: str):
+        self.proc = proc
+        self.go_file = go_file
+        self.rc = None
+        self.json = None
+        self._collected = False
+
+    def _touch(self):
+        with open(self.go_file, "w") as f:
+            f.write("go")
+
+    def poll(self):
+        if not self._collected and self.proc.poll() is not None:
+            self.rc, self.json = _finish(self.proc, 10)
+            self._collected = True
+            self._touch()
+
+    def ensure(self, timeout: float):
+        if not self._collected:
+            self.rc, self.json = _finish(self.proc, timeout)
+            self._collected = True
+            self._touch()
+
+
+def _monitor_device(proc, status_file, wait_s, grace_s, attempts_log, gate=None):
+    """Watch a device worker through acquisition.  Returns True if it
+    acquired a backend (worker then runs to completion), False if we killed
+    it (hopeless: no relay and grace expired, or wait_s expired)."""
+    t0 = time.time()
+    port_seen = False
+    while True:
+        if gate is not None:
+            gate.poll()
+        if proc.poll() is not None:
+            return True  # exited on its own; _finish will read the result
+        st = _read_status(status_file)
+        ports = _relay_ports_open()
+        port_seen = port_seen or bool(ports)
+        if st.get("phase") in ("waiting_for_baseline", "benching"):
+            return True  # backend acquired; bench phase gated on the baseline
+        waited = time.time() - t0
+        if not port_seen and waited > grace_s:
+            attempts_log.append({"outcome": "killed_no_relay", "waited_s": round(waited, 1),
+                                 "ports_open": ports})
+            proc.kill()
+            proc.wait()
+            return False
+        if waited > wait_s:
+            attempts_log.append({"outcome": "killed_wait_expired", "waited_s": round(waited, 1),
+                                 "ports_open": ports, "port_ever_open": port_seen})
+            proc.kill()
+            proc.wait()
+            return False
+        time.sleep(5.0)
+
+
+def main() -> int:
+    role = os.environ.get("BENCH_ROLE", "")
+    if role == "device":
+        return run_device()
+    if role == "baseline":
+        return run_baseline()
+
+    # ---- orchestrator ----
+    want_cpu = os.environ.get("JAX_PLATFORMS", "") == "cpu"
+    wait_s = float(os.environ.get("BENCH_TPU_WAIT", str(WAIT_DEFAULT)))
+    grace_s = float(os.environ.get("BENCH_TPU_GRACE", str(GRACE_DEFAULT)))
+    run_budget = float(os.environ.get("BENCH_RUN_BUDGET", "2400"))
+    tmpdir = tempfile.mkdtemp(prefix="bench_")
+    go_file = os.path.join(tmpdir, "baseline_done")
+
+    def status_path(tag):  # per-attempt file: no stale state between spawns
+        return os.path.join(tmpdir, "device_status_%s.json" % tag)
+
+    diag = {
+        "jax_platforms_env": os.environ.get("JAX_PLATFORMS", ""),
+        "relay_ports_open_at_start": _relay_ports_open(),
+        "axon_pool_ips": os.environ.get("PALLAS_AXON_POOL_IPS", ""),
+        "tpu_gen": os.environ.get("PALLAS_AXON_TPU_GEN", ""),
+    }
+    attempts = []
+
+    gate = BaselineGate(_spawn("baseline", {"JAX_PLATFORMS": "cpu"}), go_file)
+
+    device_json = None
+    if not want_cpu:
+        _stderr("attempt 1: device worker on axon (wait %.0fs, grace %.0fs if no relay)"
+                % (wait_s, grace_s))
+        sf = status_path("axon1")
+        proc = _spawn("device", {"JAX_PLATFORMS": "axon",
+                                 "BENCH_ACQUIRE_WAIT": str(wait_s),
+                                 "BENCH_GO_FILE": go_file}, sf)
+        if _monitor_device(proc, sf, wait_s + 60, grace_s, attempts, gate):
+            gate.ensure(300)  # free the cores, then let the worker bench
+            rc, device_json = _finish(proc, run_budget)
+            attempts.append({"outcome": "completed" if device_json else "died",
+                             "rc": rc, "platform": (device_json or {}).get("platform")})
+            if device_json and device_json.get("platform") == "cpu":
+                _stderr("axon attempt yielded cpu devices; keeping result but noting it")
+    if device_json is None:
+        # the CPU device run contends for the same cores as the baseline:
+        # finish the baseline's timed window before spawning it
+        gate.ensure(300)
+        _stderr("device run on cpu (fallback or requested)")
+        proc = _spawn("device", {"JAX_PLATFORMS": "cpu", "BENCH_ACQUIRE_WAIT": "120",
+                                 "BENCH_GO_FILE": go_file}, status_path("cpu"))
+        rc, device_json = _finish(proc, run_budget)
+        attempts.append({"outcome": "cpu_fallback_completed" if device_json else "cpu_fallback_died",
+                         "rc": rc})
+        # second chance: the relay may have appeared while the CPU run was
+        # on; one more short accelerator attempt, preferring its result
+        if not want_cpu and _relay_ports_open():
+            _stderr("relay is up now; second accelerator attempt")
+            sf = status_path("axon2")
+            proc = _spawn("device", {"JAX_PLATFORMS": "axon",
+                                     "BENCH_ACQUIRE_WAIT": "300",
+                                     "BENCH_GO_FILE": go_file}, sf)
+            if _monitor_device(proc, sf, 360, 120, attempts, gate):
+                rc, retry_json = _finish(proc, run_budget)
+                attempts.append({"outcome": "completed" if retry_json else "died",
+                                 "rc": rc, "platform": (retry_json or {}).get("platform")})
+                if retry_json and retry_json.get("platform") not in (None, "cpu"):
+                    device_json = retry_json
+
+    gate.ensure(run_budget)
+    baseline_json = gate.json
+    if not baseline_json:
+        _stderr("baseline worker died (rc %s)" % gate.rc)
+        baseline_json = {}
+
+    if not device_json:
+        _stderr("FATAL: no device result")
+        print(json.dumps({"metric": "traces_matched_per_sec_per_chip", "value": None,
+                          "unit": "traces/s", "vs_baseline": None,
+                          "error": "device worker produced no result",
+                          "acquire": {"diag": diag, "attempts": attempts}}))
+        return 1
+
+    cpu_pps = baseline_json.get("cpu_points_per_sec") or 0
+    cpu_tps = baseline_json.get("cpu_traces_per_sec") or 0
+    out = {
+        "metric": "traces_matched_per_sec_per_chip",
+        "value": device_json.get("value"),
+        "unit": "traces/s",
+        "vs_baseline": round(device_json.get("points_per_sec", 0) / cpu_pps, 2) if cpu_pps else None,
+        "vs_baseline_basis": "points_per_sec",
+        "vs_baseline_traces": round(device_json.get("value", 0) / cpu_tps, 2) if cpu_tps else None,
+    }
+    for k in ("platform", "acquire_s", "points_per_sec", "p50_latency_ms", "p95_latency_ms",
+              "latency_cohort", "forward", "kernel_traces_per_sec", "kernel_by_cohort",
+              "device_util", "pallas", "agreement", "agreement_by_cohort", "device_mb",
+              "edges", "ubodt_rows"):
+        if k in device_json:
+            out[k] = device_json[k]
+    out.update({k: baseline_json[k] for k in
+                ("cpu_traces_per_sec", "cpu_points_per_sec", "baseline_secs") if k in baseline_json})
+    out["acquire"] = {"diag": diag, "attempts": attempts}
+    print(json.dumps(out))
+    return 0
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

@@ -1,0 +1,28 @@
+"""Axon loopback-relay probing, shared by bench.py and tools/tpu_watch.py.
+
+The axon PJRT plugin reaches the real TPU through a loopback relay
+(AXON_POOL_SVC_OVERRIDE=127.0.0.1; session RPCs on :8082, device listing on
+:8083 -- /root/.axon_site/axon/register/pjrt.py).  When nothing listens on
+those ports a grant is impossible and ``jax.devices()`` blocks forever
+retrying the dial, so callers probe here (a connect() costs microseconds)
+before spending a process on PJRT init.
+"""
+
+from __future__ import annotations
+
+import socket
+from typing import List, Tuple
+
+RELAY_PORTS: Tuple[int, ...] = (8083, 8082)
+
+
+def port_open(port: int, timeout: float = 1.0) -> bool:
+    try:
+        with socket.create_connection(("127.0.0.1", port), timeout=timeout):
+            return True
+    except OSError:
+        return False
+
+
+def relay_ports_open(timeout: float = 0.5) -> List[int]:
+    return [p for p in RELAY_PORTS if port_open(p, timeout)]

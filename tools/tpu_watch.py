@@ -26,17 +26,21 @@ from __future__ import annotations
 
 import json
 import os
-import socket
 import subprocess
 import sys
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from reporter_tpu.utils.relay import RELAY_PORTS as PORTS  # noqa: E402
+from reporter_tpu.utils.relay import port_open  # noqa: E402
+
 LOG = os.path.join(REPO, "tpu_watch.log")
 STATE = os.path.join(REPO, "TPU_WATCH.json")
-PORTS = (8083, 8082)
 POLL_S = 10.0
-COOLDOWN_S = 600.0  # after a successful bench, re-bench at most this often
+COOLDOWN_OK_S = 600.0  # after a successful TPU bench, re-bench at most this often
+COOLDOWN_FAIL_S = 180.0  # after a failed/cpu bench attempt, back off this long
 
 
 def log(msg: str) -> None:
@@ -45,14 +49,6 @@ def log(msg: str) -> None:
         f.write(line)
     sys.stderr.write("tpu_watch: " + line)
     sys.stderr.flush()
-
-
-def port_open(port: int, timeout: float = 1.0) -> bool:
-    try:
-        with socket.create_connection(("127.0.0.1", port), timeout=timeout):
-            return True
-    except OSError:
-        return False
 
 
 def write_state(**kw) -> None:
@@ -86,7 +82,7 @@ def run_capture(cmd, env, timeout, out_path):
 def main() -> None:
     log("watcher started (pid %d), polling ports %s every %.0fs" % (os.getpid(), PORTS, POLL_S))
     last_open = False
-    last_bench_ok = 0.0
+    next_attempt_ok = 0.0  # monotonic-ish clock gate for the next bench try
     checks = 0
     runs = []
     while True:
@@ -98,9 +94,8 @@ def main() -> None:
             last_open = now_open
         write_state(relay_open=now_open, open_ports=open_ports, checks=checks,
                     runs=runs[-8:], pid=os.getpid())
-        if now_open and time.time() - last_bench_ok > COOLDOWN_S:
+        if now_open and time.time() >= next_attempt_ok:
             env = dict(os.environ)
-            env.pop("BENCH_TPU_ATTEMPT", None)
             env["JAX_PLATFORMS"] = "axon"
             rc, out, _ = run_capture(
                 [sys.executable, os.path.join(REPO, "tools", "tpu_probe.py")],
@@ -109,7 +104,6 @@ def main() -> None:
             if rc == 0:
                 env2 = dict(env)
                 env2["BENCH_TPU_WAIT"] = "600"
-                env2["BENCH_TPU_ATTEMPTS"] = "1"
                 rc2, out2, _ = run_capture(
                     [sys.executable, os.path.join(REPO, "bench.py")],
                     env2, 2700, os.path.join(REPO, "tpu_bench_out.json"))
@@ -117,10 +111,12 @@ def main() -> None:
                 runs.append({"what": "bench", "rc": rc2, "on_tpu": ok,
                              "ts": time.strftime("%H:%M:%S")})
                 if ok:
-                    last_bench_ok = time.time()
                     log("TPU BENCH CAPTURED -> tpu_bench_out.json")
+                # back off after EVERY attempt -- a consistently failing
+                # bench must not be retried back-to-back forever
+                next_attempt_ok = time.time() + (COOLDOWN_OK_S if ok else COOLDOWN_FAIL_S)
             else:
-                time.sleep(60)  # relay up but init failing; back off a little
+                next_attempt_ok = time.time() + 60  # relay up but init failing
         time.sleep(POLL_S)
 
 
