@@ -87,7 +87,7 @@ def build_scenario():
     _stderr(
         "graph %d nodes / %d edges (%.1fs); ubodt %d rows, table %.0f MB (%.1fs native build)"
         % (arrays.num_nodes, arrays.num_edges, t_graph, ubodt.num_rows,
-           (ubodt.mask + 1) * 20 / 1e6, time.time() - t0)
+           ubodt.packed.nbytes / 1e6, time.time() - t0)
     )
 
     n_short = int(os.environ.get("BENCH_TRACES", "192"))
@@ -259,15 +259,18 @@ def run_device() -> int:
     # device time -> device_util = device_time / e2e wall (association and
     # dispatch overhead are the rest).
     dg, du, params = matcher._dg, matcher._du, matcher._params
-    jit_compact = matcher._jit_match_compact
     pallas_on = bool(getattr(matcher, "_pallas", False))
 
     def _compact_args(px, py, tm, valid):
+        # mirror SegmentMatcher._dispatch_batch's forward selection: pallas
+        # only at >= one full 128-row block, scan below that
         B = px.shape[0]
-        if pallas_on and B % 128:
+        use_pallas = matcher._jit_match_pallas is not None and B >= 128
+        if use_pallas and B % 128:
             px, py, tm, valid = _pad_rows(128 - B % 128, px, py, tm, valid)
-        return (dg, du, jnp.asarray(px), jnp.asarray(py), jnp.asarray(tm),
-                jnp.asarray(valid), params)
+        fn = matcher._jit_match_pallas if use_pallas else matcher._jit_match_scan
+        return fn, (dg, du, jnp.asarray(px), jnp.asarray(py), jnp.asarray(tm),
+                    jnp.asarray(valid), params)
 
     kernel_secs = 0.0
     kernel_by_cohort = {}
@@ -277,11 +280,11 @@ def run_device() -> int:
         cohort_xy[name] = (px, py, tm, valid)
         if name == "long":
             continue  # long runs through the carry kernel below
-        args = _compact_args(px, py, tm, valid)
-        jax.block_until_ready(jit_compact(*args, cfg.beam_k))
+        fn, args = _compact_args(px, py, tm, valid)
+        jax.block_until_ready(fn(*args, cfg.beam_k))
         t0 = time.time()
         for _ in range(reps):
-            r = jit_compact(*args, cfg.beam_k)
+            r = fn(*args, cfg.beam_k)
         jax.block_until_ready(r)
         dt = (time.time() - t0) / reps
         kernel_secs += dt
@@ -373,8 +376,8 @@ def run_device() -> int:
         if cname == "long":
             edge = _long_pass(collect=True)[: len(ss)]
         else:
-            args = _compact_args(px, py, tm, valid)
-            edge = np.asarray(jit_compact(*args, cfg.beam_k).edge)[: len(ss)]
+            fn, args = _compact_args(px, py, tm, valid)
+            edge = np.asarray(fn(*args, cfg.beam_k).edge)[: len(ss)]
         agreement[cname] = round(
             float(np.mean([segment_agreement(arrays, edge[i], ss[i]) for i in range(len(ss))])), 4
         )

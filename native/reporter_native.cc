@@ -254,12 +254,17 @@ struct Pin {
   int32_t shape_index;
 };
 
+// 2-choice bucketed cuckoo table: one interleaved int32 array
+// [n_buckets, kBucket, kRowW] of (src, dst, dist-bits, time-bits,
+// first_edge, pad, pad, pad) entries.  Mirrors tiles/ubodt.py exactly.
+constexpr int64_t kBucket = 2;
+constexpr int64_t kRowW = 8;
+constexpr int64_t kMaxKicks = 500;
+enum { F_SRC = 0, F_DST = 1, F_DIST = 2, F_TIME = 3, F_FE = 4 };
+
 struct UbodtView {
-  const int32_t* src;
-  const int32_t* dst;
-  const int32_t* first_edge;
-  int64_t mask;
-  int32_t max_probes;
+  const int32_t* packed;  // [n_buckets * kBucket * kRowW]
+  int64_t bmask;          // n_buckets - 1
 };
 
 inline uint32_t pair_hash(uint32_t s, uint32_t d, int64_t mask) {
@@ -270,15 +275,24 @@ inline uint32_t pair_hash(uint32_t s, uint32_t d, int64_t mask) {
   return h & (uint32_t)mask;
 }
 
+inline uint32_t pair_hash2(uint32_t s, uint32_t d, int64_t mask) {
+  uint32_t h = s * 0x85EBCA77u + d * 0xC2B2AE3Du;
+  h ^= h >> 13;
+  h *= 0x27D4EB2Fu;
+  h ^= h >> 16;
+  return h & (uint32_t)mask;
+}
+
 // (first_edge) of the shortest src->dst row, or -1 on miss.
 inline int32_t ubodt_first_edge(const UbodtView& u, int32_t src, int32_t dst) {
-  uint32_t h = pair_hash((uint32_t)src, (uint32_t)dst, u.mask);
-  for (int32_t p = 0; p < u.max_probes; ++p) {
-    int64_t i = (h + p) & u.mask;
-    int32_t ts = u.src[i];
-    if (ts == -1) break;
-    if (ts == src && u.dst[i] == dst) return u.first_edge[i];
-  }
+  uint32_t b1 = pair_hash((uint32_t)src, (uint32_t)dst, u.bmask);
+  const int32_t* e = u.packed + (int64_t)b1 * kBucket * kRowW;
+  for (int64_t s = 0; s < kBucket; ++s, e += kRowW)
+    if (e[F_SRC] == src && e[F_DST] == dst) return e[F_FE];
+  uint32_t b2 = pair_hash2((uint32_t)src, (uint32_t)dst, u.bmask);
+  e = u.packed + (int64_t)b2 * kBucket * kRowW;
+  for (int64_t s = 0; s < kBucket; ++s, e += kRowW)
+    if (e[F_SRC] == src && e[F_DST] == dst) return e[F_FE];
   return -1;
 }
 
@@ -623,9 +637,8 @@ int32_t rn_associate_batch(
     const int32_t* edge_seg, const float* edge_seg_off,
     const uint8_t* edge_internal, const int64_t* edge_way,
     const int64_t* seg_ids, const float* seg_len,
-    // ubodt
-    const int32_t* t_src, const int32_t* t_dst, const int32_t* t_first_edge,
-    int64_t mask, int32_t max_probes, int64_t ubodt_rows,
+    // ubodt (packed cuckoo table, [n_buckets * kBucket * kRowW] int32)
+    const int32_t* t_packed, int64_t bmask, int64_t ubodt_rows,
     // matches
     int64_t B, int64_t T, const int32_t* m_edge, const float* m_offset,
     const uint8_t* m_break, const double* m_time, const int32_t* n_points,
@@ -639,7 +652,7 @@ int32_t rn_associate_batch(
     int64_t* way_ids_out) {
   AssocInputs in = {edge_from, edge_to,  edge_len, edge_seg, edge_seg_off,
                     edge_internal, edge_way, seg_ids,  seg_len,
-                    {t_src, t_dst, t_first_edge, mask, max_probes},
+                    {t_packed, bmask},
                     ubodt_rows, T, m_edge, m_offset, m_break, m_time,
                     n_points, queue_thresh_mps, back_tol};
   CallerSink sink;
@@ -689,9 +702,8 @@ int32_t rn_associate_batch_mt(
     const int32_t* edge_seg, const float* edge_seg_off,
     const uint8_t* edge_internal, const int64_t* edge_way,
     const int64_t* seg_ids, const float* seg_len,
-    // ubodt
-    const int32_t* t_src, const int32_t* t_dst, const int32_t* t_first_edge,
-    int64_t mask, int32_t max_probes, int64_t ubodt_rows,
+    // ubodt (packed cuckoo table, [n_buckets * kBucket * kRowW] int32)
+    const int32_t* t_packed, int64_t bmask, int64_t ubodt_rows,
     // matches
     int64_t B, int64_t T, const int32_t* m_edge, const float* m_offset,
     const uint8_t* m_break, const double* m_time, const int32_t* n_points,
@@ -705,7 +717,7 @@ int32_t rn_associate_batch_mt(
     int64_t* way_ids_out, int64_t* needed_rec, int64_t* needed_way) {
   AssocInputs in = {edge_from, edge_to,  edge_len, edge_seg, edge_seg_off,
                     edge_internal, edge_way, seg_ids,  seg_len,
-                    {t_src, t_dst, t_first_edge, mask, max_probes},
+                    {t_packed, bmask},
                     ubodt_rows, T, m_edge, m_offset, m_break, m_time,
                     n_points, queue_thresh_mps, back_tol};
   if (num_threads <= 0) {
@@ -943,44 +955,79 @@ void rn_ubodt_fetch(void* handle, int32_t* src, int32_t* dst, float* dist,
   delete res;
 }
 
-// Linear-probe packing, identical to tiles/ubodt.ubodt_from_rows' inner loop
-// (same pair_hash, same insertion order => bit-identical table).  `size` must
-// be a power of two.  Fills the five table arrays (pre-sized to `size`) and
-// returns the max probe length used, or -1 when it would exceed
-// max_probe_limit (caller doubles `size` and retries, as the Python packer
-// does).
-int64_t rn_ubodt_pack(int64_t n_rows, const int32_t* src, const int32_t* dst,
-                      const float* dist, const float* time, const int32_t* fe,
-                      int64_t size, int64_t max_probe_limit, int32_t* t_src,
-                      int32_t* t_dst, float* t_dist, float* t_time,
-                      int32_t* t_fe) {
-  const int64_t mask = size - 1;
-  const float inf = std::numeric_limits<float>::infinity();
-  for (int64_t i = 0; i < size; ++i) {
-    t_src[i] = -1;
-    t_dst[i] = -1;
-    t_dist[i] = inf;
-    t_time[i] = inf;
-    t_fe[i] = -1;
-  }
-  int64_t max_probe = 0;
+// Deterministic 2-choice cuckoo packing, identical to
+// tiles/ubodt._pack_python (same hashes, same insertion order, same rotating
+// eviction slot => bit-identical table).  `packed` is the caller's
+// [n_buckets * kBucket * kRowW] int32 array, pre-zeroed with every entry's
+// F_SRC set to -1 (the Python caller does this; this function also
+// re-initialises it so either convention is safe).  Returns the longest
+// displacement chain used, or -1 when an insert exceeds kMaxKicks (caller
+// doubles n_buckets and retries).
+int64_t rn_cuckoo_pack(int64_t n_rows, const int32_t* src, const int32_t* dst,
+                       const float* dist, const float* time, const int32_t* fe,
+                       int64_t n_buckets, int32_t* packed) {
+  const int64_t bmask = n_buckets - 1;
+  for (int64_t i = 0; i < n_buckets * kBucket * kRowW; ++i) packed[i] = 0;
+  for (int64_t b = 0; b < n_buckets * kBucket; ++b)
+    packed[b * kRowW + F_SRC] = -1;
+
+  auto entry = [&](int64_t bucket, int64_t slot) -> int32_t* {
+    return packed + (bucket * kBucket + slot) * kRowW;
+  };
+  auto bits = [](float f) -> int32_t {
+    int32_t v;
+    std::memcpy(&v, &f, sizeof v);
+    return v;
+  };
+
+  int64_t max_chain = 0;
   for (int64_t r = 0; r < n_rows; ++r) {
-    uint32_t h = pair_hash((uint32_t)src[r], (uint32_t)dst[r], mask);
-    for (int64_t p = 0; p < size; ++p) {
-      int64_t i = (h + p) & mask;
-      if (t_src[i] == -1) {
-        t_src[i] = src[r];
-        t_dst[i] = dst[r];
-        t_dist[i] = dist[r];
-        t_time[i] = time[r];
-        t_fe[i] = fe[r];
-        if (p + 1 > max_probe) max_probe = p + 1;
+    int32_t cs = src[r], cd = dst[r];
+    int32_t cdist = bits(dist[r]), ctime = bits(time[r]), cfe = fe[r];
+    bool placed = false;
+    int64_t b = pair_hash((uint32_t)cs, (uint32_t)cd, bmask);
+    for (int64_t kick = 0; kick < kMaxKicks; ++kick) {
+      int64_t free_s = -1;
+      for (int64_t s = 0; s < kBucket; ++s)
+        if (entry(b, s)[F_SRC] == -1) { free_s = s; break; }
+      if (free_s >= 0) {
+        int32_t* e = entry(b, free_s);
+        e[F_SRC] = cs; e[F_DST] = cd; e[F_DIST] = cdist;
+        e[F_TIME] = ctime; e[F_FE] = cfe;
+        if (kick > max_chain) max_chain = kick;
+        placed = true;
         break;
       }
+      int64_t alt = pair_hash2((uint32_t)cs, (uint32_t)cd, bmask);
+      if (alt == b) alt = pair_hash((uint32_t)cs, (uint32_t)cd, bmask);
+      if (alt != b) {
+        free_s = -1;
+        for (int64_t s = 0; s < kBucket; ++s)
+          if (entry(alt, s)[F_SRC] == -1) { free_s = s; break; }
+        if (free_s >= 0) {
+          int32_t* e = entry(alt, free_s);
+          e[F_SRC] = cs; e[F_DST] = cd; e[F_DIST] = cdist;
+          e[F_TIME] = ctime; e[F_FE] = cfe;
+          if (kick + 1 > max_chain) max_chain = kick + 1;
+          placed = true;
+          break;
+        }
+      }
+      // evict a deterministic rotating slot of the alternate bucket
+      int64_t s = kick % kBucket;
+      int32_t* e = entry(alt, s);
+      int32_t vs = e[F_SRC], vd = e[F_DST], vdist = e[F_DIST],
+              vtime = e[F_TIME], vfe = e[F_FE];
+      e[F_SRC] = cs; e[F_DST] = cd; e[F_DIST] = cdist;
+      e[F_TIME] = ctime; e[F_FE] = cfe;
+      cs = vs; cd = vd; cdist = vdist; ctime = vtime; cfe = vfe;
+      // the victim's next try: whichever of its buckets is not `alt`
+      b = pair_hash((uint32_t)cs, (uint32_t)cd, bmask);
+      if (b == alt) b = pair_hash2((uint32_t)cs, (uint32_t)cd, bmask);
     }
-    if (max_probe > max_probe_limit) return -1;
+    if (!placed) return -1;
   }
-  return max_probe;
+  return max_chain;
 }
 
 }  // extern "C"

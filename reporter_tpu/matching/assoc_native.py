@@ -91,15 +91,10 @@ def associate_segments_batch(
         arrays._assoc_views = views
     g_from, g_to, g_len, g_seg, g_seg_off, g_internal, g_way, s_ids, s_len = views
 
-    tviews = getattr(ubodt, "_assoc_views", None)
-    if tviews is None:
-        tviews = (
-            np.ascontiguousarray(ubodt.table_src, np.int32),
-            np.ascontiguousarray(ubodt.table_dst, np.int32),
-            np.ascontiguousarray(ubodt.table_first_edge, np.int32),
-        )
-        ubodt._assoc_views = tviews
-    t_src, t_dst, t_fe = tviews
+    t_packed = getattr(ubodt, "_assoc_views", None)
+    if t_packed is None:
+        t_packed = np.ascontiguousarray(ubodt.packed.reshape(-1), np.int32)
+        ubodt._assoc_views = t_packed
 
     out_cap = int(m_edge.size) * 2 + 64 * B + 64
     way_cap = out_cap * 2
@@ -131,8 +126,8 @@ def associate_segments_batch(
             need_way = _ct.c_int64(0)
             rc = lib.rn_associate_batch_mt(
                 g_from, g_to, g_len, g_seg, g_seg_off, g_internal, g_way,
-                s_ids, s_len, t_src, t_dst, t_fe, int(ubodt.mask),
-                int(ubodt.max_probes), int(ubodt.num_rows), B, T, m_edge,
+                s_ids, s_len, t_packed, int(ubodt.bmask),
+                int(ubodt.num_rows), B, T, m_edge,
                 m_off, m_brk, m_tim, n_pts, float(queue_thresh_mps),
                 float(back_tol), n_threads, out_cap, way_cap,
                 rec_start[1:], has_seg, seg_id, t0, t1, length, internal,
@@ -146,7 +141,7 @@ def associate_segments_batch(
             continue
         rc = lib.rn_associate_batch(
             g_from, g_to, g_len, g_seg, g_seg_off, g_internal, g_way, s_ids,
-            s_len, t_src, t_dst, t_fe, int(ubodt.mask), int(ubodt.max_probes),
+            s_len, t_packed, int(ubodt.bmask),
             int(ubodt.num_rows), B, T, m_edge, m_off, m_brk, m_tim, n_pts,
             float(queue_thresh_mps), float(back_tol), out_cap, way_cap,
             rec_start[1:], has_seg, seg_id, t0, t1, length, internal, qlen,

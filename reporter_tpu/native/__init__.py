@@ -81,15 +81,15 @@ _SYMBOLS = {
     "rn_ubodt_fetch": (None, [
         ctypes.c_void_p, _i32p, _i32p, _f32p, _f32p, _i32p,
     ]),
-    "rn_ubodt_pack": (ctypes.c_int64, [
+    "rn_cuckoo_pack": (ctypes.c_int64, [
         ctypes.c_int64, _i32p, _i32p, _f32p, _f32p, _i32p,
-        ctypes.c_int64, ctypes.c_int64, _i32p, _i32p, _f32p, _f32p, _i32p,
+        ctypes.c_int64, _i32p,
     ]),
     "rn_associate_batch": (ctypes.c_int32, [
         # graph
         _i32p, _i32p, _f32p, _i32p, _f32p, _u8p, _i64p, _i64p, _f32p,
-        # ubodt
-        _i32p, _i32p, _i32p, ctypes.c_int64, ctypes.c_int32, ctypes.c_int64,
+        # ubodt (packed cuckoo table + bmask + rows)
+        _i32p, ctypes.c_int64, ctypes.c_int64,
         # matches
         ctypes.c_int64, ctypes.c_int64, _i32p, _f32p, _u8p, _f64p, _i32p,
         # params
@@ -101,8 +101,8 @@ _SYMBOLS = {
     "rn_associate_batch_mt": (ctypes.c_int32, [
         # graph
         _i32p, _i32p, _f32p, _i32p, _f32p, _u8p, _i64p, _i64p, _f32p,
-        # ubodt
-        _i32p, _i32p, _i32p, ctypes.c_int64, ctypes.c_int32, ctypes.c_int64,
+        # ubodt (packed cuckoo table + bmask + rows)
+        _i32p, ctypes.c_int64, ctypes.c_int64,
         # matches
         ctypes.c_int64, ctypes.c_int64, _i32p, _f32p, _u8p, _f64p, _i32p,
         # params

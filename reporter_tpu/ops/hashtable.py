@@ -1,23 +1,31 @@
-"""Device-side open-addressing hash probe for the UBODT.
+"""Device-side cuckoo hash probe for the UBODT.
 
-The route-distance lookup inside the HMM transition becomes a fixed number of
-vectorised gathers: hash the (src, dst) node pair, probe up to ``max_probes``
-slots (statically unrolled — max_probes is measured at build time and kept
-small by the builder), select the hit with ``where``.  No data-dependent
-control flow, so XLA fuses the whole probe into the transition computation.
+The route-distance lookup inside the HMM transition is exactly **two
+row-gathers**: hash the (src, dst) node pair with two independent mixes, pull
+each candidate bucket as one interleaved [BUCKET, ROW_W]-int32 row (a 64-byte
+contiguous window — the thing the TPU memory system is actually good at), and
+select the hit with a masked reduce over the 2*BUCKET candidate entries.  No
+data-dependent control flow, no probe chains: the probe count is an
+architectural constant of the table layout, not a function of load.
 
-Must mirror tiles/ubodt.py's host-side layout and hash exactly.
+(Round 3 used linear probing: up to 64 unrolled probes x 5 separate scalar
+gathers into five ~32M-slot arrays, which made the transition matrix
+HBM-random-access-bound and left the TPU ~15x slower than host CPU on the
+same program.  This layout is the round-4 fix.)
+
+Must mirror tiles/ubodt.py's host-side layout and hashes exactly.
 """
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
-from ..tiles.ubodt import DeviceUBODT
+from ..tiles.ubodt import BUCKET, F_DIST, F_DST, F_FE, F_SRC, F_TIME, DeviceUBODT
 
 
 def device_pair_hash(src: jnp.ndarray, dst: jnp.ndarray, mask: int) -> jnp.ndarray:
-    """uint32 mix identical to tiles.ubodt.pair_hash."""
+    """uint32 mix identical to tiles.ubodt.pair_hash (bucket choice 1)."""
     s = src.astype(jnp.uint32)
     d = dst.astype(jnp.uint32)
     h = s * jnp.uint32(0x9E3779B1) + d * jnp.uint32(0x85EBCA6B)
@@ -27,61 +35,73 @@ def device_pair_hash(src: jnp.ndarray, dst: jnp.ndarray, mask: int) -> jnp.ndarr
     return (h & jnp.uint32(mask)).astype(jnp.int32)
 
 
-def ubodt_lookup(u: DeviceUBODT, src: jnp.ndarray, dst: jnp.ndarray):
-    """Vectorised probe.  src/dst: any (broadcastable) int32 shape.
+def device_pair_hash2(src: jnp.ndarray, dst: jnp.ndarray, mask: int) -> jnp.ndarray:
+    """uint32 mix identical to tiles.ubodt.pair_hash2 (bucket choice 2)."""
+    s = src.astype(jnp.uint32)
+    d = dst.astype(jnp.uint32)
+    h = s * jnp.uint32(0x85EBCA77) + d * jnp.uint32(0xC2B2AE3D)
+    h = h ^ (h >> jnp.uint32(13))
+    h = h * jnp.uint32(0x27D4EB2F)
+    h = h ^ (h >> jnp.uint32(16))
+    return (h & jnp.uint32(mask)).astype(jnp.int32)
 
-    Returns (dist, time, first_edge): dist/time = +inf and first_edge = -1 on
-    miss.  When ``u.shard_axis`` is set the table leaves are local slot-range
-    slices inside a shard_map and the result is resolved with collectives.
-    """
-    if u.shard_axis is not None:
-        return _ubodt_lookup_sharded(u, src, dst)
-    h = device_pair_hash(src, dst, u.mask)
-    dist = jnp.full(h.shape, jnp.inf, jnp.float32)
-    time = jnp.full(h.shape, jnp.inf, jnp.float32)
-    first = jnp.full(h.shape, -1, jnp.int32)
-    found = jnp.zeros(h.shape, jnp.bool_)
-    for p in range(u.max_probes):
-        idx = (h + p) & u.mask
-        ts = u.table_src[idx]
-        td = u.table_dst[idx]
-        hit = (ts == src) & (td == dst) & (~found)
-        dist = jnp.where(hit, u.table_dist[idx], dist)
-        time = jnp.where(hit, u.table_time[idx], time)
-        first = jnp.where(hit, u.table_first_edge[idx], first)
-        found = found | hit | (ts == -1)  # empty slot terminates the chain
+
+def _select(rows: jnp.ndarray, src: jnp.ndarray, dst: jnp.ndarray):
+    """rows: [..., E, ROW_W] candidate entries -> (dist, time, first) with
+    +inf / -1 on miss.  Keys are unique so at most one entry hits; min/max
+    reduces resolve the select without another gather."""
+    hit = (rows[..., F_SRC] == src[..., None]) & (rows[..., F_DST] == dst[..., None])
+    dist_f = jax.lax.bitcast_convert_type(rows[..., F_DIST], jnp.float32)
+    time_f = jax.lax.bitcast_convert_type(rows[..., F_TIME], jnp.float32)
+    dist = jnp.min(jnp.where(hit, dist_f, jnp.inf), axis=-1)
+    time = jnp.min(jnp.where(hit, time_f, jnp.inf), axis=-1)
+    first = jnp.max(jnp.where(hit, rows[..., F_FE], -1), axis=-1)
     return dist, time, first
 
 
-def _ubodt_lookup_sharded(u: DeviceUBODT, src: jnp.ndarray, dst: jnp.ndarray):
-    """Probe a slot-range-sharded table from inside a shard_map.
+def ubodt_lookup(u: DeviceUBODT, src: jnp.ndarray, dst: jnp.ndarray):
+    """Vectorised two-bucket probe.  src/dst: any (broadcastable) int32 shape.
 
-    Each rank probes the global chain but only reads slots in its local
-    range; keys are unique, so at most one rank hits and a pmin/pmax over the
-    shard axis resolves every query exactly.  Communication is three small
-    collectives per lookup batch, riding the ICI — the table itself never
-    moves.  (Early-exit on empty slots is dropped: correctness comes from key
-    uniqueness, and a fixed probe count keeps the loop unrolled and fused.)
+    Returns (dist, time, first_edge): dist/time = +inf and first_edge = -1 on
+    miss.  When ``u.shard_axis`` is set the packed table leaf is a local
+    bucket-range slice inside a shard_map and the result is resolved with
+    collectives.
     """
-    import jax
+    if u.shard_axis is not None:
+        return _ubodt_lookup_sharded(u, src, dst)
+    src, dst = jnp.broadcast_arrays(src, dst)
+    b1 = device_pair_hash(src, dst, u.bmask)
+    b2 = device_pair_hash2(src, dst, u.bmask)
+    r1 = u.packed[b1]  # [..., BUCKET, ROW_W]
+    r2 = u.packed[b2]
+    rows = jnp.concatenate([r1, r2], axis=-2)  # [..., 2*BUCKET, ROW_W]
+    return _select(rows, src, dst)
 
-    L = u.table_src.shape[0]  # local slice length
+
+def _ubodt_lookup_sharded(u: DeviceUBODT, src: jnp.ndarray, dst: jnp.ndarray):
+    """Probe a bucket-range-sharded table from inside a shard_map.
+
+    Each rank gathers the two candidate buckets only when they fall in its
+    local range; keys are unique, so at most one rank hits and a pmin/pmax
+    over the shard axis resolves every query exactly.  Communication is three
+    small collectives per lookup batch, riding the ICI — the table itself
+    never moves.
+    """
+    L = u.packed.shape[0]  # local bucket-range length
     lo = jax.lax.axis_index(u.shard_axis) * L
-    h = device_pair_hash(src, dst, u.mask)
-    dist = jnp.full(h.shape, jnp.inf, jnp.float32)
-    time = jnp.full(h.shape, jnp.inf, jnp.float32)
-    first = jnp.full(h.shape, -1, jnp.int32)
-    for p in range(u.max_probes):
-        idx = (h + p) & u.mask
-        loc = idx - lo
+    src, dst = jnp.broadcast_arrays(src, dst)
+    b1 = device_pair_hash(src, dst, u.bmask)
+    b2 = device_pair_hash2(src, dst, u.bmask)
+
+    def local_rows(b):
+        loc = b - lo
         inr = (loc >= 0) & (loc < L)
-        sl = jnp.where(inr, loc, 0)
-        ts = jnp.where(inr, u.table_src[sl], -2)  # -2 matches nothing
-        td = jnp.where(inr, u.table_dst[sl], -2)
-        hit = (ts == src) & (td == dst)
-        dist = jnp.where(hit, u.table_dist[sl], dist)
-        time = jnp.where(hit, u.table_time[sl], time)
-        first = jnp.where(hit, u.table_first_edge[sl], first)
+        r = u.packed[jnp.where(inr, loc, 0)]  # [..., BUCKET, ROW_W]
+        # out-of-range buckets contribute entries that match nothing (-2)
+        return jnp.where(inr[..., None, None], r, -2)
+
+    rows = jnp.concatenate([local_rows(b1), local_rows(b2)], axis=-2)
+    dist, time, first = _select(rows, src, dst)
     dist = jax.lax.pmin(dist, u.shard_axis)
     time = jax.lax.pmin(time, u.shard_axis)
     first = jax.lax.pmax(first, u.shard_axis)

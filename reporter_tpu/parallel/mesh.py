@@ -195,13 +195,13 @@ def graph_sharded_match_fn(mesh: Mesh, k: int, num_segments: int):
 
 
 def check_ubodt_shardable(ubodt, n_gp: int):
-    """The sharded probe slices the table into n_gp equal slot ranges; the
-    power-of-two table size must divide evenly (it does whenever n_gp is a
-    power of two <= size).  Returns the table unchanged."""
-    size = len(ubodt.table_src)
+    """The sharded probe slices the table into n_gp equal bucket ranges; the
+    power-of-two bucket count must divide evenly (it does whenever n_gp is a
+    power of two <= n_buckets).  Returns the table unchanged."""
+    size = ubodt.packed.shape[0]
     if size % n_gp:
         raise ValueError(
-            "UBODT table size %d not divisible by gp=%d (use a power-of-two "
+            "UBODT bucket count %d not divisible by gp=%d (use a power-of-two "
             "gp axis)" % (size, n_gp)
         )
     return ubodt

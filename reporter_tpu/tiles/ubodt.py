@@ -4,18 +4,26 @@ The Meili engine computes candidate-to-candidate route distances with on-line
 bidirectional A* inside C++ (the dominant hot loop, SURVEY.md §3.1).  Graph
 search is irregular and a poor fit for the TPU, so this framework moves it
 entirely to preprocessing: a bounded-radius Dijkstra from every node yields all
-node pairs within ``delta`` metres, stored in an open-addressing hash table
-whose arrays live in HBM.  At match time the [batch, T, K, K] transition
-route-distances become pure vectorised gathers (ops/hashtable.py) — no graph
-search on device at all.
+node pairs within ``delta`` metres, stored in a hash table whose array lives in
+HBM.  At match time the [batch, T, K, K] transition route-distances become
+pure vectorised gathers (ops/hashtable.py) — no graph search on device at all.
+
+Table layout (round 4): **2-choice bucketed cuckoo**, tuned for the TPU's
+memory system.  One interleaved int32 array ``packed[n_buckets, BUCKET, ROW_W]``
+holds (src, dst, dist-bits, time-bits, first_edge, 0, 0, 0) per entry, so a
+lookup is exactly **two row-gathers** (one 64-byte bucket per hash function)
+regardless of load — the linear-probe layout this replaces unrolled up to 64
+probes of 5 scalar gathers each, the single worst HBM access pattern a TPU can
+have.  Insertion uses deterministic cuckoo displacement at build time; the
+C++ packer (rn_cuckoo_pack) and the Python twin below produce bit-identical
+tables.
 
 Each row also records the first edge of the shortest path so the full edge
 path can be reconstructed host-side after Viterbi (subpaths of shortest paths
 are shortest paths, so chaining first-edge hops stays inside the table).
 
-The table layout (linear probing, power-of-two size, uint32 mix hash) is
-identical between this host builder and the device prober; keep the two in
-sync.
+Keep the layout/hash in sync across: this builder, ops/hashtable.py (device
+prober), and native/reporter_native.cc (UbodtView + rn_cuckoo_pack).
 """
 
 from __future__ import annotations
@@ -23,62 +31,80 @@ from __future__ import annotations
 import heapq
 import logging
 from dataclasses import dataclass
-from typing import List, NamedTuple, Optional, Tuple
+from typing import List, Optional, Tuple
 
 import numpy as np
 
 log = logging.getLogger(__name__)
 
-# uint32 multiplicative mixing constants (Knuth / murmur-style)
-_H1 = np.uint32(0x9E3779B1)
-_H2 = np.uint32(0x85EBCA6B)
+# uint32 multiplicative mixing constants (Knuth / murmur-style).  Two
+# independent mixes -> the two cuckoo bucket choices.
+_H1A = np.uint32(0x9E3779B1)
+_H1B = np.uint32(0x85EBCA6B)
+_H2A = np.uint32(0x85EBCA77)
+_H2B = np.uint32(0xC2B2AE3D)
 
 EMPTY = -1
 
+# entries per bucket; 2-choice with bucket size 2 supports load factors to
+# ~0.89 (Dietzfelbinger/Weidling), we size for <= LOAD_TARGET
+BUCKET = 2
+# int32 lanes per entry: src, dst, dist(f32 bits), time(f32 bits),
+# first_edge, pad, pad, pad — padded to 8 so a bucket is one aligned
+# 64-byte row-gather on device
+ROW_W = 8
+F_SRC, F_DST, F_DIST, F_TIME, F_FE = 0, 1, 2, 3, 4
+LOAD_TARGET = 0.75
+MAX_KICKS = 500
+
 
 def pair_hash(src, dst, mask):
-    """Identical on host (numpy) and device (jnp): uint32 wraparound mix."""
+    """Bucket choice 1.  Identical on host (numpy) and device (jnp)."""
     s = src.astype(np.uint32) if hasattr(src, "astype") else np.uint32(src)
     d = dst.astype(np.uint32) if hasattr(dst, "astype") else np.uint32(dst)
     with np.errstate(over="ignore"):
-        h = s * _H1 + d * _H2
+        h = s * _H1A + d * _H1B
         h ^= h >> np.uint32(15)
         h = h * np.uint32(0x2C1B3C6D)
         h ^= h >> np.uint32(12)
     return (h & np.uint32(mask)).astype(np.int64) if hasattr(h, "astype") else int(h) & mask
 
 
+def pair_hash2(src, dst, mask):
+    """Bucket choice 2 (independent mix constants)."""
+    s = src.astype(np.uint32) if hasattr(src, "astype") else np.uint32(src)
+    d = dst.astype(np.uint32) if hasattr(dst, "astype") else np.uint32(dst)
+    with np.errstate(over="ignore"):
+        h = s * _H2A + d * _H2B
+        h ^= h >> np.uint32(13)
+        h = h * np.uint32(0x27D4EB2F)
+        h ^= h >> np.uint32(16)
+    return (h & np.uint32(mask)).astype(np.int64) if hasattr(h, "astype") else int(h) & mask
+
+
 class DeviceUBODT:
-    """Pytree whose table arrays are leaves and whose (mask, max_probes,
-    shard_axis) are static aux data, so probe loops unroll at trace time.
+    """Pytree whose packed table array is the leaf and whose (bmask,
+    shard_axis) are static aux data.
 
-    ``shard_axis`` names a mesh axis when the table arrays are 1/N slot-range
-    slices inside a shard_map (parallel/mesh.py graph sharding): the device
-    prober then masks probes to the local slot range and resolves hits with
-    pmin/pmax collectives over that axis.  None = whole table resident."""
+    ``shard_axis`` names a mesh axis when the packed array is a 1/N
+    bucket-range slice inside a shard_map (parallel/mesh.py graph sharding):
+    the device prober then masks probes to the local bucket range and
+    resolves hits with pmin/pmax collectives over that axis.  None = whole
+    table resident."""
 
-    def __init__(self, table_src, table_dst, table_dist, table_time, table_first_edge,
-                 mask: int, max_probes: int, shard_axis=None):
-        self.table_src = table_src
-        self.table_dst = table_dst
-        self.table_dist = table_dist
-        self.table_time = table_time
-        self.table_first_edge = table_first_edge
-        self.mask = int(mask)
-        self.max_probes = int(max_probes)
+    # architectural probe bound: one gather per hash function
+    max_probes = 2
+
+    def __init__(self, packed, bmask: int, shard_axis=None):
+        self.packed = packed  # [n_buckets, BUCKET, ROW_W] int32
+        self.bmask = int(bmask)
         self.shard_axis = shard_axis
 
     def with_shard_axis(self, axis: str) -> "DeviceUBODT":
-        return DeviceUBODT(
-            self.table_src, self.table_dst, self.table_dist, self.table_time,
-            self.table_first_edge, self.mask, self.max_probes, shard_axis=axis,
-        )
+        return DeviceUBODT(self.packed, self.bmask, shard_axis=axis)
 
     def tree_flatten(self):
-        return (
-            (self.table_src, self.table_dst, self.table_dist, self.table_time, self.table_first_edge),
-            (self.mask, self.max_probes, self.shard_axis),
-        )
+        return ((self.packed,), (self.bmask, self.shard_axis))
 
     @classmethod
     def tree_unflatten(cls, aux, children):
@@ -104,38 +130,48 @@ except ImportError:  # pragma: no cover - host-only usage without jax
 @dataclass
 class UBODT:
     delta: float
-    table_src: np.ndarray
-    table_dst: np.ndarray
-    table_dist: np.ndarray
-    table_time: np.ndarray  # travel seconds along the shortest-distance path
-    table_first_edge: np.ndarray
-    mask: int
-    max_probes: int
+    packed: np.ndarray  # [n_buckets, BUCKET, ROW_W] int32
+    bmask: int  # n_buckets - 1
     num_rows: int
+    max_kicks: int  # longest displacement chain seen during packing
+    # architectural probe bound (two bucket gathers per lookup)
+    max_probes: int = 2
+
+    @property
+    def n_buckets(self) -> int:
+        return self.bmask + 1
+
+    def _find(self, src: int, dst: int) -> int:
+        """Flat entry index of the (src, dst) row, or -1."""
+        for h in (
+            int(pair_hash(np.int64(src), np.int64(dst), self.bmask)),
+            int(pair_hash2(np.int64(src), np.int64(dst), self.bmask)),
+        ):
+            for s in range(BUCKET):
+                e = self.packed[h, s]
+                if e[F_SRC] == src and e[F_DST] == dst:
+                    return h * BUCKET + s
+        return -1
 
     def lookup(self, src: int, dst: int) -> Tuple[float, int]:
         """Host-side probe.  Returns (dist, first_edge) or (inf, -1)."""
-        h = int(pair_hash(np.int64(src), np.int64(dst), self.mask))
-        for p in range(self.max_probes):
-            i = (h + p) & self.mask
-            ts = self.table_src[i]
-            if ts == EMPTY:
-                break
-            if ts == src and self.table_dst[i] == dst:
-                return float(self.table_dist[i]), int(self.table_first_edge[i])
-        return float("inf"), -1
+        i = self._find(src, dst)
+        if i < 0:
+            return float("inf"), -1
+        e = self.packed.reshape(-1, ROW_W)[i]
+        return float(np.int32(e[F_DIST]).view(np.float32)), int(e[F_FE])
 
     def lookup_full(self, src: int, dst: int) -> Tuple[float, float, int]:
         """One probe returning (dist, time, first_edge); (inf, inf, -1) miss."""
-        h = int(pair_hash(np.int64(src), np.int64(dst), self.mask))
-        for p in range(self.max_probes):
-            i = (h + p) & self.mask
-            ts = self.table_src[i]
-            if ts == EMPTY:
-                break
-            if ts == src and self.table_dst[i] == dst:
-                return float(self.table_dist[i]), float(self.table_time[i]), int(self.table_first_edge[i])
-        return float("inf"), float("inf"), -1
+        i = self._find(src, dst)
+        if i < 0:
+            return float("inf"), float("inf"), -1
+        e = self.packed.reshape(-1, ROW_W)[i]
+        return (
+            float(np.int32(e[F_DIST]).view(np.float32)),
+            float(np.int32(e[F_TIME]).view(np.float32)),
+            int(e[F_FE]),
+        )
 
     def path_edges(self, src: int, dst: int) -> Optional[List[int]]:
         """Reconstruct the edge sequence of the shortest path src -> dst by
@@ -168,13 +204,8 @@ class UBODT:
         import jax.numpy as jnp
 
         return DeviceUBODT(
-            table_src=jnp.asarray(self.table_src, jnp.int32),
-            table_dst=jnp.asarray(self.table_dst, jnp.int32),
-            table_dist=jnp.asarray(self.table_dist, jnp.float32),
-            table_time=jnp.asarray(self.table_time, jnp.float32),
-            table_first_edge=jnp.asarray(self.table_first_edge, jnp.int32),
-            mask=self.mask,
-            max_probes=self.max_probes,
+            packed=jnp.asarray(self.packed, jnp.int32),
+            bmask=self.bmask,
         )
 
 
@@ -217,8 +248,7 @@ def _bounded_dijkstra(
 def build_ubodt(
     arrays,
     delta: float = 3000.0,
-    load_factor: float = 0.5,
-    max_probe_limit: int = 64,
+    load_factor: float = LOAD_TARGET,
     num_threads: int = 0,
     use_native: bool = True,
 ) -> UBODT:
@@ -226,7 +256,7 @@ def build_ubodt(
 
     Fast path: ``rn_ubodt_build`` in native/reporter_native.cc -- a parallel
     bounded Dijkstra over all sources (num_threads <= 0 means all cores)
-    followed by native hash packing.  The pure-Python loop below is the
+    followed by native cuckoo packing.  The pure-Python loop below is the
     oracle and the no-compiler fallback; the two produce bit-identical
     tables (tests/test_ubodt.py diffs them).  The reference pays this route
     search per match inside Valhalla C++ (reporter_service.py:240); here it
@@ -236,7 +266,7 @@ def build_ubodt(
         if built is not None:
             src, dst, dist, tm, fe = built
             return ubodt_from_columns(
-                src, dst, dist, tm, fe, delta, load_factor, max_probe_limit
+                src, dst, dist, tm, fe, delta, load_factor
             ).attach_graph(arrays.edge_to)
     rows: List[Tuple[int, int, float, float, int]] = []
     for src in range(arrays.num_nodes):
@@ -246,7 +276,7 @@ def build_ubodt(
         ):
             rows.append((src, dst, d, tm, fe))
     return ubodt_from_rows(
-        rows, delta, load_factor, max_probe_limit, use_native=use_native
+        rows, delta, load_factor, use_native=use_native
     ).attach_graph(arrays.edge_to)
 
 
@@ -292,27 +322,73 @@ def _native_build_rows(arrays, delta: float, num_threads: int):
     return src, dst, dist, tm, fe
 
 
-def _pack_python(src, dst, dist, time, first_edge, size, max_probe_limit,
-                 tsrc, tdst, tdist, ttime, tfe) -> int:
-    """Python twin of rn_ubodt_pack: fill the pre-initialised table arrays,
-    return max probe length, or -1 when max_probe_limit is exceeded."""
-    mask = size - 1
-    max_probe = 0
+def _pack_python(src, dst, dist, time, first_edge, n_buckets, packed) -> int:
+    """Python twin of rn_cuckoo_pack: deterministic 2-choice cuckoo insert
+    into ``packed`` [n_buckets, BUCKET, ROW_W] (pre-zeroed with src = EMPTY),
+    return the longest displacement chain, or -1 when an insert exceeds
+    MAX_KICKS (caller doubles n_buckets and retries)."""
+    bmask = n_buckets - 1
+    dist_bits = np.asarray(dist, np.float32).view(np.int32)
+    time_bits = np.asarray(time, np.float32).view(np.int32)
+    max_chain = 0
     for r in range(len(src)):
-        h = int(pair_hash(np.int64(src[r]), np.int64(dst[r]), mask))
-        for p in range(size):
-            i = (h + p) & mask
-            if tsrc[i] == EMPTY:
-                tsrc[i] = src[r]
-                tdst[i] = dst[r]
-                tdist[i] = dist[r]
-                ttime[i] = time[r]
-                tfe[i] = first_edge[r]
-                max_probe = max(max_probe, p + 1)
+        cs, cd = int(src[r]), int(dst[r])
+        cdist, ctime, cfe = int(dist_bits[r]), int(time_bits[r]), int(first_edge[r])
+        placed = False
+        b = int(pair_hash(np.int64(cs), np.int64(cd), bmask))
+        for kick in range(MAX_KICKS):
+            free = -1
+            for s in range(BUCKET):
+                if packed[b, s, F_SRC] == EMPTY:
+                    free = s
+                    break
+            if free >= 0:
+                packed[b, free, F_SRC] = cs
+                packed[b, free, F_DST] = cd
+                packed[b, free, F_DIST] = cdist
+                packed[b, free, F_TIME] = ctime
+                packed[b, free, F_FE] = cfe
+                max_chain = max(max_chain, kick)
+                placed = True
                 break
-        if max_probe > max_probe_limit:
+            alt = int(pair_hash2(np.int64(cs), np.int64(cd), bmask))
+            if alt == b:
+                alt = int(pair_hash(np.int64(cs), np.int64(cd), bmask))
+            if alt != b:
+                free = -1
+                for s in range(BUCKET):
+                    if packed[alt, s, F_SRC] == EMPTY:
+                        free = s
+                        break
+                if free >= 0:
+                    packed[alt, free, F_SRC] = cs
+                    packed[alt, free, F_DST] = cd
+                    packed[alt, free, F_DIST] = cdist
+                    packed[alt, free, F_TIME] = ctime
+                    packed[alt, free, F_FE] = cfe
+                    max_chain = max(max_chain, kick + 1)
+                    placed = True
+                    break
+            # evict a deterministic rotating slot of the alternate bucket
+            s = kick % BUCKET
+            vs = int(packed[alt, s, F_SRC])
+            vd = int(packed[alt, s, F_DST])
+            vdist = int(packed[alt, s, F_DIST])
+            vtime = int(packed[alt, s, F_TIME])
+            vfe = int(packed[alt, s, F_FE])
+            packed[alt, s, F_SRC] = cs
+            packed[alt, s, F_DST] = cd
+            packed[alt, s, F_DIST] = cdist
+            packed[alt, s, F_TIME] = ctime
+            packed[alt, s, F_FE] = cfe
+            cs, cd, cdist, ctime, cfe = vs, vd, vdist, vtime, vfe
+            # the victim's next try: whichever of its buckets is not `alt`
+            b = int(pair_hash(np.int64(cs), np.int64(cd), bmask))
+            if b == alt:
+                b = int(pair_hash2(np.int64(cs), np.int64(cd), bmask))
+        if not placed:
             return -1
-    return max_probe
+    return max_chain
 
 
 def ubodt_from_columns(
@@ -322,13 +398,12 @@ def ubodt_from_columns(
     time: np.ndarray,
     first_edge: np.ndarray,
     delta: float,
-    load_factor: float = 0.5,
-    max_probe_limit: int = 64,
+    load_factor: float = LOAD_TARGET,
     use_native: bool = True,
 ) -> UBODT:
-    """Pack row columns into the hash table.  The single home of the sizing
-    and grow-on-probe-overflow policy; the probe/insert inner loop runs in
-    C++ (rn_ubodt_pack) when available and ``use_native``, else in
+    """Pack row columns into the cuckoo table.  The single home of the sizing
+    and grow-on-insert-failure policy; the displacement inner loop runs in
+    C++ (rn_cuckoo_pack) when available and ``use_native``, else in
     _pack_python -- both produce bit-identical tables."""
     n = int(len(src))
     src = np.ascontiguousarray(src, np.int32)
@@ -336,52 +411,41 @@ def ubodt_from_columns(
     dist = np.ascontiguousarray(dist, np.float32)
     time = np.ascontiguousarray(time, np.float32)
     first_edge = np.ascontiguousarray(first_edge, np.int32)
-    lib = _get_native("rn_ubodt_pack") if use_native else None
+    lib = _get_native("rn_cuckoo_pack") if use_native else None
 
-    size = 1
-    while size < max(int(n / load_factor), 8):
-        size <<= 1
+    n_buckets = 1
+    while n_buckets * BUCKET * load_factor < max(n, 1):
+        n_buckets <<= 1
+    n_buckets = max(n_buckets, 4)
     while True:
+        packed = np.zeros((n_buckets, BUCKET, ROW_W), np.int32)
+        packed[:, :, F_SRC] = EMPTY
         if lib is not None:
-            # rn_ubodt_pack initialises every slot itself; skip the dead
-            # Python-side pre-fill (size can be tens of millions of slots)
-            tsrc = np.empty(size, np.int32)
-            tdst = np.empty(size, np.int32)
-            tdist = np.empty(size, np.float32)
-            ttime = np.empty(size, np.float32)
-            tfe = np.empty(size, np.int32)
-            max_probe = lib.rn_ubodt_pack(
-                n, src, dst, dist, time, first_edge, size, max_probe_limit,
-                tsrc, tdst, tdist, ttime, tfe,
+            max_chain = lib.rn_cuckoo_pack(
+                n, src, dst, dist, time, first_edge, n_buckets,
+                packed.reshape(-1),
             )
         else:
-            tsrc = np.full(size, EMPTY, np.int32)
-            tdst = np.full(size, EMPTY, np.int32)
-            tdist = np.full(size, np.inf, np.float32)
-            ttime = np.full(size, np.inf, np.float32)
-            tfe = np.full(size, -1, np.int32)
-            max_probe = _pack_python(
-                src, dst, dist, time, first_edge, size, max_probe_limit,
-                tsrc, tdst, tdist, ttime, tfe,
+            max_chain = _pack_python(
+                src, dst, dist, time, first_edge, n_buckets, packed
             )
-        if max_probe >= 0:
+        if max_chain >= 0:
             break
-        size <<= 1
-        log.info("ubodt: max probe length exceeded %d, growing table to %d",
-                 max_probe_limit, size)
-    log.info("ubodt: %d rows, table size %d, max probes %d", n, size, max_probe)
+        n_buckets <<= 1
+        log.info("ubodt: cuckoo insert chain exceeded %d kicks, growing table "
+                 "to %d buckets", MAX_KICKS, n_buckets)
+    log.info("ubodt: %d rows, %d buckets (load %.2f), max kick chain %d",
+             n, n_buckets, n / max(n_buckets * BUCKET, 1), max_chain)
     return UBODT(
-        delta=delta, table_src=tsrc, table_dst=tdst, table_dist=tdist,
-        table_time=ttime, table_first_edge=tfe, mask=size - 1,
-        max_probes=int(max_probe), num_rows=n,
+        delta=delta, packed=packed, bmask=n_buckets - 1, num_rows=n,
+        max_kicks=int(max_chain),
     )
 
 
 def ubodt_from_rows(
     rows: List[Tuple[int, int, float, float, int]],
     delta: float,
-    load_factor: float = 0.5,
-    max_probe_limit: int = 64,
+    load_factor: float = LOAD_TARGET,
     use_native: bool = True,
 ) -> UBODT:
     """Pack (src, dst, dist, time, first_edge) row tuples into the hash
@@ -394,6 +458,6 @@ def ubodt_from_rows(
     return ubodt_from_columns(
         np.asarray(srcs, np.int32), np.asarray(dsts, np.int32),
         np.asarray(dists, np.float32), np.asarray(times, np.float32),
-        np.asarray(fes, np.int32), delta, load_factor, max_probe_limit,
+        np.asarray(fes, np.int32), delta, load_factor,
         use_native=use_native,
     )

@@ -125,7 +125,7 @@ def test_graph_sharded_rejects_bad_axis(setup):
     from reporter_tpu.parallel import check_ubodt_shardable
 
     arrays, ubodt = setup
-    size = len(ubodt.table_src)
+    size = ubodt.packed.shape[0]
     bad = 3 if size % 3 else 5
     with pytest.raises(ValueError):
         check_ubodt_shardable(ubodt, bad)

@@ -82,9 +82,9 @@ def test_path_reconstruction(arrays, ubodt):
 
 
 def test_native_builder_bit_identical(arrays):
-    """The C++ builder (rn_ubodt_build + rn_ubodt_pack) must produce the
+    """The C++ builder (rn_ubodt_build + rn_cuckoo_pack) must produce the
     exact table the Python oracle does: same rows in the same order, same
-    probe placement -- byte-for-byte equal arrays."""
+    deterministic cuckoo placement -- byte-for-byte equal arrays."""
     from reporter_tpu.native import get_lib
 
     if get_lib() is None:
@@ -92,13 +92,9 @@ def test_native_builder_bit_identical(arrays):
     u_py = build_ubodt(arrays, delta=1000.0, use_native=False)
     u_nat = build_ubodt(arrays, delta=1000.0, use_native=True)
     assert u_nat.num_rows == u_py.num_rows
-    assert u_nat.mask == u_py.mask
-    assert u_nat.max_probes == u_py.max_probes
-    for field in ("table_src", "table_dst", "table_dist", "table_time",
-                  "table_first_edge"):
-        np.testing.assert_array_equal(
-            getattr(u_nat, field), getattr(u_py, field), err_msg=field
-        )
+    assert u_nat.bmask == u_py.bmask
+    assert u_nat.max_kicks == u_py.max_kicks
+    np.testing.assert_array_equal(u_nat.packed, u_py.packed)
 
 
 def test_native_builder_threaded_deterministic(arrays):
@@ -134,8 +130,14 @@ def test_device_lookup_matches_host(arrays, ubodt):
             assert d_dev[i] == pytest.approx(d_host, rel=1e-6)
             assert fe_dev[i] == fe_host
 
-    # hash parity host vs device
-    mask = ubodt.mask
+    # hash parity host vs device (both bucket choices)
+    from reporter_tpu.ops.hashtable import device_pair_hash2
+    from reporter_tpu.tiles.ubodt import pair_hash2
+
+    mask = ubodt.bmask
     h_host = np.array([int(pair_hash(np.int64(s), np.int64(t), mask)) for s, t in zip(src, dst)])
     h_dev = np.asarray(device_pair_hash(jnp.asarray(src), jnp.asarray(dst), mask))
     np.testing.assert_array_equal(h_host, h_dev)
+    h2_host = np.array([int(pair_hash2(np.int64(s), np.int64(t), mask)) for s, t in zip(src, dst)])
+    h2_dev = np.asarray(device_pair_hash2(jnp.asarray(src), jnp.asarray(dst), mask))
+    np.testing.assert_array_equal(h2_host, h2_dev)

@@ -1,0 +1,148 @@
+"""Microbenchmark: UBODT probe layouts on the real device.
+
+Compares the round-3 layout (linear probing, 5 SoA arrays, max_probes
+unrolled gathers x 5 arrays each) against the round-4 candidate (2-choice
+bucketed cuckoo, one interleaved [buckets, 2, 8] int32 row-gather per probe)
+on a synthetic table sized like the bench scenario (~32M slots / ~10.7M rows).
+
+Run:  python tools/probe_microbench.py [--platform tpu|cpu]
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+
+import numpy as np
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--slots", type=int, default=1 << 25)  # 32M (r03 bench size)
+    ap.add_argument("--lookups", type=int, default=8 * 1023 * 64)  # B=8,T=1024,KxK=64
+    ap.add_argument("--probes", type=int, default=26)  # measured r03 max_probes would go here
+    ap.add_argument("--reps", type=int, default=20)
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    dev = jax.devices()[0]
+    print("platform:", dev.platform, dev)
+
+    S = args.slots
+    N = args.lookups
+    rng = np.random.default_rng(0)
+
+    # --- r03 layout: 5 SoA int32/f32 arrays -------------------------------
+    t_src = jnp.asarray(rng.integers(0, 1 << 20, S, dtype=np.int32))
+    t_dst = jnp.asarray(rng.integers(0, 1 << 20, S, dtype=np.int32))
+    t_dist = jnp.asarray(rng.random(S, dtype=np.float32))
+    t_time = jnp.asarray(rng.random(S, dtype=np.float32))
+    t_fe = jnp.asarray(rng.integers(0, 1 << 20, S, dtype=np.int32))
+
+    # --- r04 layout: interleaved [buckets, 2, 8] int32 --------------------
+    BKT = S // 2
+    packed = jnp.asarray(rng.integers(0, 1 << 20, (BKT, 2, 8), dtype=np.int32))
+
+    src = jnp.asarray(rng.integers(0, 1 << 20, N, dtype=np.int32))
+    dst = jnp.asarray(rng.integers(0, 1 << 20, N, dtype=np.int32))
+    mask = S - 1
+    bmask = BKT - 1
+
+    def hash1(s, d, m):
+        s = s.astype(jnp.uint32)
+        d = d.astype(jnp.uint32)
+        h = s * jnp.uint32(0x9E3779B1) + d * jnp.uint32(0x85EBCA6B)
+        h = h ^ (h >> jnp.uint32(15))
+        h = h * jnp.uint32(0x2C1B3C6D)
+        h = h ^ (h >> jnp.uint32(12))
+        return (h & jnp.uint32(m)).astype(jnp.int32)
+
+    def hash2(s, d, m):
+        s = s.astype(jnp.uint32)
+        d = d.astype(jnp.uint32)
+        h = s * jnp.uint32(0x85EBCA77) + d * jnp.uint32(0xC2B2AE3D)
+        h = h ^ (h >> jnp.uint32(13))
+        h = h * jnp.uint32(0x27D4EB2F)
+        h = h ^ (h >> jnp.uint32(16))
+        return (h & jnp.uint32(m)).astype(jnp.int32)
+
+    def probe_r03(src, dst, n_probes):
+        h = hash1(src, dst, mask)
+        dist = jnp.full(h.shape, jnp.inf, jnp.float32)
+        tim = jnp.full(h.shape, jnp.inf, jnp.float32)
+        first = jnp.full(h.shape, -1, jnp.int32)
+        found = jnp.zeros(h.shape, jnp.bool_)
+        for p in range(n_probes):
+            idx = (h + p) & mask
+            ts = t_src[idx]
+            td = t_dst[idx]
+            hit = (ts == src) & (td == dst) & (~found)
+            dist = jnp.where(hit, t_dist[idx], dist)
+            tim = jnp.where(hit, t_time[idx], tim)
+            first = jnp.where(hit, t_fe[idx], first)
+            found = found | hit | (ts == -1)
+        return dist, tim, first
+
+    def probe_cuckoo(src, dst):
+        b1 = hash1(src, dst, bmask)
+        b2 = hash2(src, dst, bmask)
+        r1 = packed[b1]  # [N, 2, 8]
+        r2 = packed[b2]
+        rows = jnp.concatenate([r1, r2], axis=-2)  # [N, 4, 8]
+        hit = (rows[..., 0] == src[..., None]) & (rows[..., 1] == dst[..., None])
+        dist = jnp.min(
+            jnp.where(hit, jax.lax.bitcast_convert_type(rows[..., 2], jnp.float32), jnp.inf),
+            axis=-1,
+        )
+        tim = jnp.min(
+            jnp.where(hit, jax.lax.bitcast_convert_type(rows[..., 3], jnp.float32), jnp.inf),
+            axis=-1,
+        )
+        first = jnp.max(jnp.where(hit, rows[..., 4], -1), axis=-1)
+        return dist, tim, first
+
+    def probe_r03_interleaved(src, dst, n_probes):
+        # linear probing but one row-gather per probe
+        h = hash1(src, dst, mask)
+        flat = packed.reshape(-1, 8)[:S]
+        dist = jnp.full(h.shape, jnp.inf, jnp.float32)
+        tim = jnp.full(h.shape, jnp.inf, jnp.float32)
+        first = jnp.full(h.shape, -1, jnp.int32)
+        found = jnp.zeros(h.shape, jnp.bool_)
+        for p in range(n_probes):
+            idx = (h + p) & mask
+            row = flat[idx]  # [N, 8]
+            hit = (row[..., 0] == src) & (row[..., 1] == dst) & (~found)
+            dist = jnp.where(hit, jax.lax.bitcast_convert_type(row[..., 2], jnp.float32), dist)
+            tim = jnp.where(hit, jax.lax.bitcast_convert_type(row[..., 3], jnp.float32), tim)
+            first = jnp.where(hit, row[..., 4], first)
+            found = found | hit | (row[..., 0] == -1)
+        return dist, tim, first
+
+    def bench(name, fn, *a):
+        f = jax.jit(fn)
+        t0 = time.time()
+        out = f(*a)
+        jax.block_until_ready(out)
+        compile_s = time.time() - t0
+        t0 = time.time()
+        for _ in range(args.reps):
+            out = f(*a)
+        jax.block_until_ready(out)
+        dt = (time.time() - t0) / args.reps
+        print(
+            "%-22s %8.2f ms   %8.1f M lookups/s   (compile %.1fs)"
+            % (name, dt * 1e3, N / dt / 1e6, compile_s)
+        )
+        return dt
+
+    bench("cuckoo-2probe", probe_cuckoo, src, dst)
+    bench("linear-interleaved-8", lambda s, d: probe_r03_interleaved(s, d, 8), src, dst)
+    bench("linear-soa-8", lambda s, d: probe_r03(s, d, 8), src, dst)
+    bench("linear-soa-%d" % args.probes, lambda s, d: probe_r03(s, d, args.probes), src, dst)
+
+
+if __name__ == "__main__":
+    main()
