@@ -261,7 +261,9 @@ def run_device() -> int:
     dg, du, params = matcher._dg, matcher._du, matcher._params
     pallas_on = bool(getattr(matcher, "_pallas", False))
 
-    def _compact_args(px, py, tm, valid):
+    forward_by_cohort = {}
+
+    def _compact_args(px, py, tm, valid, cohort=None):
         # mirror SegmentMatcher._dispatch_batch's forward selection: pallas
         # only at >= one full 128-row block, scan below that
         B = px.shape[0]
@@ -269,6 +271,8 @@ def run_device() -> int:
         if use_pallas and B % 128:
             px, py, tm, valid = _pad_rows(128 - B % 128, px, py, tm, valid)
         fn = matcher._jit_match_pallas if use_pallas else matcher._jit_match_scan
+        if cohort:
+            forward_by_cohort[cohort] = "pallas" if use_pallas else "scan"
         return fn, (dg, du, jnp.asarray(px), jnp.asarray(py), jnp.asarray(tm),
                     jnp.asarray(valid), params)
 
@@ -280,7 +284,7 @@ def run_device() -> int:
         cohort_xy[name] = (px, py, tm, valid)
         if name == "long":
             continue  # long runs through the carry kernel below
-        fn, args = _compact_args(px, py, tm, valid)
+        fn, args = _compact_args(px, py, tm, valid, cohort=name)
         jax.block_until_ready(fn(*args, cfg.beam_k))
         t0 = time.time()
         for _ in range(reps):
@@ -324,7 +328,8 @@ def run_device() -> int:
 
     kernel_tps = n_traces / kernel_secs
     device_util = min(1.0, kernel_secs / (e2e_wall / reps))
-    forward = "pallas" if pallas_on else "scan"
+    forward_by_cohort["long"] = "carry-scan"
+    forward = "pallas" if pallas_on else "scan"  # availability; per-cohort below
     _stderr("kernel-only %.1f traces/s (%s forward); e2e %.1f traces/s (%.0f pts/s); "
             "device util %.2f" % (kernel_tps, forward, tps, pps, device_util))
 
@@ -393,6 +398,7 @@ def run_device() -> int:
         "p95_latency_ms": round(p95_ms, 2),
         "latency_cohort": "short64",
         "forward": forward,
+        "forward_by_cohort": forward_by_cohort,
         "kernel_traces_per_sec": round(kernel_tps, 1),
         "kernel_by_cohort": {k: round(v, 1) for k, v in kernel_by_cohort.items()},
         "device_util": round(device_util, 3),
@@ -643,7 +649,7 @@ def main() -> int:
         "vs_baseline_traces": round(device_json.get("value", 0) / cpu_tps, 2) if cpu_tps else None,
     }
     for k in ("platform", "acquire_s", "points_per_sec", "p50_latency_ms", "p95_latency_ms",
-              "latency_cohort", "forward", "kernel_traces_per_sec", "kernel_by_cohort",
+              "latency_cohort", "forward", "forward_by_cohort", "kernel_traces_per_sec", "kernel_by_cohort",
               "device_util", "pallas", "agreement", "agreement_by_cohort", "device_mb",
               "edges", "ubodt_rows"):
         if k in device_json:

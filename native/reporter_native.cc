@@ -980,50 +980,48 @@ int64_t rn_cuckoo_pack(int64_t n_rows, const int32_t* src, const int32_t* dst,
     return v;
   };
 
+  // Standard cuckoo walk, mirrored line-for-line with
+  // tiles/ubodt._pack_python: try both home buckets; when both are full,
+  // evict the (kick % kBucket) slot of the second bucket and push the
+  // victim to *its* other bucket, repeating.  The rotating slot index
+  // de-synchronises revisits so deterministic walks still disperse.
+  auto try_place = [&](int64_t b, const int32_t* e5) -> bool {
+    for (int64_t s = 0; s < kBucket; ++s) {
+      int32_t* e = entry(b, s);
+      if (e[F_SRC] == -1) {
+        for (int64_t i = 0; i < kRowW; ++i) e[i] = 0;
+        e[F_SRC] = e5[0]; e[F_DST] = e5[1]; e[F_DIST] = e5[2];
+        e[F_TIME] = e5[3]; e[F_FE] = e5[4];
+        return true;
+      }
+    }
+    return false;
+  };
+
   int64_t max_chain = 0;
   for (int64_t r = 0; r < n_rows; ++r) {
-    int32_t cs = src[r], cd = dst[r];
-    int32_t cdist = bits(dist[r]), ctime = bits(time[r]), cfe = fe[r];
+    int32_t cur[5] = {src[r], dst[r], bits(dist[r]), bits(time[r]), fe[r]};
+    int64_t b1 = pair_hash((uint32_t)cur[0], (uint32_t)cur[1], bmask);
+    int64_t b2 = pair_hash2((uint32_t)cur[0], (uint32_t)cur[1], bmask);
+    if (try_place(b1, cur) || try_place(b2, cur)) continue;
+    int64_t b = b2;
     bool placed = false;
-    int64_t b = pair_hash((uint32_t)cs, (uint32_t)cd, bmask);
     for (int64_t kick = 0; kick < kMaxKicks; ++kick) {
-      int64_t free_s = -1;
-      for (int64_t s = 0; s < kBucket; ++s)
-        if (entry(b, s)[F_SRC] == -1) { free_s = s; break; }
-      if (free_s >= 0) {
-        int32_t* e = entry(b, free_s);
-        e[F_SRC] = cs; e[F_DST] = cd; e[F_DIST] = cdist;
-        e[F_TIME] = ctime; e[F_FE] = cfe;
-        if (kick > max_chain) max_chain = kick;
+      int64_t s = kick % kBucket;
+      int32_t* e = entry(b, s);
+      int32_t victim[5] = {e[F_SRC], e[F_DST], e[F_DIST], e[F_TIME], e[F_FE]};
+      e[F_SRC] = cur[0]; e[F_DST] = cur[1]; e[F_DIST] = cur[2];
+      e[F_TIME] = cur[3]; e[F_FE] = cur[4];
+      for (int64_t i = 0; i < 5; ++i) cur[i] = victim[i];
+      // the victim's other bucket (same bucket if h1 == h2)
+      int64_t nb = pair_hash((uint32_t)cur[0], (uint32_t)cur[1], bmask);
+      if (nb == b) nb = pair_hash2((uint32_t)cur[0], (uint32_t)cur[1], bmask);
+      b = nb;
+      if (try_place(b, cur)) {
+        if (kick + 1 > max_chain) max_chain = kick + 1;
         placed = true;
         break;
       }
-      int64_t alt = pair_hash2((uint32_t)cs, (uint32_t)cd, bmask);
-      if (alt == b) alt = pair_hash((uint32_t)cs, (uint32_t)cd, bmask);
-      if (alt != b) {
-        free_s = -1;
-        for (int64_t s = 0; s < kBucket; ++s)
-          if (entry(alt, s)[F_SRC] == -1) { free_s = s; break; }
-        if (free_s >= 0) {
-          int32_t* e = entry(alt, free_s);
-          e[F_SRC] = cs; e[F_DST] = cd; e[F_DIST] = cdist;
-          e[F_TIME] = ctime; e[F_FE] = cfe;
-          if (kick + 1 > max_chain) max_chain = kick + 1;
-          placed = true;
-          break;
-        }
-      }
-      // evict a deterministic rotating slot of the alternate bucket
-      int64_t s = kick % kBucket;
-      int32_t* e = entry(alt, s);
-      int32_t vs = e[F_SRC], vd = e[F_DST], vdist = e[F_DIST],
-              vtime = e[F_TIME], vfe = e[F_FE];
-      e[F_SRC] = cs; e[F_DST] = cd; e[F_DIST] = cdist;
-      e[F_TIME] = ctime; e[F_FE] = cfe;
-      cs = vs; cd = vd; cdist = vdist; ctime = vtime; cfe = vfe;
-      // the victim's next try: whichever of its buckets is not `alt`
-      b = pair_hash((uint32_t)cs, (uint32_t)cd, bmask);
-      if (b == alt) b = pair_hash2((uint32_t)cs, (uint32_t)cd, bmask);
     }
     if (!placed) return -1;
   }

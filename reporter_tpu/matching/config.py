@@ -38,6 +38,11 @@ class MatcherConfig:
     # pallas Viterbi forward (ops/viterbi_pallas.py): None = auto (TPU with
     # beam_k == 8), True/False = force.  $REPORTER_PALLAS overrides.
     use_pallas: Optional[bool] = None
+    # devices to shard the trace batch over (dp axis of a jax Mesh).  1 =
+    # single device; >1 routes every match_many batch through dp-sharded
+    # jits (parallel/mesh.py semantics in the product path).  Must be a
+    # power of two <= visible devices.
+    devices: int = 1
     # report() business-logic default (reporter_service.py:54-58)
     threshold_sec: int = 15
     mode: str = "auto"

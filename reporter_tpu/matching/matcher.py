@@ -84,6 +84,31 @@ class SegmentMatcher:
         self._dg = self.arrays.to_device()
         self._du = self.ubodt.to_device()
         self._params = MatchParams.from_config(self.cfg)
+
+        # device mesh in the product path (VERDICT r03 next #4): with
+        # cfg.devices > 1 the graph/UBODT/params live replicated over a dp
+        # mesh and every batch array is device_put with a dp sharding before
+        # dispatch — computation follows data, so the same jits below run
+        # SPMD across chips with XLA inserting the collectives.  This is the
+        # TPU equivalent of the reference scaling by Kafka partitions
+        # (README.md:169-173).
+        self._mesh = None
+        self._batch_sharding = None
+        self._n_dp = max(1, int(self.cfg.devices))
+        if self._n_dp > 1:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from ..parallel.mesh import BATCH_AXIS, make_mesh
+
+            if self._n_dp & (self._n_dp - 1):
+                raise ValueError("cfg.devices must be a power of two, got %d"
+                                 % self._n_dp)
+            self._mesh = make_mesh(self._n_dp)
+            repl = NamedSharding(self._mesh, P())
+            self._batch_sharding = NamedSharding(self._mesh, P(BATCH_AXIS))
+            self._dg = jax.device_put(self._dg, repl)
+            self._du = jax.device_put(self._du, repl)
+            self._params = jax.device_put(self._params, repl)
         self._jit_match_carry = jax.jit(match_batch_carry, static_argnums=(7,))
 
         use_pallas = self.cfg.use_pallas
@@ -94,6 +119,12 @@ class SegmentMatcher:
             use_pallas = (
                 jax.devices()[0].platform == "tpu" and self.cfg.beam_k == 8
             )
+        if self._mesh is not None and use_pallas:
+            # the pallas forward does not partition under sharded jit; the
+            # mesh path runs the scan forward (the transition/UBODT work —
+            # where the time goes — shards either way)
+            log.info("devices=%d: pallas forward disabled in mesh mode", self._n_dp)
+            use_pallas = False
         self._pallas = bool(use_pallas) and self.cfg.beam_k == 8
         # the scan forward is always compiled: it serves every batch smaller
         # than the pallas kernel's 128-row block (padding a single streaming
@@ -119,6 +150,18 @@ class SegmentMatcher:
 
         self._cpu = CPUViterbiMatcher(self.arrays, self.ubodt, self.cfg)
 
+    def _put(self, a: np.ndarray, dtype):
+        """Batch array -> device, dp-sharded when a mesh is configured.
+        Sharded host arrays go straight to their owner devices (device_put
+        on the host array); routing through a single-device jnp.asarray
+        first would double the transfer."""
+        import jax
+        import jax.numpy as jnp
+
+        if self._batch_sharding is not None:
+            return jax.device_put(np.asarray(a, dtype), self._batch_sharding)
+        return jnp.asarray(a, dtype)
+
     def _dispatch_batch(self, px: np.ndarray, py: np.ndarray, times: np.ndarray, valid: np.ndarray):
         """Queue one [B, T] padded batch on the backend without blocking.
         Returns an opaque handle for _collect_batch."""
@@ -138,11 +181,16 @@ class SegmentMatcher:
                         128 - B % 128, px, py, times, valid
                     )
                 fn = self._jit_match_pallas
+            elif self._mesh is not None and px.shape[0] % self._n_dp:
+                # dp sharding splits the batch axis evenly across chips
+                px, py, times, valid = _pad_rows(
+                    self._n_dp - px.shape[0] % self._n_dp, px, py, times, valid
+                )
             res = fn(
                 self._dg, self._du,
-                jnp.asarray(px, jnp.float32), jnp.asarray(py, jnp.float32),
-                jnp.asarray(times, jnp.float32),
-                jnp.asarray(valid, bool), self._params, self.cfg.beam_k,
+                self._put(px, jnp.float32), self._put(py, jnp.float32),
+                self._put(times, jnp.float32),
+                self._put(valid, bool), self._params, self.cfg.beam_k,
             )
             return ("jax", B, res)
         return ("cpu", self._cpu.run_batch(px, py, times, valid))
@@ -226,7 +274,7 @@ class SegmentMatcher:
 
         for blen, idxs in chunks:
             px, py, tm, valid, times = self._fill_rows(traces, idxs, blen)
-            handle = self._dispatch_batch(*self._pad_pow2(px, py, tm, valid))
+            handle = self._dispatch_batch(*self._pad_batch(px, py, tm, valid))
             pending.append((idxs, handle, times))
             if len(pending) >= PIPELINE_DEPTH:
                 drain_one()
@@ -244,13 +292,21 @@ class SegmentMatcher:
 
     def _device_cap(self, blen: int) -> int:
         """Rows per device batch for window length blen: bound B*T (the
-        kernel materialises [B, T, K, K]) with a row cap on top, rounded down
-        to a power of two so pow2 batch padding cannot overshoot it."""
+        kernel materialises [B, T, K, K]) with a row cap on top, rounded
+        DOWN to a _BATCH_LADDER rung so batch padding (which rounds UP to a
+        rung) can never overshoot the configured memory bound.  Never below
+        the dp mesh width: a chunk must split evenly across devices."""
         cap = max(1, min(int(self.cfg.max_device_batch),
                          int(self.cfg.max_device_points) // blen))
-        while cap & (cap - 1):
-            cap &= cap - 1
-        return cap
+        rung = self._BATCH_LADDER[0]
+        for r in self._BATCH_LADDER:
+            if r <= cap:
+                rung = r
+        if cap > self._BATCH_LADDER[-1]:  # beyond the ladder: power of two
+            rung = cap
+            while rung & (rung - 1):
+                rung &= rung - 1
+        return max(rung, self._n_dp if self.backend == "jax" else 1)
 
     def _fill_rows(self, traces, idxs, T):
         """Pack traces[idxs] into padded [B, T] device arrays + times lists."""
@@ -277,15 +333,24 @@ class SegmentMatcher:
             times.append(ts)
         return px, py, tm, valid, times
 
-    @staticmethod
-    def _pad_pow2(px, py, tm, valid):
-        """Pad the batch dimension to a power of two so the jitted kernel
-        compiles for a bounded set of (B, T) shapes; dummy rows are
-        all-invalid and sliced off by the caller."""
+    # batch-dimension padding ladder: the jitted kernels compile once per
+    # (B, T) shape, so B snaps up to a small fixed set instead of every
+    # power of two (VERDICT r03 next #3: prune the compiled shape set).
+    # Below one pallas block the rungs are sparse (worst case 4x row waste,
+    # only where absolute cost is small); at >=128 the rungs are the pow2
+    # block multiples the pallas forward serves.
+    _BATCH_LADDER = (1, 4, 16, 64, 128, 256, 512, 1024, 2048)
+
+    @classmethod
+    def _pad_batch(cls, px, py, tm, valid):
+        """Pad the batch dimension up to the next ladder rung; dummy rows
+        are all-invalid and sliced off by the caller."""
         B = px.shape[0]
-        B_pad = 1
-        while B_pad < B:
-            B_pad <<= 1
+        B_pad = next((r for r in cls._BATCH_LADDER if r >= B), None)
+        if B_pad is None:  # beyond the ladder: next power of two
+            B_pad = 1
+            while B_pad < B:
+                B_pad <<= 1
         if B_pad == B:
             return px, py, tm, valid
         return _pad_rows(B_pad - B, px, py, tm, valid)
@@ -330,17 +395,23 @@ class SegmentMatcher:
             T_max = max(len(traces[i]["trace"]) for i in group)
             n_chunks = -(-T_max // W)
             px, py, tm, valid, times = self._fill_rows(traces, group, n_chunks * W)
-            px, py, tm, valid = self._pad_pow2(px, py, tm, valid)
+            px, py, tm, valid = self._pad_batch(px, py, tm, valid)
+            if self._mesh is not None and px.shape[0] % self._n_dp:
+                px, py, tm, valid = _pad_rows(
+                    self._n_dp - px.shape[0] % self._n_dp, px, py, tm, valid
+                )
             B_pad = px.shape[0]
 
             carry = initial_carry_batch(B_pad, self.cfg.beam_k)
+            if self._batch_sharding is not None:
+                carry = jax.device_put(carry, self._batch_sharding)
             edges, offs, brks = [], [], []
             for c in range(n_chunks):
                 sl = slice(c * W, (c + 1) * W)
                 cm, carry = self._jit_match_carry(
                     self._dg, self._du,
-                    jnp.asarray(px[:, sl]), jnp.asarray(py[:, sl]),
-                    jnp.asarray(tm[:, sl]), jnp.asarray(valid[:, sl]),
+                    self._put(px[:, sl], jnp.float32), self._put(py[:, sl], jnp.float32),
+                    self._put(tm[:, sl], jnp.float32), self._put(valid[:, sl], bool),
                     self._params, self.cfg.beam_k, carry,
                 )
                 edges.append(np.asarray(cm.edge))

@@ -326,66 +326,63 @@ def _pack_python(src, dst, dist, time, first_edge, n_buckets, packed) -> int:
     """Python twin of rn_cuckoo_pack: deterministic 2-choice cuckoo insert
     into ``packed`` [n_buckets, BUCKET, ROW_W] (pre-zeroed with src = EMPTY),
     return the longest displacement chain, or -1 when an insert exceeds
-    MAX_KICKS (caller doubles n_buckets and retries)."""
+    MAX_KICKS (caller doubles n_buckets and retries).
+
+    Standard cuckoo walk: try both home buckets; when both are full, evict
+    the ``kick % BUCKET`` slot of the second bucket and push the victim to
+    *its* other bucket, repeating.  The rotating slot index de-synchronises
+    revisits of the same bucket, so deterministic walks still disperse; the
+    C++ twin mirrors this loop exactly for bit-identical tables."""
     bmask = n_buckets - 1
     dist_bits = np.asarray(dist, np.float32).view(np.int32)
     time_bits = np.asarray(time, np.float32).view(np.int32)
+
+    def h1(s, d):
+        return int(pair_hash(np.int64(s), np.int64(d), bmask))
+
+    def h2(s, d):
+        return int(pair_hash2(np.int64(s), np.int64(d), bmask))
+
+    def try_place(b, e) -> bool:
+        for s in range(BUCKET):
+            if packed[b, s, F_SRC] == EMPTY:
+                packed[b, s] = 0
+                packed[b, s, F_SRC] = e[0]
+                packed[b, s, F_DST] = e[1]
+                packed[b, s, F_DIST] = e[2]
+                packed[b, s, F_TIME] = e[3]
+                packed[b, s, F_FE] = e[4]
+                return True
+        return False
+
     max_chain = 0
     for r in range(len(src)):
-        cs, cd = int(src[r]), int(dst[r])
-        cdist, ctime, cfe = int(dist_bits[r]), int(time_bits[r]), int(first_edge[r])
+        cur = (int(src[r]), int(dst[r]), int(dist_bits[r]), int(time_bits[r]),
+               int(first_edge[r]))
+        b1 = h1(cur[0], cur[1])
+        b2 = h2(cur[0], cur[1])
+        if try_place(b1, cur) or try_place(b2, cur):
+            continue
+        b = b2
         placed = False
-        b = int(pair_hash(np.int64(cs), np.int64(cd), bmask))
         for kick in range(MAX_KICKS):
-            free = -1
-            for s in range(BUCKET):
-                if packed[b, s, F_SRC] == EMPTY:
-                    free = s
-                    break
-            if free >= 0:
-                packed[b, free, F_SRC] = cs
-                packed[b, free, F_DST] = cd
-                packed[b, free, F_DIST] = cdist
-                packed[b, free, F_TIME] = ctime
-                packed[b, free, F_FE] = cfe
-                max_chain = max(max_chain, kick)
+            s = kick % BUCKET
+            victim = tuple(int(v) for v in packed[b, s, :5])
+            packed[b, s, F_SRC] = cur[0]
+            packed[b, s, F_DST] = cur[1]
+            packed[b, s, F_DIST] = cur[2]
+            packed[b, s, F_TIME] = cur[3]
+            packed[b, s, F_FE] = cur[4]
+            cur = victim
+            # the victim's other bucket (same bucket if h1 == h2)
+            nb = h1(cur[0], cur[1])
+            if nb == b:
+                nb = h2(cur[0], cur[1])
+            b = nb
+            if try_place(b, cur):
+                max_chain = max(max_chain, kick + 1)
                 placed = True
                 break
-            alt = int(pair_hash2(np.int64(cs), np.int64(cd), bmask))
-            if alt == b:
-                alt = int(pair_hash(np.int64(cs), np.int64(cd), bmask))
-            if alt != b:
-                free = -1
-                for s in range(BUCKET):
-                    if packed[alt, s, F_SRC] == EMPTY:
-                        free = s
-                        break
-                if free >= 0:
-                    packed[alt, free, F_SRC] = cs
-                    packed[alt, free, F_DST] = cd
-                    packed[alt, free, F_DIST] = cdist
-                    packed[alt, free, F_TIME] = ctime
-                    packed[alt, free, F_FE] = cfe
-                    max_chain = max(max_chain, kick + 1)
-                    placed = True
-                    break
-            # evict a deterministic rotating slot of the alternate bucket
-            s = kick % BUCKET
-            vs = int(packed[alt, s, F_SRC])
-            vd = int(packed[alt, s, F_DST])
-            vdist = int(packed[alt, s, F_DIST])
-            vtime = int(packed[alt, s, F_TIME])
-            vfe = int(packed[alt, s, F_FE])
-            packed[alt, s, F_SRC] = cs
-            packed[alt, s, F_DST] = cd
-            packed[alt, s, F_DIST] = cdist
-            packed[alt, s, F_TIME] = ctime
-            packed[alt, s, F_FE] = cfe
-            cs, cd, cdist, ctime, cfe = vs, vd, vdist, vtime, vfe
-            # the victim's next try: whichever of its buckets is not `alt`
-            b = int(pair_hash(np.int64(cs), np.int64(cd), bmask))
-            if b == alt:
-                b = int(pair_hash2(np.int64(cs), np.int64(cd), bmask))
         if not placed:
             return -1
     return max_chain

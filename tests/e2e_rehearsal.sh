@@ -13,6 +13,12 @@ set -euo pipefail
 cd "$(dirname "$0")/.."
 export PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}"
 export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+# the rehearsal service runs the SHARDED matcher (devices=2 in the config
+# below) on a virtual 2-device CPU mesh — the integrated mesh path must
+# survive the full pipeline, not just unit tests (VERDICT r03 next #4)
+if [[ "${XLA_FLAGS:-}" != *xla_force_host_platform_device_count* ]]; then
+    export XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=2"
+fi
 
 WORK="${1:-$(mktemp -d /tmp/reporter-e2e.XXXXXX)}"
 PORT=18021
@@ -23,7 +29,7 @@ echo "rehearsal workdir: $WORK"
 cat > "$WORK/config.json" <<EOF
 {
   "network": {"type": "grid", "rows": 8, "cols": 8, "spacing_m": 200},
-  "matcher": {"sigma_z": 4.07, "beta": 3.0, "search_radius": 50.0},
+  "matcher": {"sigma_z": 4.07, "beta": 3.0, "search_radius": 50.0, "devices": 2},
   "backend": "jax",
   "batch": {"max_batch": 64, "max_wait_ms": 5}
 }

@@ -144,3 +144,35 @@ def test_epoch_scale_times_preserved(setup, matcher):
         if a["end_time"] != -1 and b["start_time"] != -1
     ]
     assert pairs and all(abs(x - y) < 0.01 for x, y in pairs)
+
+
+def test_mesh_devices_product_path(setup, matcher):
+    """cfg.devices=2 routes match_many through dp-sharded jits (the product
+    mesh path, VERDICT r03 next #4) and must reproduce the single-device
+    results segment-for-segment, including odd batch sizes that need
+    dp padding and the long-trace carry path."""
+    import jax
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs the virtual multi-device CPU backend")
+    _, arrays, ubodt = setup
+    mm = SegmentMatcher(
+        arrays=arrays, ubodt=ubodt, config=MatcherConfig(devices=2)
+    )
+    assert mm._mesh is not None
+    row = [2 * 5 + c for c in range(5)]
+    traces = [street_trace(arrays, row, 10, seed=s) for s in range(5)]
+    # a long trace beyond the largest bucket exercises the sharded carry path
+    traces.append(street_trace(arrays, row, 300, seed=99, dt=2))
+    got = mm.match_many(traces)
+    want = matcher.match_many(traces)
+    for g, w in zip(got, want):
+        assert g == w
+
+
+def test_mesh_devices_validation():
+    with pytest.raises(ValueError, match="power of two"):
+        city = grid_city(rows=3, cols=3, spacing_m=150.0)
+        arrays = build_graph_arrays(city, cell_size=100.0)
+        ubodt = build_ubodt(arrays, delta=500.0)
+        SegmentMatcher(arrays=arrays, ubodt=ubodt, config=MatcherConfig(devices=3))

@@ -50,23 +50,9 @@ def main():
     mask = S - 1
     bmask = BKT - 1
 
-    def hash1(s, d, m):
-        s = s.astype(jnp.uint32)
-        d = d.astype(jnp.uint32)
-        h = s * jnp.uint32(0x9E3779B1) + d * jnp.uint32(0x85EBCA6B)
-        h = h ^ (h >> jnp.uint32(15))
-        h = h * jnp.uint32(0x2C1B3C6D)
-        h = h ^ (h >> jnp.uint32(12))
-        return (h & jnp.uint32(m)).astype(jnp.int32)
-
-    def hash2(s, d, m):
-        s = s.astype(jnp.uint32)
-        d = d.astype(jnp.uint32)
-        h = s * jnp.uint32(0x85EBCA77) + d * jnp.uint32(0xC2B2AE3D)
-        h = h ^ (h >> jnp.uint32(13))
-        h = h * jnp.uint32(0x27D4EB2F)
-        h = h ^ (h >> jnp.uint32(16))
-        return (h & jnp.uint32(m)).astype(jnp.int32)
+    # the production hash mixes — measure exactly what the product probes
+    from reporter_tpu.ops.hashtable import device_pair_hash as hash1
+    from reporter_tpu.ops.hashtable import device_pair_hash2 as hash2
 
     def probe_r03(src, dst, n_probes):
         h = hash1(src, dst, mask)
