@@ -37,8 +37,15 @@ def main(argv=None) -> int:
     ap.add_argument("--checkpoint", default=None,
                     help="state snapshot file: restored at boot, written on "
                          "an interval and at close (the Kafka state-store "
-                         "durability equivalent)")
+                         "durability equivalent; single-instance)")
     ap.add_argument("--checkpoint-interval", type=float, default=60.0)
+    ap.add_argument("--checkpoint-dir", default=None,
+                    help="PARTITION-scoped snapshot directory shared by all "
+                         "instances of the consumer group (NFS/shared disk): "
+                         "in-flight vehicle state follows partitions across "
+                         "rebalances, so N instances scale out like the "
+                         "reference's Kafka Streams stores.  Kafka mode only; "
+                         "mutually exclusive with --checkpoint")
     args = ap.parse_args(argv)
 
     logging.basicConfig(
@@ -59,7 +66,12 @@ def main(argv=None) -> int:
         microbatch_size=args.microbatch,
     )
 
-    from .checkpoint import Checkpointer, load_file
+    from .checkpoint import Checkpointer, PartitionedStreamRunner, load_file
+
+    if args.checkpoint and args.checkpoint_dir:
+        ap.error("--checkpoint and --checkpoint-dir are mutually exclusive")
+    if args.checkpoint_dir and not args.bootstrap:
+        ap.error("--checkpoint-dir needs the Kafka transport (--bootstrap)")
 
     ckpt = Checkpointer(pipeline, args.checkpoint, args.checkpoint_interval)
     if args.checkpoint:
@@ -68,6 +80,10 @@ def main(argv=None) -> int:
     if args.bootstrap:
         from .kafka_io import run_pipeline
 
+        runner = (
+            PartitionedStreamRunner(pipeline, args.checkpoint_dir)
+            if args.checkpoint_dir else None
+        )
         run_pipeline(
             pipeline, args.topic, args.bootstrap, duration_sec=args.duration,
             on_tick=ckpt.maybe_save,
@@ -77,6 +93,7 @@ def main(argv=None) -> int:
             # coordinate offset commits with snapshots so a crash replays
             # from the restored state instead of dropping the gap
             manual_commit=bool(args.checkpoint),
+            runner=runner,
         )
     else:
         start = time.time()

@@ -61,11 +61,19 @@ class BatchingProcessor:
         self.microbatch_size = max(1, microbatch_size)
         self.store: Dict[str, Batch] = {}
         self._ready: List[str] = []  # uuids awaiting a micro-batch flush
+        # source partition per uuid: the unit of state hand-off between
+        # consumer-group members (the reference gets this scoping for free
+        # from Kafka Streams' per-partition state stores,
+        # BatchingProcessor.java:19-22; here checkpoint.snapshot_partition
+        # selects on it during a rebalance)
+        self.partitions: Dict[str, int] = {}
         self.reported_pairs = 0
 
     # -- stream hooks ------------------------------------------------------
 
-    def process(self, key: str, point: Point, timestamp_ms: int) -> None:
+    def process(self, key: str, point: Point, timestamp_ms: int,
+                partition: int = 0) -> None:
+        self.partitions[key] = partition
         batch = self.store.get(key)
         if batch is None:
             batch = Batch(point)
@@ -91,6 +99,7 @@ class BatchingProcessor:
         requests, keys = [], []
         for k in stale:
             batch = self.store.pop(k)
+            self.partitions.pop(k, None)
             if k in self._ready:
                 self._ready.remove(k)
             if batch.meets(0, 2, 0):
@@ -130,6 +139,7 @@ class BatchingProcessor:
                 log.debug("%s trimmed %d -> %d", k, before, len(batch.points))
             if not batch.points:
                 del self.store[k]
+                self.partitions.pop(k, None)
             self._forward(resp)
 
     # -- downstream --------------------------------------------------------
@@ -162,3 +172,32 @@ class BatchingProcessor:
                 log.warning("got back invalid segment: %r", seg)
         self.reported_pairs += n
         return n
+
+    # -- partition state hand-off -----------------------------------------
+
+    def take_partition(self, partition: int):
+        """Remove and return this partition's in-flight state:
+        (batches: {uuid: Batch}, ready: [uuid]).  Used when a rebalance
+        revokes the partition — the state travels to the next owner via a
+        partition checkpoint (checkpoint.PartitionCheckpointer)."""
+        uuids = [k for k, p in self.partitions.items() if p == partition]
+        batches = {}
+        ready = []
+        for k in uuids:
+            b = self.store.pop(k, None)
+            if b is not None:
+                batches[k] = b
+            self.partitions.pop(k, None)
+            if k in self._ready:
+                self._ready.remove(k)
+                ready.append(k)
+        return batches, ready
+
+    def put_partition(self, partition: int, batches, ready) -> None:
+        """Adopt a partition's in-flight state (inverse of take_partition)."""
+        for k, b in batches.items():
+            self.store[k] = b
+            self.partitions[k] = partition
+        for k in ready:
+            if k in self.store and k not in self._ready:
+                self._ready.append(k)

@@ -141,3 +141,149 @@ class Checkpointer:
             # stream either -- the offsets simply stay uncommitted
             log.exception("stream checkpoint to %s failed; continuing", self.path)
             return False
+
+
+# ---------------------------------------------------------------------------
+# partition-scoped checkpoints (multi-instance streaming)
+#
+# The reference runs N `reporter-kafka` instances in one consumer group;
+# Kafka Streams scopes each state store to a topic partition and migrates it
+# (via changelog topics) when a rebalance moves the partition
+# (BatchingProcessor.java:19-22, README.md:169-173).  The equivalent here:
+# per-partition snapshot files in a directory every group member can reach
+# (shared disk / NFS / object-store mount).  On revoke the member snapshots
+# the partition's in-flight vehicle batches and drops them locally; on
+# assign the next owner loads the file.  Tile-slice (anonymiser) state stays
+# instance-local by design: segment observations already forwarded belong to
+# the instance that produced them, and tile filenames are uuid4-suffixed so
+# concurrent writers never collide — the same split the reference gets from
+# the separate `batched` topic.
+
+
+def snapshot_partition(pipeline, partition: int) -> dict:
+    """Extract (destructively) one partition's in-flight batcher state."""
+    batches, ready = pipeline.batcher.take_partition(partition)
+    return {
+        "version": VERSION,
+        "partition": partition,
+        "store": {k: _b64(b.pack()) for k, b in batches.items()},
+        "ready": ready,
+    }
+
+
+def restore_partition(pipeline, state: dict) -> int:
+    """Adopt a partition snapshot produced by snapshot_partition."""
+    if state.get("version") != VERSION:
+        raise ValueError("unsupported checkpoint version %r" % (state.get("version"),))
+    part = int(state["partition"])
+    batches = {k: Batch.unpack(_unb64(v)) for k, v in state.get("store", {}).items()}
+    pipeline.batcher.put_partition(part, batches, state.get("ready", []))
+    return len(batches)
+
+
+class PartitionCheckpointer:
+    """Directory of per-partition snapshot files (part-<n>.ckpt)."""
+
+    def __init__(self, pipeline, directory: str):
+        self.pipeline = pipeline
+        self.dir = directory
+        os.makedirs(directory, exist_ok=True)
+
+    def _path(self, partition: int) -> str:
+        return os.path.join(self.dir, "part-%05d.ckpt" % partition)
+
+    def save(self, partition: int) -> bool:
+        """Snapshot + drop the partition's local state.  Best-effort like
+        Checkpointer.save: a failed write logs and returns False (offsets
+        for the partition then stay uncommitted, so the records replay)."""
+        try:
+            state = snapshot_partition(self.pipeline, partition)
+            tmp = self._path(partition) + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(state, f, separators=(",", ":"))
+            os.replace(tmp, self._path(partition))
+            log.info("checkpointed partition %d (%d vehicles) to %s",
+                     partition, len(state["store"]), self._path(partition))
+            return True
+        except Exception:
+            log.exception("partition %d checkpoint failed; continuing", partition)
+            return False
+
+    def save_keep(self, partition: int) -> bool:
+        """Interval snapshot that KEEPS the local state (the partition is
+        still owned): snapshot_partition is destructive, so re-adopt."""
+        try:
+            state = snapshot_partition(self.pipeline, partition)
+            restore_partition(self.pipeline, state)
+            tmp = self._path(partition) + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(state, f, separators=(",", ":"))
+            os.replace(tmp, self._path(partition))
+            return True
+        except Exception:
+            log.exception("partition %d checkpoint failed; continuing", partition)
+            return False
+
+    def load(self, partition: int) -> int:
+        """Adopt the partition's snapshot if one exists.  Returns vehicles
+        restored."""
+        path = self._path(partition)
+        if not os.path.exists(path):
+            return 0
+        with open(path) as f:
+            state = json.load(f)
+        n = restore_partition(self.pipeline, state)
+        log.info("restored partition %d (%d vehicles) from %s", partition, n, path)
+        return n
+
+
+class PartitionedStreamRunner:
+    """Transport-agnostic consumer-group member: owns the rebalance
+    protocol around a StreamPipeline.  The Kafka loop (kafka_io) wires its
+    callbacks to a ConsumerRebalanceListener; the fake-broker test drives
+    them directly — same code path either way."""
+
+    def __init__(self, pipeline, ckpt_dir: str):
+        self.pipeline = pipeline
+        self.ckpt = PartitionCheckpointer(pipeline, ckpt_dir)
+        self.assigned: set = set()
+
+    def on_assigned(self, partitions) -> None:
+        for p in partitions:
+            if p not in self.assigned:
+                self.ckpt.load(p)
+                self.assigned.add(p)
+
+    def on_revoked(self, partitions) -> "list[int]":
+        """Flush pending micro-batches (their responses may trim in-flight
+        state), snapshot each revoked partition, drop it locally.  Returns
+        the partitions whose snapshot landed — the caller commits offsets
+        only for those."""
+        self.pipeline.batcher.flush_ready()
+        saved = []
+        for p in partitions:
+            if p in self.assigned:
+                if self.ckpt.save(p):
+                    saved.append(p)
+                self.assigned.discard(p)
+        return saved
+
+    def feed(self, raw: str, timestamp_ms: int, partition: int) -> None:
+        self.pipeline.feed(raw, timestamp_ms, partition=partition)
+
+    def tick(self, timestamp_ms: int) -> bool:
+        """Periodic housekeeping + interval snapshots of every owned
+        partition.  Returns True when all snapshots landed (commit gate)."""
+        self.pipeline.tick(timestamp_ms)
+        self.pipeline.batcher.flush_ready()
+        return all(self.ckpt.save_keep(p) for p in sorted(self.assigned))
+
+    def close(self, timestamp_ms: int) -> bool:
+        """Graceful shutdown: final snapshots BEFORE close's drain (the
+        drain force-reports leftover batches; vehicles still unreportable
+        belong to the next owner), then drain and flush tiles."""
+        self.pipeline.batcher.flush_ready()
+        ok = all(self.ckpt.save(p) for p in sorted(self.assigned))
+        self.assigned.clear()
+        self.pipeline.close(timestamp_ms)
+        return ok

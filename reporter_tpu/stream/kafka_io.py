@@ -87,6 +87,7 @@ def run_pipeline(
     on_tick: Optional[Callable[[int], None]] = None,
     on_close: Optional[Callable[[], bool]] = None,
     manual_commit: bool = False,
+    runner=None,
 ) -> None:
     """Consume a raw topic and drive the StreamPipeline until duration (or
     forever).
@@ -101,10 +102,22 @@ def run_pipeline(
     after ``pipeline.close`` the loop takes one last snapshot and commits
     only when it lands, so the committed offsets always correspond to the
     state on disk — including on graceful shutdown, where an interval-gated
-    ``on_tick`` may decline to snapshot."""
+    ``on_tick`` may decline to snapshot.
+
+    ``runner`` (checkpoint.PartitionedStreamRunner) turns on MULTI-INSTANCE
+    mode: the consumer subscribes with a rebalance listener, per-vehicle
+    state is scoped to the source partition, and when a rebalance revokes a
+    partition its in-flight state is checkpointed to the runner's shared
+    directory (and the partition's offsets committed) so the next owner
+    adopts it — no lost or duplicated segment observations across the move.
+    ``on_tick``/``on_close`` are ignored in this mode (the runner owns
+    snapshots); manual_commit is forced on."""
     kafka = _require_kafka()
+    if runner is not None:
+        manual_commit = True
+        on_tick = on_close = None
     consumer = kafka.KafkaConsumer(
-        topic,
+        *([] if runner is not None else [topic]),
         bootstrap_servers=bootstrap,
         group_id=group,
         value_deserializer=lambda b: b.decode("utf-8", "replace"),
@@ -114,6 +127,25 @@ def run_pipeline(
         # SIGTERM shutdown flag is noticed well inside docker's 10 s grace
         consumer_timeout_ms=int(min(tick_sec, 1.0) * 1000),
     )
+    if runner is not None:
+        class _Listener(kafka.ConsumerRebalanceListener):
+            def on_partitions_revoked(self, revoked):
+                saved = runner.on_revoked([tp.partition for tp in revoked])
+                offs = {}
+                for tp in revoked:
+                    if tp.partition not in saved:
+                        continue  # snapshot failed: let the records replay
+                    try:
+                        offs[tp] = kafka.OffsetAndMetadata(consumer.position(tp), "")
+                    except Exception:  # noqa: BLE001 - no position fetched yet
+                        pass
+                if offs:
+                    consumer.commit(offs)
+
+            def on_partitions_assigned(self, assigned):
+                runner.on_assigned([tp.partition for tp in assigned])
+
+        consumer.subscribe([topic], listener=_Listener())
     import signal
     import threading
 
@@ -142,7 +174,7 @@ def run_pipeline(
                 ts_ms = msg.timestamp if msg.timestamp and msg.timestamp > 0 else int(
                     time.time() * 1000
                 )
-                pipeline.feed(msg.value, ts_ms)
+                pipeline.feed(msg.value, ts_ms, partition=msg.partition)
                 if stop_requested or time.time() - last_tick >= tick_sec:
                     break
             if stop_requested:
@@ -150,12 +182,18 @@ def run_pipeline(
                 break
             now = time.time()
             if now - last_tick >= tick_sec:
-                pipeline.tick(int(now * 1000))
-                saved = on_tick(int(now * 1000)) if on_tick is not None else None
-                # commit only when a snapshot actually landed: on crash the
-                # consumer replays exactly from the restored state
-                if manual_commit and (on_tick is None or saved):
-                    consumer.commit()
+                if runner is not None:
+                    # runner.tick snapshots every owned partition; commit
+                    # only when all snapshots landed
+                    if runner.tick(int(now * 1000)) and manual_commit:
+                        consumer.commit()
+                else:
+                    pipeline.tick(int(now * 1000))
+                    saved = on_tick(int(now * 1000)) if on_tick is not None else None
+                    # commit only when a snapshot actually landed: on crash
+                    # the consumer replays exactly from the restored state
+                    if manual_commit and (on_tick is None or saved):
+                        consumer.commit()
                 last_tick = now
             if duration_sec is not None and now - start > duration_sec:
                 break
@@ -172,13 +210,22 @@ def run_pipeline(
         for sig, h in prev_handlers:
             signal.signal(sig, h)
         if graceful:
-            pipeline.close(int(time.time() * 1000))
-            # final snapshot AFTER close (close may flush tiles / mutate
-            # state), then commit only if it landed: persisted state and
-            # committed offsets stay in lockstep.  A crash commits nothing.
-            saved = on_close() if on_close is not None else None
-            if manual_commit and (on_close is None or saved):
-                consumer.commit()
+            if runner is not None:
+                # hand-off shutdown: snapshot owned partitions (the next
+                # owner adopts the in-flight vehicles — close() must NOT
+                # force-report them), flush this instance's tiles, commit
+                # only when every snapshot landed
+                if runner.close(int(time.time() * 1000)) and manual_commit:
+                    consumer.commit()
+            else:
+                pipeline.close(int(time.time() * 1000))
+                # final snapshot AFTER close (close may flush tiles / mutate
+                # state), then commit only if it landed: persisted state and
+                # committed offsets stay in lockstep.  A crash commits
+                # nothing.
+                saved = on_close() if on_close is not None else None
+                if manual_commit and (on_close is None or saved):
+                    consumer.commit()
         consumer.close()
 
 

@@ -41,9 +41,12 @@ class StreamPipeline:
         self.dropped = 0
         self.log_every = log_every
 
-    def feed(self, raw: str, timestamp_ms: int) -> None:
+    def feed(self, raw: str, timestamp_ms: int, partition: int = 0) -> None:
         """One raw probe record (swallow-and-log on parse failure,
-        KeyedFormattingProcessor.java:39-41)."""
+        KeyedFormattingProcessor.java:39-41).  ``partition`` is the source
+        topic partition the record arrived on — the unit of state hand-off
+        between consumer-group members (checkpoint.PartitionedStreamRunner);
+        transports without partitions leave it 0."""
         try:
             uuid, point = self.formatter.format(raw)
         except Exception as e:
@@ -53,7 +56,7 @@ class StreamPipeline:
         self.formatted += 1
         if self.formatted % self.log_every == 0:
             log.info("formatted %d messages", self.formatted)
-        self.batcher.process(uuid, point, timestamp_ms)
+        self.batcher.process(uuid, point, timestamp_ms, partition=partition)
         self.anonymiser.maybe_punctuate(timestamp_ms)
 
     def tick(self, timestamp_ms: int) -> None:

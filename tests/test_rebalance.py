@@ -1,0 +1,216 @@
+"""Multi-instance streaming: partition state hand-off across a rebalance.
+
+The reference scales streaming by running N `reporter-kafka` instances in
+one consumer group; Kafka Streams migrates each partition's state store with
+the partition (BatchingProcessor.java:19-22, README.md:169-173).  This
+framework's equivalent is partition-scoped checkpoints
+(stream/checkpoint.PartitionedStreamRunner).  The test here is the
+guarantee statement: two consumers rebalancing MID-STREAM — with vehicle
+windows in flight that span the hand-off — must produce exactly the segment
+observations of an uninterrupted single consumer: none lost, none
+duplicated.
+"""
+
+import os
+
+from reporter_tpu.stream.anonymiser import AnonymisingProcessor
+from reporter_tpu.stream.batcher import BatchingProcessor
+from reporter_tpu.stream.checkpoint import PartitionedStreamRunner
+from reporter_tpu.stream.formatter import Formatter
+from reporter_tpu.stream.topology import StreamPipeline
+
+N_VEHICLES = 6
+N_PARTS = 2
+T0 = 1_460_000_000
+
+
+class SpanClient:
+    """Fake matcher: reports one segment pair per request spanning the
+    trace's first..last time, with ids derived from the uuid so
+    observations are attributable.  shape_used = n-1 keeps a rolling tail
+    in flight (the reference's incremental-matching contract)."""
+
+    def report_many(self, requests):
+        out = []
+        for r in requests:
+            n = len(r["trace"])
+            vid = int(r["uuid"].rsplit("-", 1)[1])
+            out.append({
+                "shape_used": n - 1,
+                "datastore": {"reports": [{
+                    "id": 8 * (vid + 1),
+                    "next_id": 8 * (vid + 1) + 8,
+                    "t0": r["trace"][0]["time"],
+                    "t1": r["trace"][-1]["time"],
+                    "length": 100 + vid,
+                    "queue_length": 0,
+                }]},
+            })
+        return out
+
+
+def make_instance(tmp_path, name):
+    out = tmp_path / name
+    out.mkdir(exist_ok=True)
+    anon = AnonymisingProcessor(
+        privacy=1, quantisation=3600, output=str(out), source="RB",
+        flush_interval_sec=10**9,
+    )
+    batcher = BatchingProcessor(
+        client=SpanClient(), sink=anon.process, microbatch_size=1,
+    )
+    fmt = Formatter.from_config(",sv,\\|,0,2,3,1,4")
+    return StreamPipeline(fmt, batcher, anon), out
+
+
+def records():
+    """16 points per vehicle, ~111 m apart, 10 s apart: the 500 m/10 pt/60 s
+    report gate crosses at point 10 — INSIDE phase 2, after the rebalance —
+    so a correct report needs state fed to two different owners."""
+    msgs = []  # (global_order, partition, raw)
+    for t in range(16):
+        for v in range(N_VEHICLES):
+            raw = "veh-%d|%d|%0.6f|%0.6f|5" % (v, T0 + t * 10, 37.75, -122.44 + t * 1e-3)
+            msgs.append((t, v % N_PARTS, raw))
+    return msgs
+
+
+def feed(target, msgs, ts_scale=1000):
+    for t, part, raw in msgs:
+        target.feed(raw, (T0 + t * 10) * ts_scale, partition=part)
+
+
+def drain(pipeline):
+    """Session-gap eviction (relaxed final reports) + tile flush — the
+    stream's natural end-of-test drain, identical for every instance."""
+    end_ms = (T0 + 16 * 10 + 3600) * 1000
+    pipeline.tick(end_ms)
+    pipeline.anonymiser.punctuate()
+
+
+def tile_rows(*dirs):
+    rows = []
+    for d in dirs:
+        for root, _, files in os.walk(d):
+            for f in files:
+                with open(os.path.join(root, f)) as fh:
+                    body = fh.read().strip().splitlines()
+                # header + data rows; key rows by tile path so identical
+                # rows in different tiles stay distinct
+                tile = os.path.relpath(root, d)
+                rows.extend((tile, ln) for ln in body[1:])
+    return sorted(rows)
+
+
+def test_rebalance_no_lost_or_duplicated_observations(tmp_path):
+    msgs = records()
+    phase1 = [m for m in msgs if m[0] < 8]
+    phase2 = [m for m in msgs if m[0] >= 8]
+
+    # ---- oracle: one uninterrupted consumer owning both partitions ------
+    single, out_single = make_instance(tmp_path, "single")
+    feed(single, phase1)
+    feed(single, phase2)
+    drain(single)
+    want = tile_rows(out_single)
+    assert want, "oracle run produced no observations"
+
+    # ---- two instances, rebalance mid-stream ----------------------------
+    ckpt_dir = str(tmp_path / "ckpt")
+    pa, out_a = make_instance(tmp_path, "a")
+    pb, out_b = make_instance(tmp_path, "b")
+    ra = PartitionedStreamRunner(pa, ckpt_dir)
+    rb = PartitionedStreamRunner(pb, ckpt_dir)
+
+    # instance A starts as the whole group
+    ra.on_assigned([0, 1])
+    for t, part, raw in phase1:
+        ra.feed(raw, (T0 + t * 10) * 1000, part)
+    assert pa.batcher.store, "phase 1 must leave vehicle windows in flight"
+
+    # rebalance: B joins, partition 1 moves A -> B (Kafka order: revoke
+    # first, then assign)
+    saved = ra.on_revoked([1])
+    assert saved == [1]
+    rb.on_assigned([1])
+    assert pb.batcher.store, "B must adopt partition 1's in-flight windows"
+    assert all(p == 1 for p in pb.batcher.partitions.values())
+    assert all(p == 0 for p in pa.batcher.partitions.values())
+
+    # phase 2 routed by ownership
+    for t, part, raw in phase2:
+        (ra if part == 0 else rb).feed(raw, (T0 + t * 10) * 1000, part)
+
+    drain(pa)
+    drain(pb)
+    got = tile_rows(out_a, out_b)
+
+    assert got == want, (
+        "observations diverged across the rebalance:\nwant %d rows, got %d"
+        % (len(want), len(got))
+    )
+
+
+def test_rebalance_handoff_preserves_window_start(tmp_path):
+    """The first report after the move must span points fed BEFORE the
+    rebalance (its t0 predates the hand-off) — proof the in-flight window
+    itself moved, not just the offsets."""
+    msgs = records()
+    phase1 = [m for m in msgs if m[0] < 8]
+    phase2 = [m for m in msgs if m[0] >= 8]
+
+    ckpt_dir = str(tmp_path / "ckpt2")
+    pa, _ = make_instance(tmp_path, "a2")
+    pb, out_b = make_instance(tmp_path, "b2")
+    ra = PartitionedStreamRunner(pa, ckpt_dir)
+    rb = PartitionedStreamRunner(pb, ckpt_dir)
+
+    ra.on_assigned([0, 1])
+    for t, part, raw in phase1:
+        ra.feed(raw, (T0 + t * 10) * 1000, part)
+    ra.on_revoked([1])
+    rb.on_assigned([1])
+    for t, part, raw in phase2:
+        (ra if part == 0 else rb).feed(raw, (T0 + t * 10) * 1000, part)
+    drain(pb)
+
+    rows = tile_rows(out_b)
+    assert rows, "B produced no observations"
+    # segment CSV rows carry the window start epoch; at least one must
+    # predate the first phase-2 timestamp
+    first_phase2 = T0 + 8 * 10
+    starts = [int(float(ln.split(",")[2])) for _, ln in rows]
+    assert min(starts) < first_phase2, (starts, first_phase2)
+
+
+def test_graceful_close_hands_off_instead_of_reporting(tmp_path):
+    """runner.close must snapshot in-flight windows for the next owner, not
+    force-report them: a restarted instance adopting the checkpoint and
+    finishing the stream must equal the uninterrupted run."""
+    msgs = records()
+    phase1 = [m for m in msgs if m[0] < 8]
+    phase2 = [m for m in msgs if m[0] >= 8]
+
+    single, out_single = make_instance(tmp_path, "single3")
+    feed(single, phase1)
+    feed(single, phase2)
+    drain(single)
+    want = tile_rows(out_single)
+
+    ckpt_dir = str(tmp_path / "ckpt3")
+    p1, out_1 = make_instance(tmp_path, "gen1")
+    r1 = PartitionedStreamRunner(p1, ckpt_dir)
+    r1.on_assigned([0, 1])
+    for t, part, raw in phase1:
+        r1.feed(raw, (T0 + t * 10) * 1000, part)
+    assert r1.close((T0 + 80) * 1000)  # graceful shutdown mid-stream
+
+    p2, out_2 = make_instance(tmp_path, "gen2")
+    r2 = PartitionedStreamRunner(p2, ckpt_dir)
+    r2.on_assigned([0, 1])  # restarted instance adopts everything
+    for t, part, raw in phase2:
+        r2.feed(raw, (T0 + t * 10) * 1000, part)
+    drain(p2)
+
+    got = tile_rows(out_1, out_2)
+    assert got == want
