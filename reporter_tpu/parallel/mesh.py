@@ -115,12 +115,16 @@ def match_and_histogram(
         dd.reshape(-1), step_seg.reshape(-1), num_segments=num_segments + 1
     )[:num_segments]
 
-    # trace-touch counts: 1 per (trace, segment) pair -- approximate with the
-    # "first point on segment" indicator (segment change or trace start)
-    first_touch = (seg >= 0) & jnp.concatenate(
-        [jnp.ones((B, 1), bool), seg[:, 1:] != seg[:, :-1]], axis=1
+    # trace-touch counts: EXACTLY 1 per (trace, segment) pair (VERDICT r03
+    # weak #7: the old "first point on segment" indicator re-counted
+    # re-entries).  Sort each row's segment ids and keep first occurrences:
+    # a [B, T] sort + compare, no [T, T] blowup, and exact regardless of how
+    # often a trace leaves and re-enters a segment.
+    sorted_seg = jnp.sort(flat_seg, axis=1)  # [B, T], overflow bin sorts last
+    first_touch = jnp.concatenate(
+        [jnp.ones((B, 1), bool), sorted_seg[:, 1:] != sorted_seg[:, :-1]], axis=1
     )
-    touch_seg = jnp.where(first_touch, seg, num_segments)
+    touch_seg = jnp.where(first_touch, sorted_seg, num_segments)
     trace_count = jax.ops.segment_sum(
         jnp.ones_like(touch_seg, jnp.float32).reshape(-1),
         touch_seg.reshape(-1),
