@@ -30,8 +30,9 @@ Three roles in one file (BENCH_ROLE env):
       BASELINE.json config 1) run for >= BENCH_BASELINE_SECS (default 60,
       VERDICT r02 weak #3) on the same scenario.
 
-Scenario: metro-scale synthetic city -- >=50k edges, UBODT in the tens of
-millions of rows (native builder, full delta), mixed 64/256/1024-pt cohorts;
+Scenario: metro-scale realistic city (OSM ingestion path; ~50k edges) --
+UBODT in the millions of rows (native builder, full delta), mixed
+64/256/1024-pt cohorts;
 the 1024-pt cohort exceeds the largest length bucket and exercises
 carried-state streaming.
 
@@ -70,24 +71,40 @@ def _relay_ports_open():
 
 def build_scenario():
     """Metro-scale city + UBODT + mixed trace cohorts.  numpy + native C++
-    only -- safe to run while the jax backend is still initialising."""
+    only -- safe to run while the jax backend is still initialising.
+
+    BENCH_SCENARIO=osm (default): realistic OSM-extract city ingested
+    through the PBF codec path (synth/osm_city.py — jittered curved grid,
+    road-class hierarchy, one-ways, river + sparse bridges, orbital
+    motorway with internal ramps), so candidates and UBODT see real-map
+    topology rather than a uniform lattice (VERDICT r03 next #7).
+    BENCH_SCENARIO=grid keeps the round-3 uniform lattice for comparison."""
     from reporter_tpu.synth import TraceSynthesizer
     from reporter_tpu.tiles.arrays import build_graph_arrays
     from reporter_tpu.tiles.network import grid_city
     from reporter_tpu.tiles.ubodt import build_ubodt
 
+    scenario = os.environ.get("BENCH_SCENARIO", "osm")
     rows = cols = int(os.environ.get("BENCH_GRID", "120"))
     delta = float(os.environ.get("BENCH_DELTA", "3000"))
     t0 = time.time()
-    city = grid_city(rows=rows, cols=cols, spacing_m=150.0)
+    if scenario == "osm":
+        from reporter_tpu.synth.osm_city import realistic_city_network
+
+        city = realistic_city_network(rows, cols, spacing_m=150.0, seed=3)
+    else:
+        city = grid_city(rows=rows, cols=cols, spacing_m=150.0)
     arrays = build_graph_arrays(city, cell_size=100.0)
     t_graph = time.time() - t0
     t0 = time.time()
     ubodt = build_ubodt(arrays, delta=delta)
     _stderr(
-        "graph %d nodes / %d edges (%.1fs); ubodt %d rows, table %.0f MB (%.1fs native build)"
-        % (arrays.num_nodes, arrays.num_edges, t_graph, ubodt.num_rows,
-           ubodt.packed.nbytes / 1e6, time.time() - t0)
+        "scenario %s: graph %d nodes / %d edges (%.1fs); ubodt %d rows, "
+        "table %.0f MB, load %.2f, max kick chain %d (%.1fs native build)"
+        % (scenario, arrays.num_nodes, arrays.num_edges, t_graph,
+           ubodt.num_rows, ubodt.packed.nbytes / 1e6,
+           ubodt.num_rows / max(ubodt.packed.shape[0] * 2, 1), ubodt.max_kicks,
+           time.time() - t0)
     )
 
     n_short = int(os.environ.get("BENCH_TRACES", "192"))
@@ -106,7 +123,7 @@ def build_scenario():
         "synthesized %d traces (%d pts, %.1fs)"
         % (sum(len(s) for _, _, s in cohorts), n_pts, time.time() - t0)
     )
-    return arrays, ubodt, cohorts
+    return scenario, arrays, ubodt, cohorts
 
 
 def _cohort_xy(arrays, straces, T):
@@ -170,7 +187,7 @@ def run_device() -> int:
     init_thread.start()
 
     # scenario build overlaps the grant wait (numpy + native only)
-    arrays, ubodt, cohorts = build_scenario()
+    scenario, arrays, ubodt, cohorts = build_scenario()
     _write_status(phase="built", platform=acquired.get("platform"))
 
     while init_thread.is_alive() and time.time() - t_start < wait_s:
@@ -406,8 +423,12 @@ def run_device() -> int:
         "agreement": round(agr_mean, 4),
         "agreement_by_cohort": agreement,
         "device_mb": round(hbm_mb, 1),
+        "scenario": scenario,
         "edges": int(arrays.num_edges),
         "ubodt_rows": int(ubodt.num_rows),
+        "ubodt_load": round(ubodt.num_rows / max(ubodt.packed.shape[0] * 2, 1), 3),
+        "ubodt_max_probes": ubodt.max_probes,
+        "ubodt_max_kicks": int(ubodt.max_kicks),
     }))
     return 0
 
@@ -420,7 +441,7 @@ def run_baseline() -> int:
     from reporter_tpu.utils.jaxenv import ensure_platform
 
     ensure_platform()
-    arrays, ubodt, cohorts = build_scenario()
+    scenario, arrays, ubodt, cohorts = build_scenario()
 
     from reporter_tpu.matching import MatcherConfig, SegmentMatcher
 
@@ -651,7 +672,8 @@ def main() -> int:
     for k in ("platform", "acquire_s", "points_per_sec", "p50_latency_ms", "p95_latency_ms",
               "latency_cohort", "forward", "forward_by_cohort", "kernel_traces_per_sec", "kernel_by_cohort",
               "device_util", "pallas", "agreement", "agreement_by_cohort", "device_mb",
-              "edges", "ubodt_rows"):
+              "scenario", "edges", "ubodt_rows", "ubodt_load", "ubodt_max_probes",
+              "ubodt_max_kicks"):
         if k in device_json:
             out[k] = device_json[k]
     out.update({k: baseline_json[k] for k in

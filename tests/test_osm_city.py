@@ -1,0 +1,102 @@
+"""Realistic-city OSM extract generator (synth/osm_city.py).
+
+The bench's "real map" substitute (no egress): must be deterministic, must
+round-trip the actual PBF ingestion path, and must show the structural
+properties that distinguish it from the uniform grid — road-class mix,
+one-ways, internal ramps, curved multi-segment edges, and a river that
+forces route distances far above straight-line distance.
+"""
+
+import numpy as np
+import pytest
+
+from reporter_tpu.synth.osm_city import realistic_city, realistic_city_network
+from reporter_tpu.tiles.arrays import build_graph_arrays
+from reporter_tpu.tiles.ubodt import build_ubodt
+
+ROWS = COLS = 24
+
+
+@pytest.fixture(scope="module")
+def net():
+    return realistic_city_network(ROWS, COLS, seed=3)
+
+
+@pytest.fixture(scope="module")
+def arrays(net):
+    return build_graph_arrays(net, cell_size=100.0)
+
+
+def test_deterministic():
+    n1, w1 = realistic_city(10, 10, seed=5)
+    n2, w2 = realistic_city(10, 10, seed=5)
+    assert n1 == n2
+    assert [(w.id, w.refs, w.tags) for w in w1] == [(w.id, w.refs, w.tags) for w in w2]
+
+
+def test_structural_mix(arrays):
+    levels = np.bincount(arrays.edge_level, minlength=3)
+    assert levels[0] > 0 and levels[1] > 0 and levels[2] > 0
+    assert levels[2] > levels[1] > 0  # locals dominate
+    assert arrays.edge_internal.sum() >= 8  # motorway_link ramps
+    # one-ways: some directed edges without a reverse twin
+    pairs = set(zip(arrays.edge_from.tolist(), arrays.edge_to.tolist()))
+    assert sum(1 for a, b in pairs if (b, a) not in pairs) > 50
+    # curved streets: some edges carry more than one shape segment
+    seg_per_edge = np.bincount(arrays.shp_edge, minlength=arrays.num_edges)
+    assert (seg_per_edge > 1).sum() > 20
+    # speed diversity
+    assert len(np.unique(arrays.edge_speed)) >= 4
+
+
+def test_river_forces_detours(net, arrays):
+    """Straight-line neighbours across the river must route the long way
+    round (or not at all within delta) — the regime where the HMM's
+    |route - gc| transition discriminates."""
+    ubodt = build_ubodt(arrays, delta=4000.0)
+    node_y = arrays.node_y
+    node_x = arrays.node_x
+    # node pairs straddling the river mid-band, horizontally close
+    mid = node_y.min() + (node_y.max() - node_y.min()) * 0.52
+    detours = 0
+    checked = 0
+    for i in range(arrays.num_nodes):
+        if not (mid - 380 < node_y[i] < mid - 120):
+            continue
+        for j in range(arrays.num_nodes):
+            if not (mid + 120 < node_y[j] < mid + 380):
+                continue
+            if abs(node_x[i] - node_x[j]) > 250:
+                continue
+            gc = float(np.hypot(node_x[i] - node_x[j], node_y[i] - node_y[j]))
+            d, _, _ = ubodt.lookup_full(i, j)
+            checked += 1
+            if d > 2.0 * gc:  # unreachable (inf) also counts as a detour
+                detours += 1
+    assert checked >= 5, "no river-straddling pairs sampled"
+    assert detours / checked > 0.5, (detours, checked)
+
+
+def test_largest_component_dominates(net, arrays):
+    """Dead-end pruning and the river must not shatter the graph: the bulk
+    of nodes stay mutually routable (traces synthesized on the city need
+    somewhere to drive)."""
+    n = arrays.num_nodes
+    seen = np.zeros(n, bool)
+    comp_best = 0
+    for s in range(n):
+        if seen[s]:
+            continue
+        stack = [s]
+        seen[s] = True
+        size = 0
+        while stack:
+            u = stack.pop()
+            size += 1
+            for k in range(arrays.out_start[u], arrays.out_start[u + 1]):
+                v = int(arrays.edge_to[arrays.out_edges[k]])
+                if not seen[v]:
+                    seen[v] = True
+                    stack.append(v)
+        comp_best = max(comp_best, size)
+    assert comp_best > 0.85 * n, (comp_best, n)
