@@ -293,8 +293,25 @@ def run_device() -> int:
         return fn, (dg, du, jnp.asarray(px), jnp.asarray(py), jnp.asarray(tm),
                     jnp.asarray(valid), params)
 
+    # HBM-traffic model for the roofline (VERDICT r03 weak #5): the two
+    # dominant gather streams per trace are the UBODT transition probes
+    # (2 bucket rows of BUCKET*ROW_W int32 per [T-1, K, K] entry) and the
+    # candidate search (grid items + interleaved shape fields per point).
+    from reporter_tpu.tiles.ubodt import BUCKET, ROW_W
+
+    grid_cap = int(arrays.grid_items.shape[1])
+    hbm_peak = float(os.environ.get("BENCH_HBM_GBS", "819")) * 1e9  # v5e
+
+    def _bytes_per_trace(T: int) -> int:
+        k = cfg.beam_k
+        ubodt_b = (T - 1) * k * k * 2 * (BUCKET * ROW_W * 4)
+        cand_b = T * 9 * grid_cap * (4 + 6 * 4)  # item ids + 6 f32 fields
+        return ubodt_b + cand_b
+
     kernel_secs = 0.0
     kernel_by_cohort = {}
+    kernel_secs_by_cohort = {}
+    roofline = {}
     cohort_xy = {}
     for name, T, ss in cohorts:
         px, py, tm, valid = _cohort_xy(arrays, ss, T)
@@ -310,6 +327,12 @@ def run_device() -> int:
         dt = (time.time() - t0) / reps
         kernel_secs += dt
         kernel_by_cohort[name] = len(ss) / dt
+        kernel_secs_by_cohort[name] = round(dt, 4)
+        gbs = _bytes_per_trace(T) * len(ss) / dt / 1e9
+        roofline[name] = {
+            "est_gather_gb_per_s": round(gbs, 2),
+            "hbm_frac": round(gbs * 1e9 / hbm_peak, 4),
+        }
     # long cohort: W-window carry chunks, exactly like _match_long
     from reporter_tpu.ops.viterbi import initial_carry_batch
 
@@ -342,6 +365,33 @@ def run_device() -> int:
     dt = (time.time() - t0) / reps
     kernel_secs += dt
     kernel_by_cohort["long"] = len(ss) / dt
+    kernel_secs_by_cohort["long"] = round(dt, 4)
+    gbs = _bytes_per_trace(T) * len(ss) / dt / 1e9
+    roofline["long"] = {
+        "est_gather_gb_per_s": round(gbs, 2),
+        "hbm_frac": round(gbs * 1e9 / hbm_peak, 4),
+    }
+
+    # profiler trace artifact (TPU only; BENCH_PROFILE=0 disables): one
+    # kernel rep per cohort under jax.profiler so a roofline argument can
+    # be checked against the real timeline, not just the byte model
+    profile_dir = None
+    if platform == "tpu" and os.environ.get("BENCH_PROFILE", "1") != "0":
+        try:
+            import jax.profiler as _prof
+
+            profile_dir = os.path.abspath(
+                os.environ.get("BENCH_PROFILE_DIR", "bench_profile"))
+            with _prof.trace(profile_dir):
+                for name in ("short", "med"):
+                    px, py, tm, valid = cohort_xy[name]
+                    fn, args = _compact_args(px, py, tm, valid)
+                    jax.block_until_ready(fn(*args, cfg.beam_k))
+                jax.block_until_ready(_long_pass().edge)
+            _stderr("profiler trace written to %s" % profile_dir)
+        except Exception as e:  # noqa: BLE001 - diagnostics must not sink the bench
+            _stderr("profiler trace failed: %s" % (e,))
+            profile_dir = None
 
     kernel_tps = n_traces / kernel_secs
     device_util = min(1.0, kernel_secs / (e2e_wall / reps))
@@ -418,6 +468,9 @@ def run_device() -> int:
         "forward_by_cohort": forward_by_cohort,
         "kernel_traces_per_sec": round(kernel_tps, 1),
         "kernel_by_cohort": {k: round(v, 1) for k, v in kernel_by_cohort.items()},
+        "kernel_secs_by_cohort": kernel_secs_by_cohort,
+        "roofline": roofline,
+        "profile_dir": profile_dir,
         "device_util": round(device_util, 3),
         "pallas": pallas_info,
         "agreement": round(agr_mean, 4),
@@ -671,6 +724,7 @@ def main() -> int:
     }
     for k in ("platform", "acquire_s", "points_per_sec", "p50_latency_ms", "p95_latency_ms",
               "latency_cohort", "forward", "forward_by_cohort", "kernel_traces_per_sec", "kernel_by_cohort",
+              "kernel_secs_by_cohort", "roofline", "profile_dir",
               "device_util", "pallas", "agreement", "agreement_by_cohort", "device_mb",
               "scenario", "edges", "ubodt_rows", "ubodt_load", "ubodt_max_probes",
               "ubodt_max_kicks"):
