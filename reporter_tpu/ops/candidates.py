@@ -50,10 +50,12 @@ def find_candidates(dg: DeviceGraph, px, py, k: int, search_radius: float) -> Ca
     valid = items >= 0
     safe = jnp.where(valid, items, 0)
 
-    ax = dg.shp_ax[safe]
-    ay = dg.shp_ay[safe]
-    bx = dg.shp_bx[safe]
-    by = dg.shp_by[safe]
+    # one interleaved 32-byte row-gather per item (ax, ay, bx, by, off,
+    # len, edge-bits) instead of six scalar gathers into six arrays
+    rows = dg.shp_packed[safe]  # [9*cap, 8]
+    ax, ay, bx, by = rows[:, 0], rows[:, 1], rows[:, 2], rows[:, 3]
+    off0, slen = rows[:, 4], rows[:, 5]
+    edge_of = jax.lax.bitcast_convert_type(rows[:, 6], jnp.int32)
 
     dx = bx - ax
     dy = by - ay
@@ -72,9 +74,10 @@ def find_candidates(dg: DeviceGraph, px, py, k: int, search_radius: float) -> Ca
     # edge without losing the edges behind them.
     m = min(4 * k, d.shape[0])
     _, pool_idx = jax.lax.top_k(-d, m)  # ascending distance order
-    pool_items = safe[pool_idx]
     pool_d = d[pool_idx]
-    pool_edge = jnp.where(jnp.isfinite(pool_d), dg.shp_edge[pool_items], -1)
+    # edge ids come from the already-gathered rows (a local [9*cap] array),
+    # not another HBM gather
+    pool_edge = jnp.where(jnp.isfinite(pool_d), edge_of[pool_idx], -1)
 
     # keep only the nearest (earliest) slot of each edge
     same = (pool_edge[None, :] == pool_edge[:, None]) & (pool_edge[None, :] >= 0)
@@ -84,11 +87,9 @@ def find_candidates(dg: DeviceGraph, px, py, k: int, search_radius: float) -> Ca
 
     _, sel = jax.lax.top_k(-pool_d, k)
     top_idx = pool_idx[sel]
-    top_items = safe[top_idx]
     top_d = pool_d[sel]
-    top_edge = jnp.where(jnp.isfinite(top_d), dg.shp_edge[top_items], -1)
-    seg_len = jnp.sqrt(len2[top_idx])
-    top_off = dg.shp_off[top_items] + t[top_idx] * seg_len
+    top_edge = jnp.where(jnp.isfinite(top_d), edge_of[top_idx], -1)
+    top_off = off0[top_idx] + t[top_idx] * slen[top_idx]
     top_qx = qx[top_idx]
     top_qy = qy[top_idx]
 

@@ -46,12 +46,12 @@ class DeviceGraph(NamedTuple):
     edge_internal: "jnp.ndarray"
     edge_head0: "jnp.ndarray"  # heading (radians) at edge start
     edge_head1: "jnp.ndarray"  # heading (radians) at edge end
-    shp_ax: "jnp.ndarray"
-    shp_ay: "jnp.ndarray"
-    shp_bx: "jnp.ndarray"
-    shp_by: "jnp.ndarray"
-    shp_edge: "jnp.ndarray"
-    shp_off: "jnp.ndarray"
+    # interleaved shape-segment rows [n_items, 8] f32: ax, ay, bx, by,
+    # off, len, edge-id-bits (int32 bitcast), pad.  One 32-byte row-gather
+    # per candidate item instead of six scalar gathers into six arrays —
+    # same layout rationale as the UBODT's cuckoo buckets (the TPU memory
+    # system rewards contiguous windows, not scattered lanes).
+    shp_packed: "jnp.ndarray"
     grid_items: "jnp.ndarray"
     grid_origin: "jnp.ndarray"  # [x0, y0] f32
     grid_dims: "jnp.ndarray"  # [nx, ny] i32
@@ -111,6 +111,19 @@ class GraphArrays:
         cy = int(np.clip((y - self.grid_y0) // self.cell_size, 0, self.grid_ny - 1))
         return cx, cy
 
+    def _shp_packed(self) -> np.ndarray:
+        """Interleaved [n_items, 8] f32 shape rows (see DeviceGraph)."""
+        n = len(self.shp_ax)
+        packed = np.zeros((n, 8), np.float32)
+        packed[:, 0] = self.shp_ax
+        packed[:, 1] = self.shp_ay
+        packed[:, 2] = self.shp_bx
+        packed[:, 3] = self.shp_by
+        packed[:, 4] = self.shp_off
+        packed[:, 5] = self.shp_len
+        packed[:, 6] = np.asarray(self.shp_edge, np.int32).view(np.float32)
+        return packed
+
     def to_device(self) -> DeviceGraph:
         import jax.numpy as jnp
 
@@ -126,12 +139,7 @@ class GraphArrays:
             edge_internal=jnp.asarray(self.edge_internal, jnp.bool_),
             edge_head0=jnp.asarray(self.edge_head0, jnp.float32),
             edge_head1=jnp.asarray(self.edge_head1, jnp.float32),
-            shp_ax=jnp.asarray(self.shp_ax, jnp.float32),
-            shp_ay=jnp.asarray(self.shp_ay, jnp.float32),
-            shp_bx=jnp.asarray(self.shp_bx, jnp.float32),
-            shp_by=jnp.asarray(self.shp_by, jnp.float32),
-            shp_edge=jnp.asarray(self.shp_edge, jnp.int32),
-            shp_off=jnp.asarray(self.shp_off, jnp.float32),
+            shp_packed=jnp.asarray(self._shp_packed(), jnp.float32),
             grid_items=jnp.asarray(self.grid_items, jnp.int32),
             grid_origin=jnp.asarray([self.grid_x0, self.grid_y0], jnp.float32),
             grid_dims=jnp.asarray([self.grid_nx, self.grid_ny], jnp.int32),

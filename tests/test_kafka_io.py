@@ -26,10 +26,11 @@ from reporter_tpu.stream import kafka_io
 # ---------------------------------------------------------------------------
 
 class FakeMessage:
-    def __init__(self, key, value, timestamp):
+    def __init__(self, key, value, timestamp, partition=0):
         self.key = key
         self.value = value
         self.timestamp = timestamp
+        self.partition = partition
 
 
 class FakeBroker:
@@ -112,7 +113,7 @@ class ScriptedPipeline:
         self.closed = False
         self.fail_on_feed = fail_on_feed
 
-    def feed(self, value, ts_ms):
+    def feed(self, value, ts_ms, partition=0):
         if self.fail_on_feed is not None and len(self.fed) == self.fail_on_feed:
             raise ValueError("poisoned record")
         self.fed.append(value)
