@@ -245,7 +245,8 @@ def run_device() -> int:
 
     t0 = time.time()
     matcher.match_many(traces)
-    _stderr("warmup/compile %.1fs" % (time.time() - t0))
+    warmup_s = time.time() - t0
+    _stderr("warmup/compile %.1fs" % warmup_s)
 
     # end-to-end throughput (device viterbi + parallel host association)
     reps = int(os.environ.get("BENCH_REPS", "3"))
@@ -472,6 +473,7 @@ def run_device() -> int:
         "roofline": roofline,
         "profile_dir": profile_dir,
         "device_util": round(device_util, 3),
+        "warmup_s": round(warmup_s, 1),
         "pallas": pallas_info,
         "agreement": round(agr_mean, 4),
         "agreement_by_cohort": agreement,
@@ -725,7 +727,7 @@ def main() -> int:
     for k in ("platform", "acquire_s", "points_per_sec", "p50_latency_ms", "p95_latency_ms",
               "latency_cohort", "forward", "forward_by_cohort", "kernel_traces_per_sec", "kernel_by_cohort",
               "kernel_secs_by_cohort", "roofline", "profile_dir",
-              "device_util", "pallas", "agreement", "agreement_by_cohort", "device_mb",
+              "device_util", "warmup_s", "pallas", "agreement", "agreement_by_cohort", "device_mb",
               "scenario", "edges", "ubodt_rows", "ubodt_load", "ubodt_max_probes",
               "ubodt_max_kicks"):
         if k in device_json:

@@ -422,6 +422,41 @@ class SegmentMatcher:
             breaks = np.concatenate(brks, axis=1)
             self._associate_and_store(group, edge, offset, breaks, times, results)
 
+    def warmup(self, lengths: "Sequence[int] | None" = None) -> float:
+        """Pre-compile the hot dispatch shapes so the first real request
+        doesn't pay XLA compilation (the streaming operating point is a
+        single ~64-pt window per call; a cold compile there blows the
+        reference client's 10 s socket budget, HttpClient.java:80-88).
+        Warms one B=1 batch per length bucket by matching a dummy trace
+        along the graph's first edge.  With the persistent compilation
+        cache enabled (utils/jaxenv) a warm restart replays from disk.
+        Returns seconds spent."""
+        import time as _time
+
+        if self.backend != "jax":
+            return 0.0
+        t0 = _time.time()
+        if lengths is None:
+            lengths = list(self.cfg.length_buckets)
+        ax = float(self.arrays.node_x[self.arrays.edge_from[0]])
+        ay = float(self.arrays.node_y[self.arrays.edge_from[0]])
+        bx = float(self.arrays.node_x[self.arrays.edge_to[0]])
+        by = float(self.arrays.node_y[self.arrays.edge_to[0]])
+        for n in lengths:
+            n = max(2, int(n))
+            xs = np.linspace(ax, bx, n)
+            ys = np.linspace(ay, by, n)
+            lat, lon = self.arrays.proj.to_latlon(xs, ys)
+            self.match_many([{
+                "uuid": "_warmup",
+                "trace": [
+                    {"lat": float(a), "lon": float(o), "time": 1.0 + 5.0 * i}
+                    for i, (a, o) in enumerate(zip(lat, lon))
+                ],
+            }])
+        log.info("matcher warmup: %d shapes in %.1fs", len(lengths), _time.time() - t0)
+        return _time.time() - t0
+
     def match(self, trace: dict) -> dict:
         return self.match_many([trace])[0]
 

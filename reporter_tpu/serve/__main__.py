@@ -46,6 +46,10 @@ def main(argv):
     )
     httpd = service.make_server(host, int(port))
     logging.info("reporter_tpu service on %s:%s (backend=%s)", host, port, matcher.backend)
+    # pre-compile the hot shapes AFTER binding (clients queue in the accept
+    # backlog rather than getting refused); "warmup": false disables
+    if conf.get("warmup", True):
+        matcher.warmup()
     try:
         httpd.serve_forever()
     except KeyboardInterrupt:
