@@ -438,10 +438,7 @@ class SegmentMatcher:
         t0 = _time.time()
         if lengths is None:
             lengths = list(self.cfg.length_buckets)
-        ax = float(self.arrays.node_x[self.arrays.edge_from[0]])
-        ay = float(self.arrays.node_y[self.arrays.edge_from[0]])
-        bx = float(self.arrays.node_x[self.arrays.edge_to[0]])
-        by = float(self.arrays.node_y[self.arrays.edge_to[0]])
+        ax, ay, bx, by = self._probe_edge_coords()
         for n in lengths:
             n = max(2, int(n))
             xs = np.linspace(ax, bx, n)
@@ -457,6 +454,16 @@ class SegmentMatcher:
         self._autotune_forward()
         log.info("matcher warmup: %d shapes in %.1fs", len(lengths), _time.time() - t0)
         return _time.time() - t0
+
+    def _probe_edge_coords(self):
+        """Endpoints of the graph's first edge — the dummy-trace span shared
+        by warmup and the forward autotune (keep the two probes identical)."""
+        return (
+            float(self.arrays.node_x[self.arrays.edge_from[0]]),
+            float(self.arrays.node_y[self.arrays.edge_from[0]]),
+            float(self.arrays.node_x[self.arrays.edge_to[0]]),
+            float(self.arrays.node_y[self.arrays.edge_to[0]]),
+        )
 
     def _autotune_forward(self, reps: int = 3) -> None:
         """Measure scan vs pallas on one full [128, 64] block and DROP the
@@ -477,10 +484,7 @@ class SegmentMatcher:
         # one full pallas block at the streaming window length (the shape
         # the gate actually decides for)
         B, T = 128, 64
-        ax = float(self.arrays.node_x[self.arrays.edge_from[0]])
-        ay = float(self.arrays.node_y[self.arrays.edge_from[0]])
-        bx = float(self.arrays.node_x[self.arrays.edge_to[0]])
-        by = float(self.arrays.node_y[self.arrays.edge_to[0]])
+        ax, ay, bx, by = self._probe_edge_coords()
         px = np.tile(np.linspace(ax, bx, T, dtype=np.float32), (B, 1))
         py = np.tile(np.linspace(ay, by, T, dtype=np.float32), (B, 1))
         tm = np.tile(np.arange(T, dtype=np.float32) * 5.0, (B, 1))
