@@ -148,3 +148,33 @@ def test_s3_prefix_split():
     from reporter_tpu.anonymise import make_store
     s = make_store("s3://mybucket/tiles/v1")
     assert s.bucket == "mybucket" and s.prefix == "tiles/v1"
+
+
+def test_datastore_stub_receives_http_tiles(tmp_path):
+    """End-to-end egress check: HttpStore -> tools/datastore_stub -> files
+    on disk keyed by tile path (the echo server the reference TODO'd,
+    tests/circle.sh:13-16)."""
+    import sys
+    import threading
+
+    sys.path.insert(0, "tools")
+    try:
+        from datastore_stub import make_server
+    finally:
+        sys.path.pop(0)
+
+    from reporter_tpu.anonymise.storage import HttpStore
+
+    root = tmp_path / "ds"
+    srv = make_server(str(root), host="127.0.0.1", port=0)
+    port = srv.server_address[1]
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    try:
+        store = HttpStore("http://127.0.0.1:%d/tiles" % port)
+        store.put("1459998000_1460001599/1/45777/SRC.abc", "h,e,a,d\n1,2,3,4\n")
+        got = root / "tiles" / "1459998000_1460001599" / "1" / "45777" / "SRC.abc"
+        assert got.exists() and got.read_bytes().startswith(b"h,e,a,d")
+    finally:
+        srv.shutdown()
+        srv.server_close()

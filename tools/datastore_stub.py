@@ -1,0 +1,75 @@
+"""Datastore stub: the echo server the reference never built.
+
+The reference fakes its datastore by not running one (tests/circle.sh:13-16
+"TODO replace with a little echo server"; TODO_DATASTORE_URL in
+docker-compose.yml).  This is that server: accepts the anonymiser's tile
+uploads (HTTP POST from anonymise/storage.HttpStore, or S3-style PUT from
+the AWS path), writes each body under a results directory keyed by the
+request path, and answers 200 — so a full docker-compose / rehearsal run
+can assert exactly which tiles a datastore would have received.
+
+    python tools/datastore_stub.py /tmp/datastore 8003
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+log = logging.getLogger("datastore_stub")
+
+
+def make_server(root: str, host: str = "0.0.0.0", port: int = 8003):
+    os.makedirs(root, exist_ok=True)
+
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def _store(self):
+            n = int(self.headers.get("Content-Length", 0))
+            body = self.rfile.read(n)
+            rel = self.path.lstrip("/").replace("..", "_") or "unnamed"
+            dest = os.path.join(root, rel)
+            os.makedirs(os.path.dirname(dest) or root, exist_ok=True)
+            with open(dest, "wb") as f:
+                f.write(body)
+            log.info("%s %s (%d bytes)", self.command, rel, n)
+            self.send_response(200)
+            self.send_header("Content-Length", "2")
+            self.end_headers()
+            self.wfile.write(b"ok")
+
+        do_POST = _store
+        do_PUT = _store
+
+        def do_GET(self):  # liveness probe
+            self.send_response(200)
+            self.send_header("Content-Length", "2")
+            self.end_headers()
+            self.wfile.write(b"up")
+
+        def log_message(self, fmt, *args):
+            log.debug("http: " + fmt, *args)
+
+    return ThreadingHTTPServer((host, port), Handler)
+
+
+def main(argv=None) -> int:
+    logging.basicConfig(level=logging.INFO,
+                        format="%(asctime)s %(name)s %(message)s")
+    argv = argv if argv is not None else sys.argv[1:]
+    root = argv[0] if argv else "datastore_out"
+    port = int(argv[1]) if len(argv) > 1 else 8003
+    srv = make_server(root, port=port)
+    log.info("datastore stub on :%d -> %s", port, os.path.abspath(root))
+    try:
+        srv.serve_forever()
+    except KeyboardInterrupt:
+        srv.server_close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
