@@ -141,3 +141,38 @@ def test_device_lookup_matches_host(arrays, ubodt):
     h2_host = np.array([int(pair_hash2(np.int64(s), np.int64(t), mask)) for s, t in zip(src, dst)])
     h2_dev = np.asarray(device_pair_hash2(jnp.asarray(src), jnp.asarray(dst), mask))
     np.testing.assert_array_equal(h2_host, h2_dev)
+
+
+def test_cuckoo_pack_high_load_bit_identical():
+    """Displacement-heavy regime: unique random keys packed at ~0.8 load
+    must still resolve every lookup, and the C++/Python packers must stay
+    bit-identical through the eviction walks."""
+    from reporter_tpu.native import get_lib
+    from reporter_tpu.tiles.ubodt import ubodt_from_columns
+
+    rng = np.random.default_rng(42)
+    n = 26000
+    keys = rng.choice(10_000_000, size=(n, 2), replace=False)
+    src = keys[:, 0].astype(np.int32)
+    dst = keys[:, 1].astype(np.int32)
+    dist = rng.random(n).astype(np.float32) * 1000
+    tm = rng.random(n).astype(np.float32) * 100
+    fe = rng.integers(0, 1 << 20, n).astype(np.int32)
+
+    u_py = ubodt_from_columns(src, dst, dist, tm, fe, delta=1000.0,
+                              load_factor=0.8, use_native=False)
+    assert u_py.num_rows == n
+    # every key resolves to its row
+    for i in range(0, n, 997):
+        d, t, f = u_py.lookup_full(int(src[i]), int(dst[i]))
+        assert d == pytest.approx(float(dist[i]), rel=1e-6)
+        assert f == int(fe[i])
+    assert u_py.lookup(1, 2)[0] == float("inf")  # a miss stays a miss
+    assert u_py.max_kicks > 0, "high-load pack never displaced: not a stress test"
+
+    if get_lib() is not None:
+        u_nat = ubodt_from_columns(src, dst, dist, tm, fe, delta=1000.0,
+                                   load_factor=0.8, use_native=True)
+        assert u_nat.bmask == u_py.bmask
+        assert u_nat.max_kicks == u_py.max_kicks
+        np.testing.assert_array_equal(u_nat.packed, u_py.packed)
