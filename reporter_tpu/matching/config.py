@@ -43,6 +43,12 @@ class MatcherConfig:
     # jits (parallel/mesh.py semantics in the product path).  Must be a
     # power of two <= visible devices.
     devices: int = 1
+    # of those devices, how many shard the UBODT table (gp axis): the
+    # route-distance table splits into bucket ranges of 1/graph_devices per
+    # chip and probes resolve with pmin/pmax collectives over the ICI — for
+    # region tables larger than one chip's HBM.  Must be a power of two
+    # dividing ``devices``; 1 = table replicated.
+    graph_devices: int = 1
     # report() business-logic default (reporter_service.py:54-58)
     threshold_sec: int = 15
     mode: str = "auto"

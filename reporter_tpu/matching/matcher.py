@@ -86,30 +86,55 @@ class SegmentMatcher:
         self._params = MatchParams.from_config(self.cfg)
 
         # device mesh in the product path (VERDICT r03 next #4): with
-        # cfg.devices > 1 the graph/UBODT/params live replicated over a dp
-        # mesh and every batch array is device_put with a dp sharding before
-        # dispatch — computation follows data, so the same jits below run
-        # SPMD across chips with XLA inserting the collectives.  This is the
-        # TPU equivalent of the reference scaling by Kafka partitions
-        # (README.md:169-173).
+        # cfg.devices > 1 the graph/params live replicated over the mesh and
+        # every batch array is device_put with a dp sharding before dispatch
+        # — computation follows data, so the same jits below run SPMD across
+        # chips with XLA inserting the collectives.  This is the TPU
+        # equivalent of the reference scaling by Kafka partitions
+        # (README.md:169-173).  With cfg.graph_devices > 1 the mesh gains a
+        # gp axis: the UBODT table lives in 1/gp bucket-range slices per
+        # chip (HBM scaling for region tables bigger than one chip) and the
+        # match runs under shard_map so probes resolve with pmin/pmax over
+        # the ICI (ops/hashtable._ubodt_lookup_sharded).
         self._mesh = None
         self._batch_sharding = None
-        self._n_dp = max(1, int(self.cfg.devices))
-        if self._n_dp > 1:
+        n_total = max(1, int(self.cfg.devices))
+        self._n_gp = max(1, int(self.cfg.graph_devices))
+        if n_total & (n_total - 1) or self._n_gp & (self._n_gp - 1):
+            raise ValueError(
+                "cfg.devices/graph_devices must be powers of two, got %d/%d"
+                % (n_total, self._n_gp))
+        if n_total % self._n_gp:
+            raise ValueError("cfg.graph_devices=%d must divide devices=%d"
+                             % (self._n_gp, n_total))
+        self._n_dp = n_total // self._n_gp
+        gp_jits = None
+        if n_total > 1 or self._n_gp > 1:
             from jax.sharding import NamedSharding, PartitionSpec as P
 
-            from ..parallel.mesh import BATCH_AXIS, make_mesh
+            from ..parallel.mesh import (
+                BATCH_AXIS, GRAPH_AXIS, check_ubodt_shardable, make_mesh,
+                make_mesh2,
+            )
 
-            if self._n_dp & (self._n_dp - 1):
-                raise ValueError("cfg.devices must be a power of two, got %d"
-                                 % self._n_dp)
-            self._mesh = make_mesh(self._n_dp)
+            if self._n_gp > 1:
+                check_ubodt_shardable(self.ubodt, self._n_gp)
+                self._mesh = make_mesh2(self._n_dp, self._n_gp)
+                du_sharding = NamedSharding(self._mesh, P(GRAPH_AXIS))
+            else:
+                self._mesh = make_mesh(self._n_dp)
+                du_sharding = NamedSharding(self._mesh, P())
             repl = NamedSharding(self._mesh, P())
             self._batch_sharding = NamedSharding(self._mesh, P(BATCH_AXIS))
             self._dg = jax.device_put(self._dg, repl)
-            self._du = jax.device_put(self._du, repl)
+            self._du = jax.device_put(self._du, du_sharding)
             self._params = jax.device_put(self._params, repl)
-        self._jit_match_carry = jax.jit(match_batch_carry, static_argnums=(7,))
+            if self._n_gp > 1:
+                gp_jits = self._make_gp_jits()
+        if gp_jits is not None:
+            self._jit_match_carry = gp_jits["carry"]
+        else:
+            self._jit_match_carry = jax.jit(match_batch_carry, static_argnums=(7,))
 
         use_pallas = self.cfg.use_pallas
         env = os.environ.get("REPORTER_PALLAS", "").strip().lower()
@@ -130,7 +155,10 @@ class SegmentMatcher:
         # than the pallas kernel's 128-row block (padding a single streaming
         # trace to 128 rows made p50 latency ~1.5 s in round 3 — VERDICT r03
         # weak #2), and is the only forward when pallas is off
-        self._jit_match_scan = jax.jit(match_batch_compact, static_argnums=(7,))
+        if gp_jits is not None:
+            self._jit_match_scan = gp_jits["compact"]
+        else:
+            self._jit_match_scan = jax.jit(match_batch_compact, static_argnums=(7,))
         self._jit_match_pallas = None
         if self._pallas:
             from ..ops.viterbi_pallas import match_batch_compact_pallas
@@ -144,6 +172,47 @@ class SegmentMatcher:
                 )
 
             self._jit_match_pallas = jax.jit(_compact_pallas, static_argnums=(7,))
+
+    def _make_gp_jits(self):
+        """shard_map'd compact/carry jits for the dp×gp mesh: batch arrays
+        split over dp, the UBODT's bucket ranges over gp, probes resolved
+        with collectives inside (the plain sharded-jit path cannot express
+        the axis_index/pmin the sharded probe needs).  Each returned fn
+        keeps the (…, params, k[, carry]) calling convention of the plain
+        jits so _dispatch_batch/_match_long stay oblivious."""
+        import jax
+        from jax.sharding import PartitionSpec as P
+
+        from ..ops.viterbi import match_batch_carry, match_batch_compact
+        from ..parallel.mesh import BATCH_AXIS, GRAPH_AXIS
+
+        k = self.cfg.beam_k
+
+        def body_compact(dg, du, px, py, tm, v, p):
+            return match_batch_compact(
+                dg, du.with_shard_axis(GRAPH_AXIS), px, py, tm, v, p, k)
+
+        def body_carry(dg, du, px, py, tm, v, p, carry):
+            return match_batch_carry(
+                dg, du.with_shard_axis(GRAPH_AXIS), px, py, tm, v, p, k, carry)
+
+        bat = P(BATCH_AXIS)
+        sm_compact = jax.jit(jax.shard_map(
+            body_compact, mesh=self._mesh,
+            in_specs=(P(), P(GRAPH_AXIS), bat, bat, bat, bat, P()),
+            out_specs=bat, check_vma=False,
+        ))
+        sm_carry = jax.jit(jax.shard_map(
+            body_carry, mesh=self._mesh,
+            in_specs=(P(), P(GRAPH_AXIS), bat, bat, bat, bat, P(), bat),
+            out_specs=(bat, bat), check_vma=False,
+        ))
+        return {
+            "compact": lambda dg, du, px, py, tm, v, p, _k: sm_compact(
+                dg, du, px, py, tm, v, p),
+            "carry": lambda dg, du, px, py, tm, v, p, _k, carry: sm_carry(
+                dg, du, px, py, tm, v, p, carry),
+        }
 
     def _init_cpu(self):
         from ..baseline.cpu_matcher import CPUViterbiMatcher
