@@ -216,6 +216,20 @@ class ReporterService:
     def make_server(self, host: str = "0.0.0.0", port: int = 8002) -> ThreadingHTTPServer:
         service = self
 
+        # connection-concurrency bound, honouring the reference's env knobs
+        # (reporter_service.py:37-45: THREAD_POOL_COUNT, or
+        # THREAD_POOL_MULTIPLIER x cpus; the reference sizes a hand-rolled
+        # pool, here a semaphore bounds the per-connection threads)
+        try:
+            pool = int(os.environ["THREAD_POOL_COUNT"])
+        except (KeyError, ValueError):
+            mult = os.environ.get("THREAD_POOL_MULTIPLIER")
+            try:
+                pool = int(float(mult) * (os.cpu_count() or 1)) if mult else 0
+            except ValueError:
+                pool = 0
+        gate = threading.BoundedSemaphore(pool) if pool > 0 else None
+
         class Handler(BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"
 
@@ -260,10 +274,16 @@ class ReporterService:
                 self._answer(code, out)
 
             def do_GET(self):
-                self._route(post=False)
+                if gate is None:
+                    return self._route(post=False)
+                with gate:
+                    self._route(post=False)
 
             def do_POST(self):
-                self._route(post=True)
+                if gate is None:
+                    return self._route(post=True)
+                with gate:
+                    self._route(post=True)
 
             def log_message(self, fmt, *args):
                 log.debug("http: " + fmt, *args)

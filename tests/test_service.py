@@ -159,3 +159,67 @@ def test_non_object_body_gets_400(service_url):
     url, _ = service_url
     code, out = post_json(url + "/report", [1, 2])
     assert code == 400 and "object" in out["error"]
+
+
+@pytest.fixture(scope="module")
+def service_matcher():
+    city = grid_city(rows=5, cols=5, spacing_m=150.0)
+    arrays = build_graph_arrays(city, cell_size=100.0)
+    ubodt = build_ubodt(arrays, delta=2000.0)
+    return SegmentMatcher(arrays=arrays, ubodt=ubodt, config=MatcherConfig())
+
+
+def test_thread_pool_env_bounds_concurrency(monkeypatch, service_matcher):
+    """THREAD_POOL_COUNT=1 (reference env, reporter_service.py:37-45) must
+    serialise request handling: with two concurrent requests, the second
+    enters only after the first leaves."""
+    import threading
+    import time as _time
+
+    from reporter_tpu.serve.service import ReporterService
+
+    monkeypatch.setenv("THREAD_POOL_COUNT", "1")
+    svc = ReporterService(service_matcher, max_wait_ms=1.0)
+    srv = svc.make_server("127.0.0.1", 0)
+    port = srv.server_address[1]
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    try:
+        import urllib.request
+
+        active = []
+        peaks = []
+        lock = threading.Lock()
+        orig = svc.handle_report
+
+        def tracked(trace):
+            with lock:
+                active.append(1)
+                peaks.append(len(active))
+            _time.sleep(0.15)
+            out = orig(trace)
+            with lock:
+                active.pop()
+            return out
+
+        svc.handle_report = tracked
+        body = json.dumps({
+            "uuid": "v", "match_options": {"report_levels": [0, 1],
+                                           "transition_levels": [0, 1]},
+            "trace": [{"lat": 37.75, "lon": -122.45, "time": 0},
+                      {"lat": 37.7501, "lon": -122.4501, "time": 15}],
+        }).encode()
+
+        def hit():
+            urllib.request.urlopen(urllib.request.Request(
+                "http://127.0.0.1:%d/report" % port, data=body), timeout=30).read()
+
+        ts = [threading.Thread(target=hit) for _ in range(3)]
+        for x in ts:
+            x.start()
+        for x in ts:
+            x.join()
+        assert peaks and max(peaks) == 1, peaks
+    finally:
+        srv.shutdown()
+        srv.server_close()
