@@ -461,6 +461,33 @@ def run_device() -> int:
     agr_mean = float(np.mean(list(agreement.values())))
     _stderr("segment agreement vs truth: %s (mean %.3f)" % (agreement, agr_mean))
 
+    # device-vs-oracle agreement on real traces (the "at equal
+    # OSMLR-segment agreement" clause of the north star, BASELINE.md):
+    # match a small mixed subset on the CPU oracle and diff the wire-format
+    # segment sequences the two backends emit
+    oracle_cmp = None
+    try:
+        from reporter_tpu.matching import SegmentMatcher as _SM
+
+        subset = ([s.trace for s in cohorts[0][2][:4]]
+                  + [s.trace for s in cohorts[1][2][:2]])
+        cpum = _SM(arrays=arrays, ubodt=ubodt, config=cfg, backend="cpu")
+        dev_out = matcher.match_many(subset)
+        cpu_out = cpum.match_many(subset)
+        ids = lambda r: [s.get("segment_id") for s in r["segments"]]
+        exact = sum(d == c for d, c in zip(dev_out, cpu_out))
+        id_match = sum(ids(d) == ids(c) for d, c in zip(dev_out, cpu_out))
+        oracle_cmp = {
+            "traces": len(subset),
+            "identical_records": exact,
+            "identical_segment_ids": id_match,
+        }
+        _stderr("device vs cpu oracle: %d/%d identical records, %d/%d "
+                "identical segment-id sequences"
+                % (exact, len(subset), id_match, len(subset)))
+    except Exception as e:  # noqa: BLE001 - diagnostics must not sink the bench
+        _stderr("oracle comparison failed: %s" % (e,))
+
     print(json.dumps({
         "platform": platform,
         "acquire_s": round(acquire_s, 1),
@@ -480,6 +507,7 @@ def run_device() -> int:
         "warmup_s": round(warmup_s, 1),
         "pallas": pallas_info,
         "agreement": round(agr_mean, 4),
+        "oracle_cmp": oracle_cmp,
         "agreement_by_cohort": agreement,
         "device_mb": round(hbm_mb, 1),
         "scenario": scenario,
@@ -731,7 +759,7 @@ def main() -> int:
     for k in ("platform", "acquire_s", "points_per_sec", "p50_latency_ms", "p95_latency_ms",
               "latency_cohort", "forward", "forward_by_cohort", "kernel_traces_per_sec", "kernel_by_cohort",
               "kernel_secs_by_cohort", "roofline", "profile_dir",
-              "device_util", "warmup_s", "pallas", "agreement", "agreement_by_cohort", "device_mb",
+              "device_util", "warmup_s", "pallas", "agreement", "oracle_cmp", "agreement_by_cohort", "device_mb",
               "scenario", "edges", "ubodt_rows", "ubodt_load", "ubodt_max_probes",
               "ubodt_max_kicks"):
         if k in device_json:
