@@ -256,8 +256,9 @@ struct Pin {
 
 // 2-choice bucketed cuckoo table: one interleaved int32 array
 // [n_buckets, kBucket, kRowW] of (src, dst, dist-bits, time-bits,
-// first_edge, pad, pad, pad) entries.  Mirrors tiles/ubodt.py exactly.
-constexpr int64_t kBucket = 2;
+// first_edge, pad, pad, pad) entries; kBucket*kRowW = 128 int32 = one TPU
+// lane row per bucket.  Mirrors tiles/ubodt.py exactly.
+constexpr int64_t kBucket = 16;
 constexpr int64_t kRowW = 8;
 constexpr int64_t kMaxKicks = 500;
 enum { F_SRC = 0, F_DST = 1, F_DIST = 2, F_TIME = 3, F_FE = 4 };

@@ -1,9 +1,11 @@
 """Candidate edge lookup on device.
 
 For each GPS point: gather the shape segments in the 3x3 spatial-grid
-neighbourhood of the point's cell (fixed-capacity buckets, so the gather is a
-static [9*cap] window), project the point onto every segment, and keep the K
-nearest within the search radius, deduplicated per edge.
+neighbourhood of the point's cell, project the point onto every segment, and
+keep the K nearest within the search radius, deduplicated per edge.  The
+grid's cells store their candidate records INLINE (tiles/arrays.py
+cell_rows), so the whole 3x3 sweep is nine contiguous row-gathers — one
+aligned DMA per cell — rather than 9*cap scattered per-item gathers.
 
 This replaces Meili's per-point candidate search (C++ R-tree walk) with a
 dense, vmappable gather — the shapes are static so XLA tiles it onto the VPU,
@@ -46,16 +48,14 @@ def find_candidates(dg: DeviceGraph, px, py, k: int, search_radius: float) -> Ca
     ncy = jnp.clip(cy0 + offs[:, None], 0, ny - 1)  # [3,1]
     cells = (ncy * nx + ncx).reshape(-1)  # [9]
 
-    items = dg.grid_items[cells].reshape(-1)  # [9*cap]
-    valid = items >= 0
-    safe = jnp.where(valid, items, 0)
-
-    # one interleaved 32-byte row-gather per item (ax, ay, bx, by, off,
-    # len, edge-bits) instead of six scalar gathers into six arrays
-    rows = dg.shp_packed[safe]  # [9*cap, 8]
+    # the whole 3x3 sweep is NINE contiguous row-gathers (one aligned DMA
+    # per cell): each cell row carries its cap candidate records inline
+    # (ax, ay, bx, by, off, len, edge-bits per record; empty slots edge -1)
+    rows = dg.cell_rows[cells].reshape(-1, 8)  # [9*cap, 8]
     ax, ay, bx, by = rows[:, 0], rows[:, 1], rows[:, 2], rows[:, 3]
     off0, slen = rows[:, 4], rows[:, 5]
     edge_of = jax.lax.bitcast_convert_type(rows[:, 6], jnp.int32)
+    valid = edge_of >= 0
 
     dx = bx - ax
     dy = by - ay

@@ -2,11 +2,11 @@
 
 The route-distance lookup inside the HMM transition is exactly **two
 row-gathers**: hash the (src, dst) node pair with two independent mixes, pull
-each candidate bucket as one interleaved [BUCKET, ROW_W]-int32 row (a 64-byte
-contiguous window — the thing the TPU memory system is actually good at), and
-select the hit with a masked reduce over the 2*BUCKET candidate entries.  No
-data-dependent control flow, no probe chains: the probe count is an
-architectural constant of the table layout, not a function of load.
+each candidate bucket as one interleaved 128-lane int32 row (a 512-byte
+aligned window — exactly one TPU tile row, the unit the memory system moves
+anyway), and select the hit with a masked reduce over the 2*BUCKET candidate
+entries.  No data-dependent control flow, no probe chains: the probe count is
+an architectural constant of the table layout, not a function of load.
 
 (Round 3 used linear probing: up to 64 unrolled probes x 5 separate scalar
 gathers into five ~32M-slot arrays, which made the transition matrix
@@ -21,7 +21,9 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from ..tiles.ubodt import BUCKET, F_DIST, F_DST, F_FE, F_SRC, F_TIME, DeviceUBODT
+from ..tiles.ubodt import (
+    BUCKET, F_DIST, F_DST, F_FE, F_SRC, F_TIME, ROW_W, DeviceUBODT,
+)
 
 
 def device_pair_hash(src: jnp.ndarray, dst: jnp.ndarray, mask: int) -> jnp.ndarray:
@@ -72,9 +74,10 @@ def ubodt_lookup(u: DeviceUBODT, src: jnp.ndarray, dst: jnp.ndarray):
     src, dst = jnp.broadcast_arrays(src, dst)
     b1 = device_pair_hash(src, dst, u.bmask)
     b2 = device_pair_hash2(src, dst, u.bmask)
-    r1 = u.packed[b1]  # [..., BUCKET, ROW_W]
+    r1 = u.packed[b1]  # [..., 128]: one aligned lane-row DMA per probe
     r2 = u.packed[b2]
-    rows = jnp.concatenate([r1, r2], axis=-2)  # [..., 2*BUCKET, ROW_W]
+    rows = jnp.concatenate([r1, r2], axis=-1)
+    rows = rows.reshape(rows.shape[:-1] + (2 * BUCKET, ROW_W))
     return _select(rows, src, dst)
 
 
@@ -96,11 +99,12 @@ def _ubodt_lookup_sharded(u: DeviceUBODT, src: jnp.ndarray, dst: jnp.ndarray):
     def local_rows(b):
         loc = b - lo
         inr = (loc >= 0) & (loc < L)
-        r = u.packed[jnp.where(inr, loc, 0)]  # [..., BUCKET, ROW_W]
+        r = u.packed[jnp.where(inr, loc, 0)]  # [..., 128]
         # out-of-range buckets contribute entries that match nothing (-2)
-        return jnp.where(inr[..., None, None], r, -2)
+        return jnp.where(inr[..., None], r, -2)
 
-    rows = jnp.concatenate([local_rows(b1), local_rows(b2)], axis=-2)
+    rows = jnp.concatenate([local_rows(b1), local_rows(b2)], axis=-1)
+    rows = rows.reshape(rows.shape[:-1] + (2 * BUCKET, ROW_W))
     dist, time, first = _select(rows, src, dst)
     dist = jax.lax.pmin(dist, u.shard_axis)
     time = jax.lax.pmin(time, u.shard_axis)

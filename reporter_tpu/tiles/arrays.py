@@ -46,13 +46,15 @@ class DeviceGraph(NamedTuple):
     edge_internal: "jnp.ndarray"
     edge_head0: "jnp.ndarray"  # heading (radians) at edge start
     edge_head1: "jnp.ndarray"  # heading (radians) at edge end
-    # interleaved shape-segment rows [n_items, 8] f32: ax, ay, bx, by,
-    # off, len, edge-id-bits (int32 bitcast), pad.  One 32-byte row-gather
-    # per candidate item instead of six scalar gathers into six arrays —
-    # same layout rationale as the UBODT's cuckoo buckets (the TPU memory
-    # system rewards contiguous windows, not scattered lanes).
-    shp_packed: "jnp.ndarray"
-    grid_items: "jnp.ndarray"
+    # CELL-MAJOR candidate rows [n_cells, cap*8] f32: for every grid cell,
+    # its (up to cap) shape segments as interleaved 8-lane records (ax, ay,
+    # bx, by, off, len, edge-id-bits, pad; empty slots carry edge -1).  A
+    # point's whole 3x3-cell candidate sweep is then NINE contiguous
+    # row-gathers — one aligned DMA per cell — instead of 9*cap scattered
+    # item gathers; same layout rationale as the UBODT's 128-lane buckets.
+    # (Rank-2 with a flat minor dim on purpose: TPU layouts tile the two
+    # minor dims to (8, 128), so a rank-3 [cells, cap, 8] would pad 16x.)
+    cell_rows: "jnp.ndarray"
     grid_origin: "jnp.ndarray"  # [x0, y0] f32
     grid_dims: "jnp.ndarray"  # [nx, ny] i32
     cell_size: "jnp.ndarray"  # f32 scalar
@@ -124,6 +126,19 @@ class GraphArrays:
         packed[:, 6] = np.asarray(self.shp_edge, np.int32).view(np.float32)
         return packed
 
+    def _cell_rows(self) -> np.ndarray:
+        """Cell-major [n_cells, cap*8] f32 candidate rows (see DeviceGraph).
+        Empty slots carry edge-id -1 (bit pattern) so the device sweep can
+        mask them without a separate item array."""
+        items = self.grid_items  # [n_cells, cap], -1 padded
+        n_cells, cap = items.shape
+        packed = self._shp_packed()
+        rows = packed[np.where(items >= 0, items, 0)]  # [n_cells, cap, 8]
+        empty = items < 0
+        rows[empty] = 0.0
+        rows[empty, 6] = np.array(-1, np.int32).view(np.float32)
+        return np.ascontiguousarray(rows.reshape(n_cells, cap * 8))
+
     def to_device(self) -> DeviceGraph:
         import jax.numpy as jnp
 
@@ -139,8 +154,7 @@ class GraphArrays:
             edge_internal=jnp.asarray(self.edge_internal, jnp.bool_),
             edge_head0=jnp.asarray(self.edge_head0, jnp.float32),
             edge_head1=jnp.asarray(self.edge_head1, jnp.float32),
-            shp_packed=jnp.asarray(self._shp_packed(), jnp.float32),
-            grid_items=jnp.asarray(self.grid_items, jnp.int32),
+            cell_rows=jnp.asarray(self._cell_rows(), jnp.float32),
             grid_origin=jnp.asarray([self.grid_x0, self.grid_y0], jnp.float32),
             grid_dims=jnp.asarray([self.grid_nx, self.grid_ny], jnp.int32),
             cell_size=jnp.asarray(self.cell_size, jnp.float32),

@@ -8,14 +8,20 @@ node pairs within ``delta`` metres, stored in a hash table whose array lives in
 HBM.  At match time the [batch, T, K, K] transition route-distances become
 pure vectorised gathers (ops/hashtable.py) — no graph search on device at all.
 
-Table layout (round 4): **2-choice bucketed cuckoo**, tuned for the TPU's
-memory system.  One interleaved int32 array ``packed[n_buckets, BUCKET, ROW_W]``
-holds (src, dst, dist-bits, time-bits, first_edge, 0, 0, 0) per entry, so a
-lookup is exactly **two row-gathers** (one 64-byte bucket per hash function)
-regardless of load — the linear-probe layout this replaces unrolled up to 64
-probes of 5 scalar gathers each, the single worst HBM access pattern a TPU can
-have.  Insertion uses deterministic cuckoo displacement at build time; the
-C++ packer (rn_cuckoo_pack) and the Python twin below produce bit-identical
+Table layout (round 4): **2-choice bucketed cuckoo sized to the TPU tile**.
+One interleaved int32 array ``packed[n_buckets, BUCKET, ROW_W]`` holds
+(src, dst, dist-bits, time-bits, first_edge, 0, 0, 0) per entry, with
+BUCKET=16 entries per bucket so one bucket is exactly **one 128-lane
+(512-byte) row** — the TPU's native (8, 128) tile width.  On device the
+table is a rank-2 ``[n_buckets, 128]`` array (zero layout padding) and a
+lookup is exactly **two row-gathers** (one aligned DMA per hash function)
+regardless of load; the hit is selected from the 2x16 candidate entries
+with lane-local compares.  The linear-probe layout this replaces unrolled
+up to 64 probes of 5 scalar gathers each — and every scattered 4-byte
+gather still cost a full tile DMA, the single worst HBM access pattern a
+TPU can have.  Insertion uses deterministic displacement at build time
+(2-choice with bucket 16 supports loads >0.9, so kicks are rare); the C++
+packer (rn_cuckoo_pack) and the Python twin below produce bit-identical
 tables.
 
 Each row also records the first edge of the shortest path so the full edge
@@ -46,12 +52,13 @@ _H2B = np.uint32(0xC2B2AE3D)
 
 EMPTY = -1
 
-# entries per bucket; 2-choice with bucket size 2 supports load factors to
-# ~0.89 (Dietzfelbinger/Weidling), we size for <= LOAD_TARGET
-BUCKET = 2
+# entries per bucket: 16 x ROW_W = one 128-lane int32 row, the TPU tile
+# width, so a bucket gather is a single aligned 512-byte DMA with no
+# layout padding.  2-choice with bucket size 16 supports load factors
+# >0.9; we size for <= LOAD_TARGET.
+BUCKET = 16
 # int32 lanes per entry: src, dst, dist(f32 bits), time(f32 bits),
-# first_edge, pad, pad, pad — padded to 8 so a bucket is one aligned
-# 64-byte row-gather on device
+# first_edge, pad, pad, pad
 ROW_W = 8
 F_SRC, F_DST, F_DIST, F_TIME, F_FE = 0, 1, 2, 3, 4
 LOAD_TARGET = 0.75
@@ -96,7 +103,7 @@ class DeviceUBODT:
     max_probes = 2
 
     def __init__(self, packed, bmask: int, shard_axis=None):
-        self.packed = packed  # [n_buckets, BUCKET, ROW_W] int32
+        self.packed = packed  # [n_buckets, BUCKET*ROW_W = 128] int32 rows
         self.bmask = int(bmask)
         self.shard_axis = shard_axis
 
@@ -203,8 +210,13 @@ class UBODT:
     def to_device(self) -> DeviceUBODT:
         import jax.numpy as jnp
 
+        # rank-2 [n_buckets, BUCKET*ROW_W=128]: the minor dim is exactly
+        # the TPU lane width, so the device layout carries zero padding and
+        # a bucket probe is one aligned row DMA
         return DeviceUBODT(
-            packed=jnp.asarray(self.packed, jnp.int32),
+            packed=jnp.asarray(
+                self.packed.reshape(self.n_buckets, BUCKET * ROW_W), jnp.int32
+            ),
             bmask=self.bmask,
         )
 

@@ -98,4 +98,6 @@ def test_device_graph_pytree(arrays):
     dg = arrays.to_device()
     leaves = jax.tree_util.tree_leaves(dg)
     assert all(hasattr(l, "shape") for l in leaves)
-    assert dg.grid_items.shape == arrays.grid_items.shape
+    # cell-major candidate rows: rank-2 with a 8-lane record per grid slot
+    n_cells, cap = arrays.grid_items.shape
+    assert dg.cell_rows.shape == (n_cells, cap * 8)
