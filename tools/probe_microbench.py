@@ -1,9 +1,9 @@
 """Microbenchmark: UBODT probe layouts on the real device.
 
 Compares the round-3 layout (linear probing, 5 SoA arrays, max_probes
-unrolled gathers x 5 arrays each) against the round-4 candidate (2-choice
-bucketed cuckoo, one interleaved [buckets, 2, 8] int32 row-gather per probe)
-on a synthetic table sized like the bench scenario (~32M slots / ~10.7M rows).
+unrolled gathers x 5 arrays each) against the round-4 production layout
+(2-choice bucketed cuckoo, one 128-lane [buckets, 128] int32 row-gather per
+probe — tiles/ubodt.py) on a synthetic table sized like the bench scenario.
 
 Run:  python tools/probe_microbench.py [--platform tpu|cpu]
 """
@@ -24,6 +24,13 @@ def main():
     ap.add_argument("--reps", type=int, default=20)
     args = ap.parse_args()
 
+    import os
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+    from reporter_tpu.utils.jaxenv import ensure_platform
+
+    ensure_platform()  # a dead accelerator tunnel must not hang a cpu run
     import jax
     import jax.numpy as jnp
 
@@ -41,9 +48,9 @@ def main():
     t_time = jnp.asarray(rng.random(S, dtype=np.float32))
     t_fe = jnp.asarray(rng.integers(0, 1 << 20, S, dtype=np.int32))
 
-    # --- r04 layout: interleaved [buckets, 2, 8] int32 --------------------
-    BKT = S // 2
-    packed = jnp.asarray(rng.integers(0, 1 << 20, (BKT, 2, 8), dtype=np.int32))
+    # --- r04 layout: one 128-lane row per 16-entry bucket ------------------
+    BKT = S // 16
+    packed = jnp.asarray(rng.integers(0, 1 << 20, (BKT, 128), dtype=np.int32))
 
     src = jnp.asarray(rng.integers(0, 1 << 20, N, dtype=np.int32))
     dst = jnp.asarray(rng.integers(0, 1 << 20, N, dtype=np.int32))
@@ -74,9 +81,9 @@ def main():
     def probe_cuckoo(src, dst):
         b1 = hash1(src, dst, bmask)
         b2 = hash2(src, dst, bmask)
-        r1 = packed[b1]  # [N, 2, 8]
+        r1 = packed[b1]  # [N, 128]: one aligned row DMA per probe
         r2 = packed[b2]
-        rows = jnp.concatenate([r1, r2], axis=-2)  # [N, 4, 8]
+        rows = jnp.concatenate([r1, r2], axis=-1).reshape(-1, 32, 8)
         hit = (rows[..., 0] == src[..., None]) & (rows[..., 1] == dst[..., None])
         dist = jnp.min(
             jnp.where(hit, jax.lax.bitcast_convert_type(rows[..., 2], jnp.float32), jnp.inf),
@@ -90,7 +97,7 @@ def main():
         return dist, tim, first
 
     def probe_r03_interleaved(src, dst, n_probes):
-        # linear probing but one row-gather per probe
+        # linear probing but one narrow row-gather per probe
         h = hash1(src, dst, mask)
         flat = packed.reshape(-1, 8)[:S]
         dist = jnp.full(h.shape, jnp.inf, jnp.float32)
