@@ -5,7 +5,8 @@ unrolled gathers x 5 arrays each) against the round-4 production layout
 (2-choice bucketed cuckoo, one 128-lane [buckets, 128] int32 row-gather per
 probe — tiles/ubodt.py) on a synthetic table sized like the bench scenario.
 
-Run:  python tools/probe_microbench.py [--platform tpu|cpu]
+Run:  python tools/probe_microbench.py [--platform axon|cpu]
+(default platform: $JAX_PLATFORMS, else cpu)
 """
 
 from __future__ import annotations
@@ -22,6 +23,8 @@ def main():
     ap.add_argument("--lookups", type=int, default=8 * 1023 * 64)  # B=8,T=1024,KxK=64
     ap.add_argument("--probes", type=int, default=26)  # measured r03 max_probes would go here
     ap.add_argument("--reps", type=int, default=20)
+    ap.add_argument("--platform", default=None,
+                    help="jax platform allow-list (default $JAX_PLATFORMS, else cpu)")
     args = ap.parse_args()
 
     import os
@@ -30,7 +33,9 @@ def main():
     sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
     from reporter_tpu.utils.jaxenv import ensure_platform
 
-    ensure_platform()  # a dead accelerator tunnel must not hang a cpu run
+    # a dead accelerator tunnel must not hang a cpu run: default the
+    # allow-list to cpu when nothing is requested
+    ensure_platform(args.platform or os.environ.get("JAX_PLATFORMS") or "cpu")
     import jax
     import jax.numpy as jnp
 
@@ -48,9 +53,12 @@ def main():
     t_time = jnp.asarray(rng.random(S, dtype=np.float32))
     t_fe = jnp.asarray(rng.integers(0, 1 << 20, S, dtype=np.int32))
 
-    # --- r04 layout: one 128-lane row per 16-entry bucket ------------------
-    BKT = S // 16
-    packed = jnp.asarray(rng.integers(0, 1 << 20, (BKT, 128), dtype=np.int32))
+    # --- r04 layout: one 128-lane row per BUCKET-entry bucket --------------
+    from reporter_tpu.tiles.ubodt import BUCKET, ROW_W
+
+    BKT = S // BUCKET
+    packed = jnp.asarray(
+        rng.integers(0, 1 << 20, (BKT, BUCKET * ROW_W), dtype=np.int32))
 
     src = jnp.asarray(rng.integers(0, 1 << 20, N, dtype=np.int32))
     dst = jnp.asarray(rng.integers(0, 1 << 20, N, dtype=np.int32))
@@ -83,7 +91,7 @@ def main():
         b2 = hash2(src, dst, bmask)
         r1 = packed[b1]  # [N, 128]: one aligned row DMA per probe
         r2 = packed[b2]
-        rows = jnp.concatenate([r1, r2], axis=-1).reshape(-1, 32, 8)
+        rows = jnp.concatenate([r1, r2], axis=-1).reshape(-1, 2 * BUCKET, ROW_W)
         hit = (rows[..., 0] == src[..., None]) & (rows[..., 1] == dst[..., None])
         dist = jnp.min(
             jnp.where(hit, jax.lax.bitcast_convert_type(rows[..., 2], jnp.float32), jnp.inf),
@@ -99,7 +107,7 @@ def main():
     def probe_r03_interleaved(src, dst, n_probes):
         # linear probing but one narrow row-gather per probe
         h = hash1(src, dst, mask)
-        flat = packed.reshape(-1, 8)[:S]
+        flat = packed.reshape(-1, ROW_W)[:S]
         dist = jnp.full(h.shape, jnp.inf, jnp.float32)
         tim = jnp.full(h.shape, jnp.inf, jnp.float32)
         first = jnp.full(h.shape, -1, jnp.int32)
