@@ -86,13 +86,21 @@ def transition_matrix(dg: DeviceGraph, du: DeviceUBODT, src: Candidates, dst: Ca
     safe_ea = jnp.where(ea >= 0, ea, 0)
     safe_eb = jnp.where(eb >= 0, eb, 0)
 
-    sp, sp_time, _ = ubodt_lookup(du, dg.edge_to[safe_ea][:, None], dg.edge_from[safe_eb][None, :])
-    remain = (dg.edge_len[safe_ea] - oa)[:, None]
+    # one interleaved row-gather per edge instead of seven scalar gathers
+    # (to-bits, from-bits, len, speed, head0, head1 — tiles/arrays.py)
+    era = dg.edge_rows[safe_ea]  # [K, 8]
+    erb = dg.edge_rows[safe_eb]
+    to_a = jax.lax.bitcast_convert_type(era[:, 0], jnp.int32)
+    from_b = jax.lax.bitcast_convert_type(erb[:, 1], jnp.int32)
+    len_a = era[:, 2]
+
+    sp, sp_time, _ = ubodt_lookup(du, to_a[:, None], from_b[None, :])
+    remain = (len_a - oa)[:, None]
     route = remain + sp + ob[None, :]
     # same 0.1 m/s floor as the UBODT builder and CPU oracle: a zero-speed
     # edge must not produce inf/NaN travel times
-    speed_a = jnp.maximum(dg.edge_speed[safe_ea], 0.1)
-    speed_b = jnp.maximum(dg.edge_speed[safe_eb], 0.1)
+    speed_a = jnp.maximum(era[:, 3], 0.1)
+    speed_b = jnp.maximum(erb[:, 3], 0.1)
     rtime = remain / speed_a[:, None] + sp_time + (ob / speed_b)[None, :]
 
     # Same-edge handling.  Forward progress is the plain offset delta.  A
@@ -122,7 +130,7 @@ def transition_matrix(dg: DeviceGraph, du: DeviceUBODT, src: Candidates, dst: Ca
     # turn penalty: scaled by the heading change between leaving the source
     # edge and entering the destination edge (0..pi); factor 0 (the reference
     # default, Dockerfile:45) disables it
-    turn = jnp.abs(angle_diff(dg.edge_head1[safe_ea][:, None], dg.edge_head0[safe_eb][None, :]))
+    turn = jnp.abs(angle_diff(era[:, 5][:, None], erb[:, 4][None, :]))
     logp = logp - jnp.where(same_known, 0.0, p.turn_penalty_factor * turn / (jnp.pi * p.beta))
     logp = jnp.where(feasible, logp, NEG_INF)
     return logp, jnp.where(feasible, route, jnp.inf)

@@ -33,19 +33,17 @@ log = logging.getLogger(__name__)
 
 
 class DeviceGraph(NamedTuple):
-    """The jnp-array pytree handed to the JAX kernels."""
+    """The jnp-array pytree handed to the JAX kernels.
 
-    node_x: "jnp.ndarray"
-    node_y: "jnp.ndarray"
-    edge_from: "jnp.ndarray"
-    edge_to: "jnp.ndarray"
-    edge_len: "jnp.ndarray"
-    edge_speed: "jnp.ndarray"
-    edge_level: "jnp.ndarray"
-    edge_seg: "jnp.ndarray"
-    edge_internal: "jnp.ndarray"
-    edge_head0: "jnp.ndarray"  # heading (radians) at edge start
-    edge_head1: "jnp.ndarray"  # heading (radians) at edge end
+    Only what the device kernels actually read ships to HBM, and the hot
+    per-edge fields travel as ONE interleaved row per edge so a transition
+    entry costs two 32-byte row-gathers instead of seven scalar gathers
+    (ops/viterbi.transition_matrix)."""
+
+    # interleaved per-edge rows [n_edges, 8] f32:
+    # to-node-bits, from-node-bits, len, speed, head0, head1, pad, pad
+    edge_rows: "jnp.ndarray"
+    edge_seg: "jnp.ndarray"  # [n_edges] i32 dense segment index (histograms)
     # CELL-MAJOR candidate rows [n_cells, cap*8] f32: for every grid cell,
     # its (up to cap) shape segments as interleaved 8-lane records (ax, ay,
     # bx, by, off, len, edge-id-bits, pad; empty slots carry edge -1).  A
@@ -139,21 +137,24 @@ class GraphArrays:
         rows[empty, 6] = np.array(-1, np.int32).view(np.float32)
         return np.ascontiguousarray(rows.reshape(n_cells, cap * 8))
 
+    def _edge_rows(self) -> np.ndarray:
+        """Interleaved [n_edges, 8] f32 per-edge rows (see DeviceGraph)."""
+        n = self.num_edges
+        rows = np.zeros((n, 8), np.float32)
+        rows[:, 0] = np.asarray(self.edge_to, np.int32).view(np.float32)
+        rows[:, 1] = np.asarray(self.edge_from, np.int32).view(np.float32)
+        rows[:, 2] = self.edge_len
+        rows[:, 3] = self.edge_speed
+        rows[:, 4] = self.edge_head0
+        rows[:, 5] = self.edge_head1
+        return rows
+
     def to_device(self) -> DeviceGraph:
         import jax.numpy as jnp
 
         return DeviceGraph(
-            node_x=jnp.asarray(self.node_x, jnp.float32),
-            node_y=jnp.asarray(self.node_y, jnp.float32),
-            edge_from=jnp.asarray(self.edge_from, jnp.int32),
-            edge_to=jnp.asarray(self.edge_to, jnp.int32),
-            edge_len=jnp.asarray(self.edge_len, jnp.float32),
-            edge_speed=jnp.asarray(self.edge_speed, jnp.float32),
-            edge_level=jnp.asarray(self.edge_level, jnp.int32),
+            edge_rows=jnp.asarray(self._edge_rows(), jnp.float32),
             edge_seg=jnp.asarray(self.edge_seg, jnp.int32),
-            edge_internal=jnp.asarray(self.edge_internal, jnp.bool_),
-            edge_head0=jnp.asarray(self.edge_head0, jnp.float32),
-            edge_head1=jnp.asarray(self.edge_head1, jnp.float32),
             cell_rows=jnp.asarray(self._cell_rows(), jnp.float32),
             grid_origin=jnp.asarray([self.grid_x0, self.grid_y0], jnp.float32),
             grid_dims=jnp.asarray([self.grid_nx, self.grid_ny], jnp.int32),
