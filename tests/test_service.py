@@ -223,3 +223,34 @@ def test_thread_pool_env_bounds_concurrency(monkeypatch, service_matcher):
     finally:
         srv.shutdown()
         srv.server_close()
+
+
+def test_concurrent_requests_micro_batch(service_url):
+    """32 parallel /report calls must all succeed and be aggregated into
+    fewer device batches than requests (the MicroBatcher's whole point:
+    concurrent singles share one [B, T] device program)."""
+    url, arrays = service_url
+    body = json.dumps(street_trace(arrays)).encode()
+
+    results = []
+    errors = []
+
+    def hit():
+        try:
+            r = urllib.request.urlopen(urllib.request.Request(
+                url + "/report", data=body,
+                headers={"Content-Type": "application/json"}), timeout=60)
+            results.append(json.loads(r.read()))
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    ts = [threading.Thread(target=hit) for _ in range(32)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert not errors, errors[:3]
+    assert len(results) == 32
+    assert all("datastore" in r and "stats" in r for r in results)
+    # identical input -> identical output across every concurrent response
+    assert all(r == results[0] for r in results[1:])
