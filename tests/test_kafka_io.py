@@ -36,45 +36,134 @@ class FakeMessage:
 class FakeBroker:
     def __init__(self):
         self.topics = {}
-        self.committed = {}   # (group, topic) -> offset
+        self.committed = {}   # (group, topic[, partition]) -> offset
         self.commit_log = []  # offsets in commit order
+        # rebalance scripting for the partitioned-runner path:
+        self.revoke_after = None      # (n_msgs_yielded, [partitions])
+        self.next_assignment = None   # partition allow-list for the next consumer
 
-    def produce(self, topic, value, key=None, ts=None):
+    def produce(self, topic, value, key=None, ts=None, partition=0):
         self.topics.setdefault(topic, []).append(
-            FakeMessage(key, value, ts or int(time.time() * 1000))
+            FakeMessage(key, value, ts or int(time.time() * 1000), partition)
         )
 
 
 def fake_kafka_module(broker: FakeBroker) -> types.ModuleType:
     mod = types.ModuleType("kafka")
 
+    class TopicPartition:
+        def __init__(self, topic, partition):
+            self.topic = topic
+            self.partition = partition
+
+        def __hash__(self):
+            return hash((self.topic, self.partition))
+
+        def __eq__(self, other):
+            return (self.topic, self.partition) == (other.topic, other.partition)
+
+    class OffsetAndMetadata:
+        def __init__(self, offset, metadata=None, leader_epoch=None):
+            self.offset = offset
+
+    class ConsumerRebalanceListener:
+        pass
+
     class KafkaConsumer:
-        def __init__(self, topic, bootstrap_servers=None, group_id=None,
+        def __init__(self, *topics, bootstrap_servers=None, group_id=None,
                      value_deserializer=None, enable_auto_commit=True,
                      consumer_timeout_ms=1000, **_kw):
-            self._topic = topic
+            self._topic = topics[0] if topics else None
             self._group = group_id
             self._deser = value_deserializer or (lambda b: b)
             self._auto = enable_auto_commit
-            self._pos = broker.committed.get((group_id, topic), 0)
+            self._listener = None
+            self._assigned = None  # set of partitions (None = not yet)
+            self._pos = {}  # partition -> consumed count within partition
+            self._yielded = 0
             self.closed = False
 
+        def subscribe(self, topics, listener=None):
+            self._topic = topics[0]
+            self._listener = listener
+
+        # -- partition plumbing ------------------------------------------
+        def _msgs(self, p):
+            return [m for m in broker.topics.get(self._topic, [])
+                    if m.partition == p]
+
+        def _all_partitions(self):
+            parts = sorted({m.partition for m in broker.topics.get(self._topic, [])})
+            return parts or [0]
+
+        def _ensure_assigned(self):
+            if self._assigned is not None:
+                return
+            parts = self._all_partitions()
+            if broker.next_assignment is not None:
+                parts = [p for p in parts if p in broker.next_assignment]
+                broker.next_assignment = None
+            self._assigned = set(parts)
+            for p in parts:
+                self._pos.setdefault(
+                    p, broker.committed.get((self._group, self._topic, p), 0))
+            if self._listener is not None:
+                self._listener.on_partitions_assigned(
+                    [TopicPartition(self._topic, p) for p in parts])
+
+        def position(self, tp):
+            return self._pos.get(tp.partition, 0)
+
         def __iter__(self):
-            # like kafka-python with consumer_timeout_ms: yield what's
-            # available, then stop iteration (idle timeout)
-            while self._pos < len(broker.topics.get(self._topic, [])):
-                msg = broker.topics[self._topic][self._pos]
-                self._pos += 1
-                raw = msg.value
+            self._ensure_assigned()
+            while True:
+                if (broker.revoke_after is not None
+                        and self._yielded >= broker.revoke_after[0]):
+                    _, parts = broker.revoke_after
+                    broker.revoke_after = None
+                    if self._listener is not None:
+                        self._listener.on_partitions_revoked(
+                            [TopicPartition(self._topic, p) for p in parts])
+                    self._assigned -= set(parts)
+                nxt = None
+                for m in broker.topics.get(self._topic, []):
+                    p = m.partition
+                    if p not in self._assigned:
+                        continue
+                    # skip already-consumed messages of this partition
+                    seen = 0
+                    for mm in broker.topics[self._topic]:
+                        if mm is m:
+                            break
+                        if mm.partition == p:
+                            seen += 1
+                    if seen < self._pos.get(p, 0):
+                        continue
+                    nxt = m
+                    break
+                if nxt is None:
+                    return  # idle timeout
+                self._pos[nxt.partition] = self._pos.get(nxt.partition, 0) + 1
+                self._yielded += 1
+                raw = nxt.value
                 yield FakeMessage(
-                    msg.key,
+                    nxt.key,
                     self._deser(raw if isinstance(raw, bytes) else raw.encode()),
-                    msg.timestamp,
+                    nxt.timestamp, nxt.partition,
                 )
 
-        def commit(self):
-            broker.committed[(self._group, self._topic)] = self._pos
-            broker.commit_log.append(self._pos)
+        def commit(self, offsets=None):
+            if offsets is not None:
+                for tp, om in offsets.items():
+                    broker.committed[(self._group, tp.topic, tp.partition)] = om.offset
+                    broker.commit_log.append((tp.partition, om.offset))
+                return
+            self._ensure_assigned()
+            total = sum(self._pos.values())
+            broker.committed[(self._group, self._topic)] = total
+            for p, off in self._pos.items():
+                broker.committed[(self._group, self._topic, p)] = off
+            broker.commit_log.append(total)
 
         def close(self):
             # kafka-python commits on close only under auto-commit
@@ -94,6 +183,9 @@ def fake_kafka_module(broker: FakeBroker) -> types.ModuleType:
 
     mod.KafkaConsumer = KafkaConsumer
     mod.KafkaProducer = KafkaProducer
+    mod.TopicPartition = TopicPartition
+    mod.OffsetAndMetadata = OffsetAndMetadata
+    mod.ConsumerRebalanceListener = ConsumerRebalanceListener
     return mod
 
 
@@ -316,3 +408,56 @@ def test_full_pipeline_checkpoint_restart_on_fake_broker(broker, tmp_path):
     )
     assert p2.formatted == 30  # no loss across the restart
     assert broker.committed[("g", "raw")] == 30
+
+
+def test_partitioned_runner_through_transport(broker, tmp_path):
+    """The full multi-instance protocol through run_pipeline itself: the
+    rebalance listener snapshots the revoked partition and commits its
+    offsets; the next consumer adopts both the state and the offset; the
+    union of both instances' tiles equals an uninterrupted single run
+    (test_rebalance proves the runner; this proves the transport glue)."""
+    from reporter_tpu.stream.checkpoint import PartitionedStreamRunner
+    from test_rebalance import T0, drain, make_instance, records, tile_rows
+
+    msgs = records()
+    phase1 = [m for m in msgs if m[0] < 8]
+    phase2 = [m for m in msgs if m[0] >= 8]
+
+    # oracle: uninterrupted single instance fed directly
+    single, out_single = make_instance(tmp_path, "k_single")
+    for t, part, raw in phase1 + phase2:
+        single.feed(raw, (T0 + t * 10) * 1000, partition=part)
+    drain(single)
+    want = tile_rows(out_single)
+
+    # all records produced upfront, partition-tagged
+    for t, part, raw in phase1 + phase2:
+        broker.produce("raw", raw, ts=(T0 + t * 10) * 1000, partition=part)
+
+    ckpt = str(tmp_path / "k_ckpt")
+
+    # consumer A owns both partitions, loses partition 1 after phase 1
+    pa, out_a = make_instance(tmp_path, "k_a")
+    ra = PartitionedStreamRunner(pa, ckpt)
+    broker.revoke_after = (len(phase1), [1])
+    kafka_io.run_pipeline(pa, "raw", "fake:9092", group="g",
+                          duration_sec=0.2, tick_sec=0.05, runner=ra)
+    assert broker.committed.get(("g", "raw", 1)) is not None, \
+        "partition-1 offsets must commit at the revoke"
+
+    # consumer B joins with partition 1 only and finishes the stream
+    pb, out_b = make_instance(tmp_path, "k_b")
+    rb = PartitionedStreamRunner(pb, ckpt)
+    broker.next_assignment = [1]
+    kafka_io.run_pipeline(pb, "raw", "fake:9092", group="g",
+                          duration_sec=0.2, tick_sec=0.05, runner=rb)
+
+    # NB the tail windows were already session-gap-evicted DURING the run:
+    # run_pipeline's wall-clock tick sees 2026 "now" against 2016-dated
+    # records (exactly how the reference's time-driven punctuate behaves on
+    # replayed data).  These drains only flush the anonymiser tiles.
+    drain(pa)
+    drain(pb)
+
+    got = tile_rows(out_a, out_b)
+    assert got == want
