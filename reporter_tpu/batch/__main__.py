@@ -45,6 +45,11 @@ def main(argv=None) -> int:
                     help="strptime pattern; empty string means epoch seconds")
     ap.add_argument("--match-config", required=True,
                     help="service config JSON (network + matcher + backend)")
+    ap.add_argument("--backend", choices=["jax", "cpu"], default=None,
+                    help="override the config's matcher backend (the "
+                         "reference north-star's --backend switch: run the "
+                         "same backfill on the device kernel or the CPU "
+                         "oracle for segment-for-segment diffing)")
     ap.add_argument("--mode", default="auto")
     ap.add_argument("--report-levels", type=int_set, default={0, 1})
     ap.add_argument("--transition-levels", type=int_set, default={0, 1})
@@ -71,7 +76,7 @@ def main(argv=None) -> int:
     from ..serve.service import load_service_config
     from .pipeline import run_pipeline
 
-    matcher, _conf = load_service_config(args.match_config)
+    matcher, _conf = load_service_config(args.match_config, backend=args.backend)
     trace_dir, match_dir = run_pipeline(
         matcher,
         archive_spec=args.src,

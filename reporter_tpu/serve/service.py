@@ -291,7 +291,7 @@ class ReporterService:
         return ThreadingHTTPServer((host, port), Handler)
 
 
-def load_service_config(path: str) -> Tuple[SegmentMatcher, dict]:
+def load_service_config(path: str, backend: Optional[str] = None) -> Tuple[SegmentMatcher, dict]:
     """Service config JSON:
 
     {
@@ -328,5 +328,7 @@ def load_service_config(path: str) -> Tuple[SegmentMatcher, dict]:
         net = load_network_tiles(netspec["path"])
     else:
         raise ValueError("unknown network type %r" % (kind,))
-    matcher = SegmentMatcher(network=net, config=cfg, backend=conf.get("backend", "jax"))
+    matcher = SegmentMatcher(
+        network=net, config=cfg, backend=backend or conf.get("backend", "jax")
+    )
     return matcher, conf
