@@ -101,3 +101,21 @@ def test_device_graph_pytree(arrays):
     # cell-major candidate rows: rank-2 with a 8-lane record per grid slot
     n_cells, cap = arrays.grid_items.shape
     assert dg.cell_rows.shape == (n_cells, cap * 8)
+
+
+def test_device_leaves_tpu_layout_friendly(arrays):
+    """TPU layouts tile the two minor dims of every array to (8, 128); a
+    rank-3 leaf with small minor dims pads catastrophically (a
+    [buckets, 2, 8] table would pad 64x in HBM).  Invariants: no device
+    leaf above rank 2, and the hot-table minor dims are exact lane rows."""
+    import jax
+
+    from reporter_tpu.tiles.ubodt import build_ubodt
+
+    dg = arrays.to_device()
+    du = build_ubodt(arrays, delta=500.0).to_device()
+    for leaf in jax.tree_util.tree_leaves(dg) + jax.tree_util.tree_leaves(du):
+        assert leaf.ndim <= 2, leaf.shape
+    assert du.packed.shape[1] == 128  # one bucket == one 512-byte lane row
+    assert dg.edge_rows.shape[1] == 8
+    assert dg.cell_rows.shape[1] % 8 == 0
