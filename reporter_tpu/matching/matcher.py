@@ -33,6 +33,14 @@ log = logging.getLogger(__name__)
 # ones; each pins ~max_device_points of input + a [B, T] compact result
 PIPELINE_DEPTH = 3
 
+# long-trace streaming: chunk results allowed to accumulate on device before
+# a concat+fetch wave.  Each deferred chunk pins its packed output
+# (12*B_pad*W bytes) PLUS its queued packed input (16*B_pad*W bytes) until
+# the wave flushes — ~3.7 MB per chunk at the default max_device_points
+# budget, so 64 bounds the deferred pool at ~235 MB while keeping the
+# host-sync count at one per wave rather than one per chunk.
+MAX_DEFERRED_CHUNKS = 64
+
 
 def _pad_rows(pad: int, *arrays):
     """Append ``pad`` all-zero (= all-invalid) rows to each [B, ...] array."""
@@ -79,7 +87,9 @@ class SegmentMatcher:
 
         import jax
 
-        from ..ops.viterbi import MatchParams, match_batch_carry, match_batch_compact
+        from ..ops.viterbi import (
+            MatchParams, match_batch_carry_packed, match_batch_compact_packed,
+        )
 
         self._dg = self.arrays.to_device()
         self._du = self.ubodt.to_device()
@@ -98,6 +108,7 @@ class SegmentMatcher:
         # the ICI (ops/hashtable._ubodt_lookup_sharded).
         self._mesh = None
         self._batch_sharding = None
+        self._carry_sharding = None
         n_total = max(1, int(self.cfg.devices))
         self._n_gp = max(1, int(self.cfg.graph_devices))
         if n_total & (n_total - 1) or self._n_gp & (self._n_gp - 1):
@@ -125,16 +136,25 @@ class SegmentMatcher:
                 self._mesh = make_mesh(self._n_dp)
                 du_sharding = NamedSharding(self._mesh, P())
             repl = NamedSharding(self._mesh, P())
-            self._batch_sharding = NamedSharding(self._mesh, P(BATCH_AXIS))
+            # packed [4, B, T] batch arrays shard over axis 1; carry pytrees
+            # (leading [B]) over axis 0
+            self._batch_sharding = NamedSharding(self._mesh, P(None, BATCH_AXIS))
+            self._carry_sharding = NamedSharding(self._mesh, P(BATCH_AXIS))
             self._dg = jax.device_put(self._dg, repl)
             self._du = jax.device_put(self._du, du_sharding)
             self._params = jax.device_put(self._params, repl)
             if self._n_gp > 1:
                 gp_jits = self._make_gp_jits()
+        # all forwards speak the packed transport: one [4, B, T] f32 array in,
+        # one [3, B, T] i32 array out (ops/viterbi.pack_inputs/pack_compact).
+        # Each host<->device crossing pays a fixed dispatch/sync cost (~73 ms
+        # on the tunneled bench chip), so the 4-put + 3-fetch unpacked calling
+        # convention tripled single-trace latency.
         if gp_jits is not None:
             self._jit_match_carry = gp_jits["carry"]
         else:
-            self._jit_match_carry = jax.jit(match_batch_carry, static_argnums=(7,))
+            self._jit_match_carry = jax.jit(
+                match_batch_carry_packed, static_argnums=(4,))
 
         use_pallas = self.cfg.use_pallas
         env = os.environ.get("REPORTER_PALLAS", "").strip().lower()
@@ -158,20 +178,23 @@ class SegmentMatcher:
         if gp_jits is not None:
             self._jit_match_scan = gp_jits["compact"]
         else:
-            self._jit_match_scan = jax.jit(match_batch_compact, static_argnums=(7,))
+            self._jit_match_scan = jax.jit(
+                match_batch_compact_packed, static_argnums=(4,))
         self._jit_match_pallas = None
         if self._pallas:
+            from ..ops.viterbi import pack_compact, unpack_inputs
             from ..ops.viterbi_pallas import match_batch_compact_pallas
 
             # off-TPU (forced-on for tests) the kernel runs interpreted
             interp = jax.devices()[0].platform != "tpu"
 
-            def _compact_pallas(dg, du, px, py, tm, v, p, k):
-                return match_batch_compact_pallas(
+            def _compact_pallas(dg, du, xin, p, k):
+                px, py, tm, v = unpack_inputs(xin)
+                return pack_compact(match_batch_compact_pallas(
                     dg, du, px, py, tm, v, p, k, interpret=interp
-                )
+                ))
 
-            self._jit_match_pallas = jax.jit(_compact_pallas, static_argnums=(7,))
+            self._jit_match_pallas = jax.jit(_compact_pallas, static_argnums=(4,))
 
     def _make_gp_jits(self):
         """shard_map'd compact/carry jits for the dp×gp mesh: batch arrays
@@ -179,39 +202,40 @@ class SegmentMatcher:
         with collectives inside (the plain sharded-jit path cannot express
         the axis_index/pmin the sharded probe needs).  Each returned fn
         keeps the (…, params, k[, carry]) calling convention of the plain
-        jits so _dispatch_batch/_match_long stay oblivious."""
+        jits so _dispatch_batch/_match_long stay oblivious (both speak the
+        packed [4, B, T] -> [3, B, T] transport; the batch axis of a packed
+        array is axis 1)."""
         import jax
         from jax.sharding import PartitionSpec as P
 
-        from ..ops.viterbi import match_batch_carry, match_batch_compact
+        from ..ops.viterbi import match_batch_carry_packed, match_batch_compact_packed
         from ..parallel.mesh import BATCH_AXIS, GRAPH_AXIS
 
         k = self.cfg.beam_k
 
-        def body_compact(dg, du, px, py, tm, v, p):
-            return match_batch_compact(
-                dg, du.with_shard_axis(GRAPH_AXIS), px, py, tm, v, p, k)
+        def body_compact(dg, du, xin, p):
+            return match_batch_compact_packed(
+                dg, du.with_shard_axis(GRAPH_AXIS), xin, p, k)
 
-        def body_carry(dg, du, px, py, tm, v, p, carry):
-            return match_batch_carry(
-                dg, du.with_shard_axis(GRAPH_AXIS), px, py, tm, v, p, k, carry)
+        def body_carry(dg, du, xin, p, carry):
+            return match_batch_carry_packed(
+                dg, du.with_shard_axis(GRAPH_AXIS), xin, p, k, carry)
 
-        bat = P(BATCH_AXIS)
+        bat = P(None, BATCH_AXIS)  # packed arrays: [field, B, T]
         sm_compact = jax.jit(jax.shard_map(
             body_compact, mesh=self._mesh,
-            in_specs=(P(), P(GRAPH_AXIS), bat, bat, bat, bat, P()),
+            in_specs=(P(), P(GRAPH_AXIS), bat, P()),
             out_specs=bat, check_vma=False,
         ))
         sm_carry = jax.jit(jax.shard_map(
             body_carry, mesh=self._mesh,
-            in_specs=(P(), P(GRAPH_AXIS), bat, bat, bat, bat, P(), bat),
-            out_specs=(bat, bat), check_vma=False,
+            in_specs=(P(), P(GRAPH_AXIS), bat, P(), P(BATCH_AXIS)),
+            out_specs=(bat, P(BATCH_AXIS)), check_vma=False,
         ))
         return {
-            "compact": lambda dg, du, px, py, tm, v, p, _k: sm_compact(
-                dg, du, px, py, tm, v, p),
-            "carry": lambda dg, du, px, py, tm, v, p, _k, carry: sm_carry(
-                dg, du, px, py, tm, v, p, carry),
+            "compact": lambda dg, du, xin, p, _k: sm_compact(dg, du, xin, p),
+            "carry": lambda dg, du, xin, p, _k, carry: sm_carry(
+                dg, du, xin, p, carry),
         }
 
     def _init_cpu(self):
@@ -219,23 +243,24 @@ class SegmentMatcher:
 
         self._cpu = CPUViterbiMatcher(self.arrays, self.ubodt, self.cfg)
 
-    def _put(self, a: np.ndarray, dtype):
-        """Batch array -> device, dp-sharded when a mesh is configured.
-        Sharded host arrays go straight to their owner devices (device_put
-        on the host array); routing through a single-device jnp.asarray
-        first would double the transfer."""
+    def _put_packed(self, xin: np.ndarray):
+        """Packed [4, B, T] batch array -> device, dp-sharded over the batch
+        axis (axis 1) when a mesh is configured.  Sharded host arrays go
+        straight to their owner devices (device_put on the host array);
+        routing through a single-device jnp.asarray first would double the
+        transfer."""
         import jax
         import jax.numpy as jnp
 
         if self._batch_sharding is not None:
-            return jax.device_put(np.asarray(a, dtype), self._batch_sharding)
-        return jnp.asarray(a, dtype)
+            return jax.device_put(xin, self._batch_sharding)
+        return jnp.asarray(xin)
 
     def _dispatch_batch(self, px: np.ndarray, py: np.ndarray, times: np.ndarray, valid: np.ndarray):
         """Queue one [B, T] padded batch on the backend without blocking.
         Returns an opaque handle for _collect_batch."""
         if self.backend == "jax":
-            import jax.numpy as jnp
+            from ..ops.viterbi import pack_inputs
 
             B = px.shape[0]
             # forward selection: the pallas kernel needs a 128-row batch
@@ -257,22 +282,21 @@ class SegmentMatcher:
                 )
             res = fn(
                 self._dg, self._du,
-                self._put(px, jnp.float32), self._put(py, jnp.float32),
-                self._put(times, jnp.float32),
-                self._put(valid, bool), self._params, self.cfg.beam_k,
+                self._put_packed(pack_inputs(px, py, times, valid)),
+                self._params, self.cfg.beam_k,
             )
             return ("jax", B, res)
         return ("cpu", self._cpu.run_batch(px, py, times, valid))
 
     def _collect_batch(self, handle):
-        """Block on a _dispatch_batch handle -> (edge, offset, break) numpy."""
+        """Block on a _dispatch_batch handle -> (edge, offset, break) numpy.
+        One fetch: the device result is a packed [3, B, T] i32 array."""
         if handle[0] == "jax":
+            from ..ops.viterbi import unpack_compact
+
             _, B, res = handle
-            return (
-                np.asarray(res.edge)[:B],
-                np.asarray(res.offset)[:B],
-                np.asarray(res.breaks)[:B],
-            )
+            edge, offset, breaks = unpack_compact(res)
+            return edge[:B], offset[:B], breaks[:B]
         return handle[1]
 
     def _run_batch(self, px: np.ndarray, py: np.ndarray, times: np.ndarray, valid: np.ndarray):
@@ -447,11 +471,13 @@ class SegmentMatcher:
         """Stream traces longer than the largest bucket through fixed
         [B, W]-windows with carried Viterbi state (ops/viterbi.TraceCarry):
         one compile regardless of trace length, no HMM restart at window
-        boundaries."""
+        boundaries.  All chunks of a group are DISPATCHED before any result
+        is fetched: the carry dependency chains them on device, so the chunk
+        loop enqueues asynchronously and only the fetch pass pays the
+        host<->device sync cost (once, not once per chunk)."""
         import jax
-        import jax.numpy as jnp
 
-        from ..ops.viterbi import initial_carry_batch
+        from ..ops.viterbi import initial_carry_batch, pack_inputs, unpack_compact
 
         W = self.cfg.length_buckets[-1] if self.cfg.length_buckets else 256
         cap = self._device_cap(W)  # rows per device batch for this window
@@ -472,23 +498,41 @@ class SegmentMatcher:
             B_pad = px.shape[0]
 
             carry = initial_carry_batch(B_pad, self.cfg.beam_k)
-            if self._batch_sharding is not None:
-                carry = jax.device_put(carry, self._batch_sharding)
-            edges, offs, brks = [], [], []
+            if self._carry_sharding is not None:
+                carry = jax.device_put(carry, self._carry_sharding)
+            xin = pack_inputs(px, py, tm, valid)  # [4, B_pad, n_chunks*W]
+            import jax.numpy as jnp
+
+            # chunk outputs accumulate ON DEVICE and are fetched in bounded
+            # waves: concat-on-device then one host sync per wave, instead
+            # of one sync per chunk.  The wave cap bounds deferred output
+            # memory (12*B_pad*W bytes per chunk) so an arbitrarily long
+            # trace cannot OOM the accelerator with pinned results.
+            outs, host_parts = [], []
+
+            def flush_wave():
+                if outs:
+                    host_parts.append(
+                        unpack_compact(jnp.concatenate(outs, axis=2))
+                        if len(outs) > 1 else unpack_compact(outs[0]))
+                    outs.clear()
+
             for c in range(n_chunks):
-                sl = slice(c * W, (c + 1) * W)
-                cm, carry = self._jit_match_carry(
+                out, carry = self._jit_match_carry(
                     self._dg, self._du,
-                    self._put(px[:, sl], jnp.float32), self._put(py[:, sl], jnp.float32),
-                    self._put(tm[:, sl], jnp.float32), self._put(valid[:, sl], bool),
+                    self._put_packed(xin[:, :, c * W : (c + 1) * W]),
                     self._params, self.cfg.beam_k, carry,
                 )
-                edges.append(np.asarray(cm.edge))
-                offs.append(np.asarray(cm.offset))
-                brks.append(np.asarray(cm.breaks))
-            edge = np.concatenate(edges, axis=1)
-            offset = np.concatenate(offs, axis=1)
-            breaks = np.concatenate(brks, axis=1)
+                outs.append(out)  # device handle; fetch deferred
+                if len(outs) >= MAX_DEFERRED_CHUNKS:
+                    flush_wave()
+            flush_wave()
+            if len(host_parts) == 1:
+                edge, offset, breaks = host_parts[0]
+            else:
+                edge = np.concatenate([p[0] for p in host_parts], axis=1)
+                offset = np.concatenate([p[1] for p in host_parts], axis=1)
+                breaks = np.concatenate([p[2] for p in host_parts], axis=1)
             self._associate_and_store(group, edge, offset, breaks, times, results)
 
     def warmup(self, lengths: "Sequence[int] | None" = None) -> float:
@@ -552,15 +596,16 @@ class SegmentMatcher:
 
         # one full pallas block at the streaming window length (the shape
         # the gate actually decides for)
+        from ..ops.viterbi import pack_inputs
+
         B, T = 128, 64
         ax, ay, bx, by = self._probe_edge_coords()
         px = np.tile(np.linspace(ax, bx, T, dtype=np.float32), (B, 1))
         py = np.tile(np.linspace(ay, by, T, dtype=np.float32), (B, 1))
         tm = np.tile(np.arange(T, dtype=np.float32) * 5.0, (B, 1))
         valid = np.ones((B, T), bool)
-        args = (self._dg, self._du, self._put(px, np.float32),
-                self._put(py, np.float32), self._put(tm, np.float32),
-                self._put(valid, bool), self._params)
+        args = (self._dg, self._du,
+                self._put_packed(pack_inputs(px, py, tm, valid)), self._params)
         times = {}
         try:
             for name, fn in (("scan", self._jit_match_scan),

@@ -375,6 +375,69 @@ def match_batch_carry(dg: DeviceGraph, du: DeviceUBODT, px, py, times, valid,
     return _compact(res), carry_out
 
 
+# -- packed host<->device transport ------------------------------------------
+#
+# Every host<->device boundary crossing pays a fixed dispatch/sync cost on top
+# of the bytes (measured ~73 ms per sync on the tunneled v5e this framework is
+# benched on; ~10-100 us on a co-located chip).  The unpacked forward crossed
+# that boundary seven times per batch (4 input device_puts + 3 result
+# fetches); the packed transport crosses it twice: one [4, B, T] f32 input
+# array in, one [3, B, T] i32 result out.  The stack/bitcast work fuses into
+# the surrounding program on device and is one numpy stack/view on host.
+
+def pack_inputs(px, py, times, valid):
+    """Host-side: one [4, B, T] f32 array from the four [B, T] batch arrays
+    (valid encoded as 0.0/1.0).  numpy in, numpy out — feed to device_put."""
+    import numpy as np
+
+    return np.stack([
+        np.asarray(px, np.float32), np.asarray(py, np.float32),
+        np.asarray(times, np.float32),
+        np.asarray(valid).astype(np.float32),
+    ])
+
+
+def unpack_inputs(xin):
+    """Device-side inverse of pack_inputs: [4, B, T] -> (px, py, times, valid)."""
+    return xin[0], xin[1], xin[2], xin[3] != 0
+
+
+def pack_compact(cm: CompactMatch) -> jnp.ndarray:
+    """Device-side: one [3, B, T] i32 array from a CompactMatch (offset
+    bitcast to preserve the f32 payload; breaks as 0/1)."""
+    return jnp.stack([
+        cm.edge.astype(jnp.int32),
+        jax.lax.bitcast_convert_type(cm.offset.astype(jnp.float32), jnp.int32),
+        cm.breaks.astype(jnp.int32),
+    ])
+
+
+def unpack_compact(out):
+    """Host-side inverse of pack_compact: [3, B, T] numpy i32 ->
+    (edge i32, offset f32, breaks bool) numpy arrays."""
+    import numpy as np
+
+    out = np.asarray(out)
+    return out[0], out[1].view(np.float32), out[2] != 0
+
+
+def match_batch_compact_packed(dg: DeviceGraph, du: DeviceUBODT, xin,
+                               p: MatchParams, k: int) -> jnp.ndarray:
+    """match_batch_compact over a packed [4, B, T] input -> packed [3, B, T]."""
+    px, py, times, valid = unpack_inputs(xin)
+    return pack_compact(match_batch_compact(dg, du, px, py, times, valid, p, k))
+
+
+def match_batch_carry_packed(dg: DeviceGraph, du: DeviceUBODT, xin,
+                             p: MatchParams, k: int, carry: TraceCarry):
+    """match_batch_carry over a packed [4, B, T] input -> (packed [3, B, T],
+    carry').  The carry pytree stays on device between chunks, so it never
+    crosses the transport boundary inside a chunk loop."""
+    px, py, times, valid = unpack_inputs(xin)
+    cm, carry_out = match_batch_carry(dg, du, px, py, times, valid, p, k, carry)
+    return pack_compact(cm), carry_out
+
+
 def initial_carry_batch(b: int, k: int) -> TraceCarry:
     """Inactive carry for a batch of b traces."""
     one = TraceCarry.inactive(k)
