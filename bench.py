@@ -248,12 +248,14 @@ def run_device() -> int:
     # warm only the single-trace latency shape (bucket 64) plus the
     # measured scan-vs-pallas gate; the fleet pass below compiles every
     # batched shape the bench actually dispatches
+    _write_status(phase="benching", step="warmup", platform=platform)
     matcher.warmup(lengths=[64])
     matcher.match_many(traces)
     warmup_s = time.time() - t0
     _stderr("warmup/compile %.1fs" % warmup_s)
 
     # end-to-end throughput (device viterbi + parallel host association)
+    _write_status(phase="benching", step="e2e", platform=platform)
     reps = int(os.environ.get("BENCH_REPS", "3"))
     t0 = time.time()
     for _ in range(reps):
@@ -265,6 +267,7 @@ def run_device() -> int:
     # p50/p95 per-trace latency at the streaming operating point (~64-pt
     # window, BatchingProcessor-style flush) -- short cohort only, named in
     # the JSON (ADVICE r02)
+    _write_status(phase="benching", step="latency", platform=platform)
     lat_reps = int(os.environ.get("BENCH_LAT_REPS", "40"))
     matcher.match_many([traces[0]])
     lats = []
@@ -335,6 +338,7 @@ def run_device() -> int:
     kernel_secs_by_cohort = {}
     roofline = {}
     cohort_xy = {}
+    _write_status(phase="benching", step="kernel", platform=platform)
     for name, T, ss in cohorts:
         px, py, tm, valid = _cohort_xy(arrays, ss, T)
         cohort_xy[name] = (px, py, tm, valid)
@@ -424,15 +428,18 @@ def run_device() -> int:
             profile_dir = None
 
     kernel_tps = n_traces / kernel_secs
+    kernel_pps = n_points_total / kernel_secs
     device_util = min(1.0, kernel_secs / (e2e_wall / reps))
     forward_by_cohort["long"] = "carry-scan"
     forward = "pallas" if pallas_on else "scan"  # availability; per-cohort below
-    _stderr("kernel-only %.1f traces/s (%s forward); e2e %.1f traces/s (%.0f pts/s); "
-            "device util %.2f" % (kernel_tps, forward, tps, pps, device_util))
+    _stderr("kernel-only %.1f traces/s / %.0f pts/s (%s forward); e2e %.1f "
+            "traces/s (%.0f pts/s); device util %.2f"
+            % (kernel_tps, kernel_pps, forward, tps, pps, device_util))
 
     # scan-vs-pallas on real hardware (VERDICT r02 next #2): bit-parity of
     # matched edges + throughput of both forwards on the short cohort
     pallas_info = None
+    _write_status(phase="benching", step="pallas_cmp", platform=platform)
     if platform == "tpu" and cfg.beam_k == 8:
         from reporter_tpu.ops.viterbi import match_batch_compact
         from reporter_tpu.ops.viterbi_pallas import match_batch_compact_pallas
@@ -473,6 +480,7 @@ def run_device() -> int:
     # accuracy: segment agreement vs ground truth, every cohort (VERDICT r02
     # weak #8) -- matched edges from the same compact/carry programs
     agreement = {}
+    _write_status(phase="benching", step="agreement", platform=platform)
     for cname, T, ss in cohorts:
         px, py, tm, valid = cohort_xy[cname]
         if cname == "long":
@@ -525,6 +533,7 @@ def run_device() -> int:
         "forward": forward,
         "forward_by_cohort": forward_by_cohort,
         "kernel_traces_per_sec": round(kernel_tps, 1),
+        "kernel_points_per_sec": round(kernel_pps, 1),
         "kernel_by_cohort": {k: round(v, 1) for k, v in kernel_by_cohort.items()},
         "kernel_secs_by_cohort": kernel_secs_by_cohort,
         "roofline": roofline,
@@ -618,6 +627,69 @@ def _finish(proc, timeout):
         except (json.JSONDecodeError, ValueError):
             continue
     return proc.returncode, None
+
+
+# a worker blocked on a dead tunnel makes no progress and cannot recover by
+# itself; after this long with the relay down AND the status heartbeat
+# frozen, kill it rather than burn the remaining run budget (a mid-run relay
+# drop has been observed; device calls then block indefinitely)
+RELAY_DEAD_KILL_S = 360.0
+
+
+def _finish_device(proc, timeout, status_file):
+    """_finish for the accelerator worker, plus tunnel-death early exit:
+    poll the relay ports and the worker's status file; if the ports stay
+    closed with no status change for RELAY_DEAD_KILL_S, the worker is
+    wedged mid-run on a dead tunnel -- kill it so the orchestrator can move
+    to the CPU fallback / retry instead of waiting out the full budget.
+
+    The relay logic only arms once the status file reports a non-cpu
+    platform: a cpu-platform worker never has relay ports open and its
+    per-step status writes are not a periodic heartbeat, so it would
+    otherwise be killed mid-progress.  stdout is drained on a thread the
+    whole time -- a poll loop that doesn't read the pipe deadlocks a worker
+    whose final JSON exceeds the pipe buffer."""
+    import threading
+
+    chunks = []
+    drainer = threading.Thread(
+        target=lambda: chunks.append(proc.stdout.read()), daemon=True)
+    drainer.start()
+
+    def _result(kill):
+        if kill:
+            proc.kill()
+        proc.wait()
+        drainer.join(30)
+        out = b"".join(c for c in chunks if c)
+        for ln in reversed(out.decode(errors="replace").strip().splitlines()):
+            try:
+                return proc.returncode, json.loads(ln)
+            except (json.JSONDecodeError, ValueError):
+                continue
+        return proc.returncode, None
+
+    t0 = time.time()
+    last_st = None
+    dead_since = None
+    while True:
+        if proc.poll() is not None:
+            return _result(kill=False)
+        if time.time() - t0 > timeout:
+            _stderr("device worker exceeded run budget (%.0fs); killing" % timeout)
+            return _result(kill=True)
+        st = _read_status(status_file)
+        on_accel = (st or {}).get("platform") not in (None, "cpu")
+        if not on_accel or _relay_ports_open() or st != last_st:
+            dead_since = None
+            last_st = st
+        elif dead_since is None:
+            dead_since = time.time()
+        elif time.time() - dead_since > RELAY_DEAD_KILL_S:
+            _stderr("relay down %.0fs with no worker progress; killing device "
+                    "worker" % (time.time() - dead_since))
+            return _result(kill=True)
+        time.sleep(10.0)
 
 
 def _read_status(path):
@@ -728,7 +800,7 @@ def main() -> int:
                                  "BENCH_GO_FILE": go_file}, sf)
         if _monitor_device(proc, sf, wait_s + 60, grace_s, attempts, gate):
             gate.ensure(300)  # free the cores, then let the worker bench
-            rc, device_json = _finish(proc, run_budget)
+            rc, device_json = _finish_device(proc, run_budget, sf)
             attempts.append({"outcome": "completed" if device_json else "died",
                              "rc": rc, "platform": (device_json or {}).get("platform")})
             if device_json and device_json.get("platform") == "cpu":
@@ -752,7 +824,7 @@ def main() -> int:
                                      "BENCH_ACQUIRE_WAIT": "300",
                                      "BENCH_GO_FILE": go_file}, sf)
             if _monitor_device(proc, sf, 360, 120, attempts, gate):
-                rc, retry_json = _finish(proc, run_budget)
+                rc, retry_json = _finish_device(proc, run_budget, sf)
                 attempts.append({"outcome": "completed" if retry_json else "died",
                                  "rc": rc, "platform": (retry_json or {}).get("platform")})
                 if retry_json and retry_json.get("platform") not in (None, "cpu"):
@@ -781,9 +853,15 @@ def main() -> int:
         "vs_baseline": round(device_json.get("points_per_sec", 0) / cpu_pps, 2) if cpu_pps else None,
         "vs_baseline_basis": "points_per_sec",
         "vs_baseline_traces": round(device_json.get("value", 0) / cpu_tps, 2) if cpu_tps else None,
+        # device-program-only ratio: what the chip does when the host
+        # transport/association overhead (tunnel sync quanta on this
+        # deployment) is excluded
+        "kernel_vs_baseline": round(
+            device_json.get("kernel_points_per_sec", 0) / cpu_pps, 2) if cpu_pps else None,
     }
     for k in ("platform", "acquire_s", "points_per_sec", "p50_latency_ms", "p95_latency_ms",
-              "latency_cohort", "forward", "forward_by_cohort", "kernel_traces_per_sec", "kernel_by_cohort",
+              "latency_cohort", "forward", "forward_by_cohort", "kernel_traces_per_sec",
+              "kernel_points_per_sec", "kernel_by_cohort",
               "kernel_secs_by_cohort", "roofline", "profile_dir",
               "device_util", "warmup_s", "pallas", "agreement", "oracle_cmp", "agreement_by_cohort", "device_mb",
               "scenario", "edges", "ubodt_rows", "ubodt_load", "ubodt_max_probes",
