@@ -152,25 +152,44 @@ def associate_segments_batch(
         out_cap *= 2
         way_cap *= 2
 
+    # bulk-convert columns to Python scalars once (.tolist() is one C pass);
+    # per-element numpy indexing materialises a numpy scalar per field and
+    # dominated association's host time at fleet scale.  Rounding stays the
+    # builtin round() on Python floats so the wire format remains
+    # byte-identical with the pure-Python fallback.
+    n_rec = int(rec_start[B])
+    rsl = rec_start.tolist()
+    wsl = way_start[: n_rec + 1].tolist()
+    way_l = way_ids[: wsl[n_rec] if n_rec else 0].tolist()
+    hs = has_seg[:n_rec].tolist()
+    sid = seg_id[:n_rec].tolist()
+    t0l = t0[:n_rec].tolist()
+    t1l = t1[:n_rec].tolist()
+    lnl = length[:n_rec].tolist()
+    inl = internal[:n_rec].tolist()
+    qll = qlen[:n_rec].tolist()
+    bsl = bshape[:n_rec].tolist()
+    esl = eshape[:n_rec].tolist()
+
     out: List[List[dict]] = []
     for b in range(B):
         recs: List[dict] = []
-        for r in range(int(rec_start[b]), int(rec_start[b + 1])):
+        for r in range(rsl[b], rsl[b + 1]):
             rec: dict = {
-                "way_ids": [int(w) for w in way_ids[way_start[r]:way_start[r + 1]]],
-                "internal": bool(internal[r]),
-                "queue_length": round(float(qlen[r]), 1),
-                "begin_shape_index": int(bshape[r]),
-                "end_shape_index": int(eshape[r]),
+                "way_ids": way_l[wsl[r]:wsl[r + 1]],
+                "internal": bool(inl[r]),
+                "queue_length": round(qll[r], 1),
+                "begin_shape_index": bsl[r],
+                "end_shape_index": esl[r],
             }
-            if has_seg[r]:
-                rec["segment_id"] = int(seg_id[r])
-                rec["start_time"] = round(float(t0[r]), 3) if t0[r] >= 0 else -1
-                rec["end_time"] = round(float(t1[r]), 3) if t1[r] >= 0 else -1
-                rec["length"] = round(float(length[r]), 3) if length[r] >= 0 else -1
+            if hs[r]:
+                rec["segment_id"] = sid[r]
+                rec["start_time"] = round(t0l[r], 3) if t0l[r] >= 0 else -1
+                rec["end_time"] = round(t1l[r], 3) if t1l[r] >= 0 else -1
+                rec["length"] = round(lnl[r], 3) if lnl[r] >= 0 else -1
             else:
-                rec["start_time"] = round(float(t0[r]), 3)
-                rec["end_time"] = round(float(t1[r]), 3)
+                rec["start_time"] = round(t0l[r], 3)
+                rec["end_time"] = round(t1l[r], 3)
                 rec["length"] = -1
             recs.append(rec)
         out.append(recs)

@@ -42,7 +42,7 @@ from ..tiles.network import RoadNetwork, grid_city
 
 log = logging.getLogger(__name__)
 
-ACTIONS = {"report", "trace_attributes_batch"}
+ACTIONS = {"report", "trace_attributes_batch", "health"}
 
 
 class MicroBatcher:
@@ -152,6 +152,12 @@ class ReporterService:
         self.threshold_sec = threshold_sec
         self.matcher = matcher
         self.batcher = MicroBatcher(matcher, max_batch=max_batch, max_wait_ms=max_wait_ms)
+        import time as _time
+
+        self._t_boot = _time.time()
+        self._counter_lock = threading.Lock()
+        self._n_requests = 0
+        self._n_errors = 0
 
     # -- request handling --------------------------------------------------
 
@@ -184,10 +190,36 @@ class ReporterService:
             match = self.batcher.match(trace)
             data = report_fn(match, trace, self.threshold_sec, rl, tl,
                              mode=trace.get("match_options", {}).get("mode", "auto"))
+            self._count(ok=True)
             return 200, data
         except Exception as e:
             log.exception("match failed")
+            self._count(ok=False)
             return 500, {"error": str(e)}
+
+    def _count(self, ok: bool) -> None:
+        with self._counter_lock:
+            self._n_requests += 1
+            if not ok:
+                self._n_errors += 1
+
+    def handle_health(self) -> Tuple[int, dict]:
+        """Liveness/ops snapshot (additive: the reference exposes no such
+        endpoint, so nothing on the wire contract changes)."""
+        import time as _time
+
+        m = self.matcher
+        return 200, {
+            "status": "ok",
+            "backend": m.backend,
+            "devices": int(getattr(m.cfg, "devices", 1)),
+            "graph_devices": int(getattr(m.cfg, "graph_devices", 1)),
+            "edges": int(m.arrays.num_edges),
+            "ubodt_rows": int(m.ubodt.num_rows),
+            "uptime_s": round(_time.time() - self._t_boot, 1),
+            "requests": self._n_requests,
+            "errors": self._n_errors,
+        }
 
     def handle_batch(self, body: dict) -> Tuple[int, dict]:
         traces = body.get("traces")
@@ -206,9 +238,11 @@ class ReporterService:
                           mode=t.get("match_options", {}).get("mode", "auto"))
                 for m, (t, rl, tl) in zip(matches, validated)
             ]
+            self._count(ok=True)
             return 200, {"results": results}
         except Exception as e:
             log.exception("batch failed")
+            self._count(ok=False)
             return 500, {"error": str(e)}
 
     # -- server ------------------------------------------------------------
@@ -242,14 +276,27 @@ class ReporterService:
                 self.end_headers()
                 self.wfile.write(body)
 
+            def _drain_body(self, post: bool):
+                """Consume any request body before an early answer: the
+                server speaks HTTP/1.1 keep-alive, so unread body bytes
+                would be parsed as the NEXT request line on this socket."""
+                if post:
+                    n = int(self.headers.get("Content-Length", 0))
+                    if n > 0:
+                        self.rfile.read(n)
+
             def _route(self, post: bool):
                 try:
                     split = urlsplit(self.path)
                     action = split.path.split("/")[-1]
                     if action not in ACTIONS:
+                        self._drain_body(post)
                         return self._answer(
                             400, {"error": "Try a valid action: %s" % sorted(ACTIONS)}
                         )
+                    if action == "health":  # no payload required
+                        self._drain_body(post)
+                        return self._answer(*service.handle_health())
                     if post:
                         n = int(self.headers.get("Content-Length", 0))
                         payload = json.loads(self.rfile.read(n).decode("utf-8"))

@@ -254,3 +254,53 @@ def test_concurrent_requests_micro_batch(service_url):
     assert all("datastore" in r and "stats" in r for r in results)
     # identical input -> identical output across every concurrent response
     assert all(r == results[0] for r in results[1:])
+
+
+class TestHealthEndpoint:
+    def test_health_snapshot(self, service_url):
+        url, arrays = service_url
+        code, out = get_json(url + "/health")
+        assert code == 200
+        assert out["status"] == "ok" and out["backend"] == "jax"
+        assert out["edges"] > 0 and out["ubodt_rows"] > 0
+        assert out["uptime_s"] >= 0
+        before = out["requests"]
+        # a served /report increments the counter; /health itself does not
+        code, _ = post_json(url + "/report", street_trace(arrays))
+        assert code == 200
+        code, after = get_json(url + "/health")
+        assert code == 200 and after["requests"] == before + 1
+        assert after["errors"] == out["errors"]
+
+    def test_keepalive_survives_post_with_body_to_health(self, service_url):
+        """POST /health (and any early-400 path) must drain the request body:
+        the server speaks HTTP/1.1 keep-alive, so leftover body bytes would
+        be parsed as the next request line on the same socket."""
+        import http.client
+
+        url, arrays = service_url
+        host_port = url.split("//")[1]
+        conn = http.client.HTTPConnection(host_port, timeout=30)
+        try:
+            body = json.dumps({"junk": "x" * 256})
+            conn.request("POST", "/health", body=body,
+                         headers={"Content-Type": "application/json"})
+            r1 = conn.getresponse()
+            assert r1.status == 200
+            assert json.loads(r1.read())["status"] == "ok"
+            # the SAME socket must serve a valid follow-up request
+            conn.request("POST", "/report", body=json.dumps(street_trace(arrays)),
+                         headers={"Content-Type": "application/json"})
+            r2 = conn.getresponse()
+            assert r2.status == 200
+            assert json.loads(r2.read())["datastore"]["reports"]
+            # and an early-400 path (bad action) must drain too
+            conn.request("POST", "/nonsense", body=body,
+                         headers={"Content-Type": "application/json"})
+            r3 = conn.getresponse()
+            assert r3.status == 400 and "valid action" in json.loads(r3.read())["error"]
+            conn.request("GET", "/health")
+            r4 = conn.getresponse()
+            assert r4.status == 200
+        finally:
+            conn.close()
