@@ -30,8 +30,16 @@ from .config import MatcherConfig
 log = logging.getLogger(__name__)
 
 # chunks allowed in flight on the device while the host associates earlier
-# ones; each pins ~max_device_points of input + a [B, T] compact result
-PIPELINE_DEPTH = 3
+# ones.  Each in-flight chunk pins its packed input + result,
+# (16 + 12) * max_device_points bytes <= ~3.7 MB at the default budget, so 8
+# bounds pinned transport memory at ~30 MB per match_many call — and the
+# MicroBatcher's composite worst case is (max_inflight + 2) * depth chunks
+# (~118 MB at its defaults; see serve/service.py), which must fit HBM
+# headroom next to the graph + UBODT.  Depth matters doubly on deployments
+# with a fixed per-sync cost: a fleet whose chunk count fits the depth
+# dispatches entirely before the first blocking fetch, so the whole batch
+# pays one sync quantum instead of one per early drain.
+PIPELINE_DEPTH = 8
 
 # long-trace streaming: chunk results allowed to accumulate on device before
 # a concat+fetch wave.  Each deferred chunk pins its packed output

@@ -65,7 +65,9 @@ class MicroBatcher:
     matcher.PIPELINE_DEPTH chunks, and (max_inflight + 2) calls can overlap
     in the worst case (one dispatching, max_inflight queued, one finishing)
     -- so size max_device_points for (max_inflight + 2) * PIPELINE_DEPTH
-    chunks, not PIPELINE_DEPTH alone.
+    chunks, not PIPELINE_DEPTH alone.  At the defaults (depth 8,
+    max_inflight 2, ~3.7 MB of packed transport per chunk) that composite
+    is ~118 MB of HBM next to the graph + UBODT.
     """
 
     def __init__(self, matcher: SegmentMatcher, max_batch: int = 64, max_wait_ms: float = 10.0,

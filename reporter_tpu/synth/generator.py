@@ -184,6 +184,25 @@ class TraceSynthesizer:
         ]
 
 
+def cohort_xy(arrays: GraphArrays, straces: "List[SyntheticTrace]", T: int):
+    """Pack synthesized traces into padded [B, T] device arrays
+    (px, py, rebased-times, valid).  Times rebase to each trace's start
+    BEFORE the float32 cast — epoch seconds have ~2 min f32 resolution.
+    Shared by bench.py and tools/kernel_breakdown.py so stage attribution is
+    measured on identically-packed inputs."""
+    B = len(straces)
+    px = np.zeros((B, T), np.float32)
+    py = np.zeros((B, T), np.float32)
+    tm = np.zeros((B, T), np.float32)
+    valid = np.ones((B, T), bool)
+    for i, s in enumerate(straces):
+        pts = s.trace["trace"]
+        x, y = arrays.proj.to_xy([p["lat"] for p in pts], [p["lon"] for p in pts])
+        px[i], py[i] = x, y
+        tm[i] = np.asarray([p["time"] for p in pts]) - pts[0]["time"]
+    return px, py, tm, valid
+
+
 def example_grid_batch(arrays: GraphArrays, B: int, T: int, seed: int = 0):
     """Padded [B, T] batch of jittered straight drives along grid-city rows.
     Shared by the driver entry (__graft_entry__) and the sharding tests so

@@ -1,0 +1,132 @@
+"""Time the match kernel's stages in isolation on the visible device.
+
+Splits one [B, T] batch's device work into:
+  candidates   find_candidates_batch only
+  transitions  candidates + the [T-1, K, K] transition matrices (UBODT probes)
+  full         match_batch_compact (adds viterbi scan + backtrace + compact)
+
+The deltas between rows attribute kernel time to the candidate sweep, the
+transition/UBODT stage, and the sequential scan machinery — the evidence
+needed before optimising any one of them (e.g. a temporal-parallel Viterbi
+only pays if `full - transitions` dominates).
+
+Timing fetches a scalar reduction per rep (block_until_ready is optimistic
+on the tunneled backend); tables are jit arguments, never closures.
+
+Run:  python tools/kernel_breakdown.py [--platform axon|cpu] [--scenario osm]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--platform", default=None)
+    ap.add_argument("--scenario", default=os.environ.get("BENCH_SCENARIO", "osm"))
+    ap.add_argument("--grid", type=int, default=int(os.environ.get("BENCH_GRID", "120")))
+    ap.add_argument("--delta", type=float, default=float(os.environ.get("BENCH_DELTA", "3000")))
+    ap.add_argument("--b", type=int, default=16)
+    ap.add_argument("--t", type=int, default=1024)
+    ap.add_argument("--reps", type=int, default=5)
+    args = ap.parse_args()
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+    from reporter_tpu.utils.jaxenv import ensure_platform
+
+    ensure_platform(args.platform or os.environ.get("JAX_PLATFORMS") or "cpu")
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from reporter_tpu.matching import MatcherConfig
+    from reporter_tpu.ops.candidates import find_candidates_batch
+    from reporter_tpu.ops.viterbi import (
+        MatchParams, match_batch_compact, transition_matrix,
+    )
+    from reporter_tpu.synth import TraceSynthesizer
+    from reporter_tpu.tiles.arrays import build_graph_arrays
+    from reporter_tpu.synth.osm_city import realistic_city_network
+    from reporter_tpu.tiles.network import grid_city
+    from reporter_tpu.tiles.ubodt import build_ubodt
+
+    print("platform:", jax.devices()[0], flush=True)
+    cfg = MatcherConfig()
+    k = cfg.beam_k
+    t0 = time.time()
+    if args.scenario == "grid":
+        city = grid_city(rows=args.grid, cols=args.grid, spacing_m=150.0)
+    else:
+        city = realistic_city_network(rows=args.grid, cols=args.grid)
+    arrays = build_graph_arrays(city, cell_size=100.0)
+    ubodt = build_ubodt(arrays, delta=args.delta)
+    print("scenario %s: %d edges, ubodt %d rows (%.1fs)"
+          % (args.scenario, arrays.num_edges, ubodt.num_rows, time.time() - t0), flush=True)
+
+    from reporter_tpu.synth.generator import cohort_xy
+
+    synth = TraceSynthesizer(arrays, seed=7)
+    B, T = args.b, args.t
+    # same packing as the bench's cohorts: identical inputs, comparable times
+    px, py, tm, valid = cohort_xy(
+        arrays, synth.batch(B, T, dt=5.0, sigma=5.0, max_tries=400), T)
+
+    dg = arrays.to_device()
+    du = ubodt.to_device()
+    p = MatchParams.from_config(cfg)
+    jpx, jpy, jtm, jvalid = map(jnp.asarray, (px, py, tm, valid))
+
+    def stage_candidates(dg, du, px, py, tm, valid):
+        c = find_candidates_batch(dg, px, py, k, p.search_radius)
+        return (jnp.sum(jnp.where(jnp.isfinite(c.dist), c.dist, 0.0))
+                + jnp.sum(c.edge))
+
+    def stage_transitions(dg, du, px, py, tm, valid):
+        def one(px, py, tm):
+            cand = find_candidates_batch(dg, px, py, k, p.search_radius)
+            src = jax.tree_util.tree_map(lambda a: a[:-1], cand)
+            dst = jax.tree_util.tree_map(lambda a: a[1:], cand)
+            gc = jnp.hypot(px[1:] - px[:-1], py[1:] - py[:-1])
+            dts = tm[1:] - tm[:-1]
+            logp, route = jax.vmap(
+                transition_matrix, in_axes=(None, None, 0, 0, 0, 0, None)
+            )(dg, du, src, dst, gc, dts, p)
+            return (jnp.sum(jnp.where(logp > -1e29, logp, 0.0))
+                    + jnp.sum(jnp.where(jnp.isfinite(route), route, 0.0)))
+        return jnp.sum(jax.vmap(one)(px, py, tm))
+
+    def stage_full(dg, du, px, py, tm, valid):
+        cm = match_batch_compact(dg, du, px, py, tm, valid, p, k)
+        return (jnp.sum(cm.edge) + jnp.sum(cm.offset)
+                + jnp.sum(cm.breaks.astype(jnp.int32)))
+
+    results = {}
+    for name, fn in (("candidates", stage_candidates),
+                     ("transitions", stage_transitions),
+                     ("full", stage_full)):
+        jf = jax.jit(fn)
+        t0 = time.time()
+        float(jf(dg, du, jpx, jpy, jtm, jvalid))
+        compile_s = time.time() - t0
+        t0 = time.time()
+        for _ in range(args.reps):
+            float(jf(dg, du, jpx, jpy, jtm, jvalid))
+        dt = (time.time() - t0) / args.reps
+        results[name] = dt
+        print("%-12s %8.2f ms   (%.0f pts/s; compile %.1fs)"
+              % (name, dt * 1e3, B * T / dt, compile_s), flush=True)
+    cand = results["candidates"]
+    trans = results["transitions"] - cand
+    scan = results["full"] - results["transitions"]
+    tot = results["full"]
+    print("attribution: candidates %.0f%%  transitions/UBODT %.0f%%  "
+          "scan+backtrace+compact %.0f%%"
+          % (100 * cand / tot, 100 * trans / tot, 100 * scan / tot), flush=True)
+
+
+if __name__ == "__main__":
+    main()
