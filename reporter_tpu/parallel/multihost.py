@@ -1,0 +1,169 @@
+"""Multi-host (multi-controller) execution for the matcher.
+
+The reference scales past one machine by adding Kafka consumers — each
+instance owns a partition of the vehicle keys and never talks to its peers
+(README.md:169-173).  The TPU-native equivalent keeps that shape on the
+*data* plane (each host feeds its own micro-batches) and adds what Kafka
+cannot provide: a single device mesh spanning every host's chips, so one
+jitted program matches the global batch with the trace axis sharded over
+all chips ("dp"), and the per-segment histograms the anonymiser consumes
+reduce across hosts with an XLA ``psum`` riding ICI within a host and DCN
+between hosts — replacing the reference's single-process punctuate sort.
+
+JAX runs one controller process per host (`jax.distributed.initialize`);
+the SAME ``parallel.sharded_match_fn`` / ``graph_sharded_match_fn``
+programs used single-host compile unchanged over the global mesh — GSPMD
+inserts the cross-host collectives.  On CPU (tests, CI) the collectives
+run over Gloo; on TPU pods the same code rides ICI/DCN.
+
+CLI dryrun (the multi-host analogue of __graft_entry__.dryrun_multichip;
+run one command per "host", here as two local processes):
+
+    python -m reporter_tpu.parallel.multihost \
+        --coordinator 127.0.0.1:9911 --processes 2 --process-id {0,1}
+
+Each process prints the global histogram checksum; they must agree.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import Optional, Sequence
+
+__all__ = ["init_multihost", "global_batch", "run_dryrun", "main"]
+
+
+def init_multihost(coordinator: str, num_processes: int, process_id: int,
+                   platforms: Optional[str] = None):
+    """Platform hygiene + ``jax.distributed.initialize``.  Call before any
+    jax array work in every host process.  Returns the jax module."""
+    from ..utils.jaxenv import ensure_platform
+
+    ensure_platform(platforms or os.environ.get("JAX_PLATFORMS") or None)
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    return jax
+
+
+def put_global(mesh, spec, tree):
+    """Build global jax.Arrays on a multi-process mesh from host numpy
+    pytrees that every process materialises identically.
+
+    Uses ``jax.make_array_from_single_device_arrays`` — each process puts
+    only the shards its local devices own (for ``P()`` that is a full local
+    copy per device, i.e. replication).  ``jax.device_put`` is NOT used for
+    this: in multi-controller mode it byte-compares the host value across
+    processes, and our device layouts legitimately contain NaN *bit
+    patterns* (int32 node ids bitcast into f32 lanes) that fail any
+    NaN-aware equality even when the bytes agree.
+    """
+    import jax
+    import numpy as np
+    from jax.sharding import NamedSharding
+
+    sh = NamedSharding(mesh, spec)
+
+    def put_one(x):
+        x = np.asarray(x)
+        idx_map = sh.addressable_devices_indices_map(x.shape)
+        bufs = [jax.device_put(x[idx], d) for d, idx in idx_map.items()]
+        return jax.make_array_from_single_device_arrays(x.shape, sh, bufs)
+
+    return jax.tree_util.tree_map(put_one, tree)
+
+
+def global_batch(mesh, arrays):
+    """[B_global, ...] numpy arrays (byte-identical in every process) ->
+    global jax.Arrays with the batch axis sharded over all hosts' devices.
+    For host-distinct feeding, build per-host shards and use
+    ``jax.make_array_from_process_local_data`` instead — this helper covers
+    the replicated-input dryrun/test path."""
+    from jax.sharding import PartitionSpec as P
+
+    from .mesh import BATCH_AXIS
+
+    return tuple(put_global(mesh, P(BATCH_AXIS), a) for a in arrays)
+
+
+def run_dryrun(coordinator: str, num_processes: int, process_id: int,
+               rows: int = 5, cols: int = 5, T: int = 16) -> dict:
+    """Build a tiny deterministic scenario, match a global batch over ALL
+    hosts' devices through the standard sharded program, and return
+    {"devices", "local_devices", "batch", "matched", "hist_total"} —
+    values derived from globally-reduced state, so every process must
+    return identical numbers (the test asserts it)."""
+    jax = init_multihost(coordinator, num_processes, process_id)
+    import numpy as np
+
+    from ..ops.viterbi import MatchParams
+    from ..synth.generator import dryrun_scenario, example_grid_batch
+    from .mesh import make_mesh, sharded_match_fn
+
+    cfg, arrays, ubodt = dryrun_scenario(rows=rows, cols=cols)
+
+    mesh = make_mesh()  # all global devices
+    n_dev = jax.device_count()
+    S = len(arrays.seg_ids)
+    fn = sharded_match_fn(mesh, cfg.beam_k, S)
+
+    B = 2 * n_dev
+    px, py, times, valid = example_grid_batch(arrays, B, T, seed=3)
+    from jax.sharding import PartitionSpec as P
+
+    to_host = lambda tree: jax.tree_util.tree_map(np.asarray, tree)
+    dg = put_global(mesh, P(), to_host(arrays.to_device()))
+    du = put_global(mesh, P(), to_host(ubodt.to_device()))
+    p = put_global(mesh, P(), to_host(MatchParams.from_config(cfg)))
+    jpx, jpy, jtm, jvalid = global_batch(mesh, (px, py, times, valid))
+
+    res, hist = fn(dg, du, jpx, jpy, jtm, jvalid, p)
+    jax.block_until_ready(hist)
+
+    # res is dp-sharded (only local shards addressable); count local matches
+    # then reduce across processes via the already-replicated histogram plus
+    # a process_allgather on the local count
+    from jax.experimental import multihost_utils
+
+    local_matched = int(sum(
+        (np.asarray(s.data) >= 0).sum() for s in res.idx.addressable_shards
+    ))
+    matched = int(multihost_utils.process_allgather(
+        np.asarray([local_matched])).sum())
+    hist_total = float(np.asarray(hist.point_count.addressable_shards[0].data).sum())
+    return {
+        "devices": int(n_dev),
+        "local_devices": int(jax.local_device_count()),
+        "batch": int(B),
+        "matched": matched,
+        "hist_total": hist_total,
+    }
+
+
+def main(argv: Sequence[str] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--coordinator", required=True, help="host:port of process 0")
+    ap.add_argument("--processes", type=int, required=True)
+    ap.add_argument("--process-id", type=int, required=True)
+    ap.add_argument("--rows", type=int, default=5)
+    ap.add_argument("--cols", type=int, default=5)
+    ap.add_argument("--t", type=int, default=16)
+    args = ap.parse_args(argv)
+    out = run_dryrun(args.coordinator, args.processes, args.process_id,
+                     rows=args.rows, cols=args.cols, T=args.t)
+    assert out["matched"] > 0, "multi-host dryrun matched nothing"
+    assert out["hist_total"] > 0, "multi-host histogram reduction empty"
+    print("multihost dryrun ok: %(devices)d devices (%(local_devices)d local), "
+          "batch %(batch)d, %(matched)d matched points, hist_total %(hist_total).1f"
+          % out)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

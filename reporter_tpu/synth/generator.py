@@ -184,6 +184,25 @@ class TraceSynthesizer:
         ]
 
 
+def dryrun_scenario(rows: int = 5, cols: int = 5, spacing_m: float = 150.0,
+                    delta: float = 1500.0):
+    """(cfg, arrays, ubodt) for a tiny deterministic grid city — THE shared
+    dryrun recipe.  Used by the driver entry (__graft_entry__._build) and
+    the multi-host dryrun (parallel.multihost) so single-host and
+    multi-host dryruns exercise identical inputs; change constants here,
+    not in a caller."""
+    from ..matching.config import MatcherConfig
+    from ..tiles.network import grid_city
+    from ..tiles.ubodt import build_ubodt
+    from ..tiles.arrays import build_graph_arrays
+
+    cfg = MatcherConfig()
+    city = grid_city(rows=rows, cols=cols, spacing_m=spacing_m)
+    arrays = build_graph_arrays(city, cell_size=100.0)
+    ubodt = build_ubodt(arrays, delta=delta)
+    return cfg, arrays, ubodt
+
+
 def cohort_xy(arrays: GraphArrays, straces: "List[SyntheticTrace]", T: int):
     """Pack synthesized traces into padded [B, T] device arrays
     (px, py, rebased-times, valid).  Times rebase to each trace's start
