@@ -21,7 +21,7 @@ def _free_port() -> int:
     return port
 
 
-def test_two_process_global_mesh(tmp_path):
+def test_two_process_global_mesh():
     import os
 
     repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))

@@ -75,7 +75,9 @@ def run_capture(cmd, env, timeout, out_path):
         f.write(out)
     with open(out_path + ".err", "w") as f:
         f.write(err)
-    log("%s -> rc=%s in %.0fs (out %d B)" % (os.path.basename(cmd[-1]), rc, time.time() - t0, len(out)))
+    # label with the script being run (argv may end with flag values)
+    script = next((a for a in cmd[1:] if a.endswith(".py")), cmd[-1])
+    log("%s -> rc=%s in %.0fs (out %d B)" % (os.path.basename(script), rc, time.time() - t0, len(out)))
     return rc, out, err
 
 
@@ -112,6 +114,15 @@ def main() -> None:
                              "ts": time.strftime("%H:%M:%S")})
                 if ok:
                     log("TPU BENCH CAPTURED -> tpu_bench_out.json")
+                    # stage attribution on the real chip (evidence for the
+                    # which-stage-dominates question; see kernel_breakdown)
+                    rc3, _, _ = run_capture(
+                        [sys.executable,
+                         os.path.join(REPO, "tools", "kernel_breakdown.py"),
+                         "--platform", "axon"],
+                        env, 1200, os.path.join(REPO, "tpu_breakdown_out.txt"))
+                    runs.append({"what": "breakdown", "rc": rc3,
+                                 "ts": time.strftime("%H:%M:%S")})
                 # back off after EVERY attempt -- a consistently failing
                 # bench must not be retried back-to-back forever
                 next_attempt_ok = time.time() + (COOLDOWN_OK_S if ok else COOLDOWN_FAIL_S)
