@@ -39,7 +39,6 @@ from reporter_tpu.utils.relay import port_open  # noqa: E402
 LOG = os.path.join(REPO, "tpu_watch.log")
 STATE = os.path.join(REPO, "TPU_WATCH.json")
 POLL_S = 10.0
-COOLDOWN_OK_S = 600.0  # after a successful TPU bench, re-bench at most this often
 COOLDOWN_FAIL_S = 180.0  # after a failed/cpu bench attempt, back off this long
 
 
@@ -123,9 +122,23 @@ def main() -> None:
                         env, 1200, os.path.join(REPO, "tpu_breakdown_out.txt"))
                     runs.append({"what": "breakdown", "rc": rc3,
                                  "ts": time.strftime("%H:%M:%S")})
-                # back off after EVERY attempt -- a consistently failing
-                # bench must not be retried back-to-back forever
-                next_attempt_ok = time.time() + (COOLDOWN_OK_S if ok else COOLDOWN_FAIL_S)
+                    # one successful capture is the job (bench JSON +
+                    # breakdown + warmed XLA cache).  Exit rather than keep
+                    # re-benching: the tunnel serves ONE client at a time,
+                    # and a watcher re-bench could collide with the
+                    # driver's own round-end bench run.  The breakdown is
+                    # best-effort — done records whether it landed, but a
+                    # breakdown failure must not keep the watcher (and the
+                    # collision risk) alive when the bench itself is in.
+                    write_state(relay_open=True, open_ports=open_ports,
+                                checks=checks, runs=runs[-8:], pid=os.getpid(),
+                                done=True, breakdown_ok=(rc3 == 0))
+                    log("capture complete (breakdown rc=%s); watcher exiting"
+                        % rc3)
+                    return
+                # back off after a failing attempt -- a consistently
+                # failing bench must not be retried back-to-back forever
+                next_attempt_ok = time.time() + COOLDOWN_FAIL_S
             else:
                 next_attempt_ok = time.time() + 60  # relay up but init failing
         time.sleep(POLL_S)
