@@ -169,3 +169,63 @@ def test_unassociated_prior_with_positive_length_not_counted_unreported():
     out = report(match, mk_trace(n=10, dt=10), 15, RL, TL)
     assert out["stats"]["unreported_matches"]["count"] == 0
     assert out["stats"]["unassociated_segments"] == 1
+
+
+def test_documented_schema_contract():
+    """Every field the reference documents (README.md:269-302) and nothing
+    undocumented, through the real matcher end to end — including the
+    internal/segment_id exclusivity rule ('internal ... cannot be true if
+    segment_id is present')."""
+    import numpy as np
+
+    from reporter_tpu.matching import SegmentMatcher
+    from reporter_tpu.synth.generator import dryrun_scenario
+
+    cfg, arrays, ubodt = dryrun_scenario(rows=6, cols=6)
+    m = SegmentMatcher(arrays=arrays, ubodt=ubodt, config=cfg)
+    ax = float(arrays.node_x[arrays.edge_from[0]])
+    ay = float(arrays.node_y[arrays.edge_from[0]])
+    cx = float(arrays.node_x[arrays.edge_to[7]])
+    cy = float(arrays.node_y[arrays.edge_to[7]])
+    lat, lon = arrays.proj.to_latlon(np.linspace(ax, cx, 30), np.linspace(ay, cy, 30))
+    trace = {
+        "uuid": "schema",
+        "match_options": {"mode": "auto", "report_levels": [0, 1, 2],
+                          "transition_levels": [0, 1, 2]},
+        "trace": [{"lat": float(a), "lon": float(o), "time": 1000 + 7 * i}
+                  for i, (a, o) in enumerate(zip(lat, lon))],
+    }
+    out = report(m.match(trace), trace, 15, {0, 1, 2}, {0, 1, 2}, mode="auto")
+
+    ds = out["datastore"]
+    assert ds["mode"] == "auto" and ds["reports"]
+    for r in ds["reports"]:
+        assert set(r) == {"id", "next_id", "queue_length", "length", "t0", "t1"}
+
+    sm = out["segment_matcher"]
+    assert sm["mode"] == "auto" and sm["segments"]
+    base = {"way_ids", "start_time", "end_time", "queue_length", "length",
+            "internal", "begin_shape_index", "end_shape_index"}
+    for s in sm["segments"]:
+        assert base <= set(s)
+        assert not (set(s) - base - {"segment_id"}), "undocumented field"
+        if s["internal"]:
+            assert "segment_id" not in s
+        else:
+            assert "segment_id" in s  # non-internal matched coverage carries one
+
+    # the multi-edge drive holds back an in-progress tail segment, so the
+    # documented trim index must be PRESENT here, not merely well-typed
+    assert isinstance(out["shape_used"], int) and out["shape_used"] > 0
+
+    # internal/segment_id exclusivity on an ACTUAL internal segment (the
+    # grid scenario has none, so exercise the association emitter directly)
+    intr = {"segments": [
+        seg(L0, start=0, end=30, length=300, begin=0, end_idx=3),
+        seg(None, start=30, end=40, internal=True, begin=3, end_idx=4),
+        seg(L1, start=40, end=70, length=300, begin=4, end_idx=7),
+    ]}
+    out2 = report(intr, mk_trace(n=10, dt=10), 15, {0, 1}, {0, 1})
+    internals = [s for s in out2["segment_matcher"]["segments"] if s["internal"]]
+    assert internals and all("segment_id" not in s for s in internals)
+    assert "stats" in out
