@@ -20,20 +20,34 @@ def main(argv):
         level=logging.INFO, format="%(asctime)s %(levelname)s %(message)s"
     )
     ensure_platform()
-    if len(argv) < 2:
-        sys.stderr.write("usage: python -m reporter_tpu.serve <config.json> [host:port]\n")
+    # conf path: positional arg, else $MATCHER_CONF_FILE — the reference's
+    # container default (README.md Env Var Overrides: MATCHER_CONF_FILE).
+    # With the env set, the single positional may be the bind address.
+    args = list(argv[1:])
+    env_conf = os.environ.get("MATCHER_CONF_FILE")
+    def _looks_like_addr(a):
+        return (":" in a or a.isdigit()) and not os.path.exists(a)
+
+    if args and not (env_conf and _looks_like_addr(args[0])):
+        conf_path, addr_args = args[0], args[1:]
+    else:
+        conf_path, addr_args = env_conf, args
+    if not conf_path:
+        sys.stderr.write(
+            "usage: python -m reporter_tpu.serve <config.json> [host:port]\n"
+            "       (or set MATCHER_CONF_FILE)\n")
         return 1
     try:
-        matcher, conf = load_service_config(argv[1])
+        matcher, conf = load_service_config(conf_path)
     except Exception as e:
         sys.stderr.write("Problem with config file: %s\n" % (e,))
         return 1
 
-    if len(argv) > 2:
-        if ":" in argv[2]:
-            host, port = argv[2].rsplit(":", 1)
+    if addr_args:
+        if ":" in addr_args[0]:
+            host, port = addr_args[0].rsplit(":", 1)
         else:
-            host, port = "0.0.0.0", argv[2]
+            host, port = "0.0.0.0", addr_args[0]
     else:
         host = os.environ.get("MATCHER_BIND_ADDR", "0.0.0.0")
         port = os.environ.get("MATCHER_LISTEN_PORT", "8002")

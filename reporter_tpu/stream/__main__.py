@@ -11,6 +11,7 @@
 
 import argparse
 import logging
+import os
 import sys
 import time
 
@@ -28,8 +29,13 @@ def main(argv=None) -> int:
     ap.add_argument("--source", required=True)
     ap.add_argument("--output", required=True, help="dir, http(s) url, or s3://bucket")
     ap.add_argument("--mode", default="auto")
-    ap.add_argument("--reports", default="0,1", help="report levels csv")
-    ap.add_argument("--transitions", default="0,1", help="transition levels csv")
+    # container knobs exactly like the reference (README.md:419-422,
+    # docker-compose.yml:13-14): env sets the default, the flag overrides
+    ap.add_argument("--reports", default=os.environ.get("REPORT_LEVELS", "0,1"),
+                    help="report levels csv (env REPORT_LEVELS)")
+    ap.add_argument("--transitions",
+                    default=os.environ.get("TRANSITION_LEVELS", "0,1"),
+                    help="transition levels csv (env TRANSITION_LEVELS)")
     ap.add_argument("--microbatch", type=int, default=16)
     ap.add_argument("--bootstrap", default=None, help="kafka bootstrap servers")
     ap.add_argument("--topic", default="raw")

@@ -228,4 +228,4 @@ def test_documented_schema_contract():
     out2 = report(intr, mk_trace(n=10, dt=10), 15, {0, 1}, {0, 1})
     internals = [s for s in out2["segment_matcher"]["segments"] if s["internal"]]
     assert internals and all("segment_id" not in s for s in internals)
-    assert "stats" in out
+    assert "stats" in out and "stats" in out2
