@@ -93,25 +93,46 @@ def global_batch(mesh, arrays):
 
 
 def run_dryrun(coordinator: str, num_processes: int, process_id: int,
-               rows: int = 5, cols: int = 5, T: int = 16) -> dict:
+               rows: int = 5, cols: int = 5, T: int = 16,
+               graph_devices: int = 1) -> dict:
     """Build a tiny deterministic scenario, match a global batch over ALL
     hosts' devices through the standard sharded program, and return
     {"devices", "local_devices", "batch", "matched", "hist_total"} —
     values derived from globally-reduced state, so every process must
-    return identical numbers (the test asserts it)."""
+    return identical numbers (the test asserts it).
+
+    ``graph_devices`` > 1 shards the UBODT's bucket ranges over a gp mesh
+    axis spanning the global device set — with more processes than the gp
+    axis fits in one host, the per-probe pmin/pmax collectives cross the
+    process boundary (DCN on pods, Gloo on CPU): the distributed-table
+    story end to end."""
     jax = init_multihost(coordinator, num_processes, process_id)
     import numpy as np
 
     from ..ops.viterbi import MatchParams
     from ..synth.generator import dryrun_scenario, example_grid_batch
-    from .mesh import make_mesh, sharded_match_fn
+    from .mesh import (
+        GRAPH_AXIS, check_ubodt_shardable, graph_sharded_match_fn,
+        make_mesh, make_mesh2, sharded_match_fn,
+    )
 
     cfg, arrays, ubodt = dryrun_scenario(rows=rows, cols=cols)
 
-    mesh = make_mesh()  # all global devices
     n_dev = jax.device_count()
     S = len(arrays.seg_ids)
-    fn = sharded_match_fn(mesh, cfg.beam_k, S)
+    n_gp = int(graph_devices)
+    if n_gp < 1:
+        raise ValueError("graph_devices must be >= 1, got %d" % n_gp)
+    if n_gp > 1:
+        if n_dev % n_gp:
+            raise ValueError("graph_devices=%d must divide device count %d"
+                             % (n_gp, n_dev))
+        check_ubodt_shardable(ubodt, n_gp)
+        mesh = make_mesh2(n_dev // n_gp, n_gp)
+        fn = graph_sharded_match_fn(mesh, cfg.beam_k, S)
+    else:
+        mesh = make_mesh()  # all global devices
+        fn = sharded_match_fn(mesh, cfg.beam_k, S)
 
     B = 2 * n_dev
     px, py, times, valid = example_grid_batch(arrays, B, T, seed=3)
@@ -119,27 +140,27 @@ def run_dryrun(coordinator: str, num_processes: int, process_id: int,
 
     to_host = lambda tree: jax.tree_util.tree_map(np.asarray, tree)
     dg = put_global(mesh, P(), to_host(arrays.to_device()))
-    du = put_global(mesh, P(), to_host(ubodt.to_device()))
+    # gp mode: the table's bucket ranges live 1/n_gp per mesh column
+    du_spec = P(GRAPH_AXIS) if n_gp > 1 else P()
+    du = put_global(mesh, du_spec, to_host(ubodt.to_device()))
     p = put_global(mesh, P(), to_host(MatchParams.from_config(cfg)))
     jpx, jpy, jtm, jvalid = global_batch(mesh, (px, py, times, valid))
 
     res, hist = fn(dg, du, jpx, jpy, jtm, jvalid, p)
     jax.block_until_ready(hist)
 
-    # res is dp-sharded (only local shards addressable); count local matches
-    # then reduce across processes via the already-replicated histogram plus
-    # a process_allgather on the local count
-    from jax.experimental import multihost_utils
+    # res.idx is dp-sharded (and gp-replicated in gp mode, so summing local
+    # shards would double count); reduce ON DEVICE — GSPMD inserts the
+    # cross-shard (and cross-process) collective and replicates the scalar
+    import jax.numpy as jnp
 
-    local_matched = int(sum(
-        (np.asarray(s.data) >= 0).sum() for s in res.idx.addressable_shards
-    ))
-    matched = int(multihost_utils.process_allgather(
-        np.asarray([local_matched])).sum())
+    matched_arr = jax.jit(lambda a: jnp.sum((a >= 0).astype(jnp.int32)))(res.idx)
+    matched = int(np.asarray(jax.block_until_ready(matched_arr).addressable_shards[0].data))
     hist_total = float(np.asarray(hist.point_count.addressable_shards[0].data).sum())
     return {
         "devices": int(n_dev),
         "local_devices": int(jax.local_device_count()),
+        "graph_devices": n_gp,
         "batch": int(B),
         "matched": matched,
         "hist_total": hist_total,
@@ -154,14 +175,17 @@ def main(argv: Sequence[str] = None) -> int:
     ap.add_argument("--rows", type=int, default=5)
     ap.add_argument("--cols", type=int, default=5)
     ap.add_argument("--t", type=int, default=16)
+    ap.add_argument("--graph-devices", type=int, default=1,
+                    help="shard the UBODT over a gp mesh axis of this size")
     args = ap.parse_args(argv)
     out = run_dryrun(args.coordinator, args.processes, args.process_id,
-                     rows=args.rows, cols=args.cols, T=args.t)
+                     rows=args.rows, cols=args.cols, T=args.t,
+                     graph_devices=args.graph_devices)
     assert out["matched"] > 0, "multi-host dryrun matched nothing"
     assert out["hist_total"] > 0, "multi-host histogram reduction empty"
-    print("multihost dryrun ok: %(devices)d devices (%(local_devices)d local), "
-          "batch %(batch)d, %(matched)d matched points, hist_total %(hist_total).1f"
-          % out)
+    print("multihost dryrun ok: %(devices)d devices (%(local_devices)d local, "
+          "gp %(graph_devices)d), batch %(batch)d, %(matched)d matched "
+          "points, hist_total %(hist_total).1f" % out)
     return 0
 
 

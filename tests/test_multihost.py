@@ -8,6 +8,7 @@ This is the framework's multi-host story actually executing — not a
 single-process simulation.
 """
 
+import os
 import socket
 import subprocess
 import sys
@@ -21,9 +22,10 @@ def _free_port() -> int:
     return port
 
 
-def test_two_process_global_mesh():
-    import os
-
+def _run_dryrun_procs(extra_args=()):
+    """Spawn the two controller processes, collect their output, and return
+    the matching 'multihost dryrun ok' line (asserted byte-identical across
+    processes — both must have computed the same globally-reduced result)."""
     repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     port = _free_port()
     env_base = {
@@ -34,7 +36,6 @@ def test_two_process_global_mesh():
         # silence gloo's per-rank connection chatter
         "GLOO_LOG_LEVEL": "ERROR",
     }
-
     procs = []
     outs = []
     try:
@@ -44,7 +45,8 @@ def test_two_process_global_mesh():
             procs.append(subprocess.Popen(
                 [sys.executable, "-m", "reporter_tpu.parallel.multihost",
                  "--coordinator", "127.0.0.1:%d" % port,
-                 "--processes", "2", "--process-id", str(pid)],
+                 "--processes", "2", "--process-id", str(pid),
+                 *extra_args],
                 stdout=subprocess.PIPE, stderr=subprocess.STDOUT, env=env,
             ))
         for p in procs:
@@ -63,7 +65,23 @@ def test_two_process_global_mesh():
         next(ln for ln in out.splitlines() if ln.startswith("multihost dryrun ok"))
         for out in outs
     ]
+    assert lines[0] == lines[1]
+    return lines[0]
+
+
+def test_two_process_global_mesh():
     # both controllers computed over the same global mesh: 8 devices, 4
     # local each, and byte-identical globally-reduced results
-    assert lines[0] == lines[1]
-    assert "8 devices (4 local)" in lines[0]
+    line = _run_dryrun_procs()
+    assert "8 devices (4 local, gp 1)" in line
+
+
+def test_two_process_graph_sharded_mesh_cross_process():
+    """gp=8 over the two-process global mesh: with only 4 devices per
+    process, an 8-wide gp axis MUST span both processes, so every UBODT
+    probe's pmin/pmax collectives cross the process boundary — the
+    distributed-table ('DCN on pods') path end to end.  (A gp axis that
+    fits inside one host would keep the probe collectives host-local and
+    test nothing beyond the dp case.)"""
+    line = _run_dryrun_procs(("--graph-devices", "8"))
+    assert "gp 8" in line
