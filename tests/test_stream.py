@@ -323,10 +323,9 @@ def grid_matcher():
     return SegmentMatcher(network=city, config=cfg, backend="jax")
 
 
-def test_stream_end_to_end(grid_matcher, tmp_path):
-    from reporter_tpu.synth.generator import TraceSynthesizer
-
-    out = str(tmp_path / "results")
+def _grid_pipeline(grid_matcher, out):
+    """Grid-scale pipeline used by the end-to-end and garbage tests: same
+    options, same report-gate tuning for the 5x5 test grid."""
     client = LocalMatcherClient(grid_matcher, threshold_sec=15)
     pipeline = build_pipeline(
         format_config=",sv,\\|,0,1,2,3,4",
@@ -339,10 +338,17 @@ def test_stream_end_to_end(grid_matcher, tmp_path):
         transition_levels=(0, 1, 2),
         microbatch_size=4,
     )
-    # loosen the report gate to the scale of the 5x5 test grid
     pipeline.batcher.report_dist = 200
     pipeline.batcher.report_count = 8
     pipeline.batcher.report_time = 30
+    return pipeline
+
+
+def test_stream_end_to_end(grid_matcher, tmp_path):
+    from reporter_tpu.synth.generator import TraceSynthesizer
+
+    out = str(tmp_path / "results")
+    pipeline = _grid_pipeline(grid_matcher, out)
 
     synth = TraceSynthesizer(grid_matcher.arrays, seed=7)
     for v in range(3):
@@ -364,3 +370,44 @@ def test_stream_end_to_end(grid_matcher, tmp_path):
         assert lines[0] == Segment.column_layout()
         rows += len(lines) - 1
     assert rows >= pipeline.batcher.reported_pairs  # buckets may duplicate
+
+
+def test_stream_swallows_garbage_records(grid_matcher, tmp_path):
+    """The reference's swallow-and-log seam
+    (KeyedFormattingProcessor.java:39-41): arbitrary junk interleaved with
+    valid records must never sink the pipeline, and the valid records must
+    still produce their tiles."""
+    import random
+
+    from reporter_tpu.synth.generator import TraceSynthesizer
+
+    out = str(tmp_path / "results")
+    pipeline = _grid_pipeline(grid_matcher, out)
+
+    rng = random.Random(1234)
+    alphabet = "abc|,;\x00\xff{}[]\"'\\0123456789.eE+- \t"
+    def junk():
+        return "".join(rng.choice(alphabet) for _ in range(rng.randrange(0, 60)))
+
+    synth = TraceSynthesizer(grid_matcher.arrays, seed=7)
+    n_junk = 0
+    for v in range(2):
+        st = synth.synthesize(24, dt=15.0, sigma=3.0, uuid="veh-%d" % v)
+        for pt in st.trace["trace"]:
+            for _ in range(2):  # junk before every valid record
+                pipeline.feed(junk(), int(pt["time"] * 1000))
+                n_junk += 1
+            # near-miss junk: right separator count, broken fields
+            pipeline.feed("veh-x|not-a-lat|1e999|%d|nan" % int(pt["time"]),
+                          int(pt["time"] * 1000))
+            n_junk += 1
+            line = "veh-%d|%.7f|%.7f|%d|%d" % (
+                v, pt["lat"], pt["lon"], int(pt["time"]), pt["accuracy"]
+            )
+            pipeline.feed(line, int(pt["time"] * 1000))
+    pipeline.close()
+
+    assert pipeline.formatted == 48  # every valid record still made it
+    assert pipeline.dropped == n_junk  # every junk record swallowed
+    files = glob.glob(os.path.join(out, "*", "*", "*", "*"))
+    assert files, "garbage starved the pipeline of its valid tiles"
