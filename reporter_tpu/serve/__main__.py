@@ -60,13 +60,34 @@ def main(argv):
     )
     httpd = service.make_server(host, int(port))
     logging.info("reporter_tpu service on %s:%s (backend=%s)", host, port, matcher.backend)
-    # pre-compile the hot shapes AFTER binding (clients queue in the accept
-    # backlog rather than getting refused); "warmup": false disables
-    if conf.get("warmup", True):
-        matcher.warmup()
+
+    # containers stop with SIGTERM: stop accepting, let in-flight handlers
+    # finish (non-daemon handler threads + block_on_close make server_close
+    # join them), and exit 0.  A handler wedged past the container's stop
+    # grace period is the runtime's SIGKILL to take.
+    import signal
+
+    httpd.daemon_threads = False
+    httpd.block_on_close = True
+
+    def _term(signum, frame):
+        raise KeyboardInterrupt
+
     try:
+        signal.signal(signal.SIGTERM, _term)
+    except ValueError:  # not the main thread (embedded use): skip
+        pass
+
+    try:
+        # pre-compile the hot shapes AFTER binding (clients queue in the
+        # accept backlog rather than getting refused); "warmup": false
+        # disables.  Inside the try: a SIGTERM during the warmup compiles
+        # (tens of seconds cold) must also shut down cleanly.
+        if conf.get("warmup", True):
+            matcher.warmup()
         httpd.serve_forever()
     except KeyboardInterrupt:
+        logging.info("shutting down (signal)")
         httpd.server_close()
     return 0
 
