@@ -63,20 +63,19 @@ def main(argv):
 
     # containers stop with SIGTERM: stop accepting, let in-flight handlers
     # finish (non-daemon handler threads + block_on_close make server_close
-    # join them), and exit 0.  A handler wedged past the container's stop
-    # grace period is the runtime's SIGKILL to take.
-    import signal
+    # join them; the per-connection idle timeout set in make_server bounds
+    # how long an idle keep-alive client can hold the join), and exit 0.
+    # The handler disarms after the first signal, so a second SIGTERM
+    # force-terminates rather than unwinding the cleanup; anything wedged
+    # past the container's stop grace period is the runtime's SIGKILL to
+    # take.  serve_forever's select loop (handlers on other threads) is the
+    # one place an async KeyboardInterrupt is safe — the stream CLIs use
+    # the cooperative StopFlag instead (utils/shutdown.py).
+    from ..utils.shutdown import term_to_keyboard_interrupt
 
     httpd.daemon_threads = False
     httpd.block_on_close = True
-
-    def _term(signum, frame):
-        raise KeyboardInterrupt
-
-    try:
-        signal.signal(signal.SIGTERM, _term)
-    except ValueError:  # not the main thread (embedded use): skip
-        pass
+    term_to_keyboard_interrupt()
 
     try:
         # pre-compile the hot shapes AFTER binding (clients queue in the

@@ -268,6 +268,11 @@ class ReporterService:
 
         class Handler(BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"
+            # idle keep-alive connections time out: without this, a handler
+            # thread blocks forever in readline() between requests, and a
+            # graceful shutdown joining non-daemon handlers (serve/__main__)
+            # would hang on any idle persistent client
+            timeout = 30
 
             def _answer(self, code: int, payload: dict):
                 body = json.dumps(payload, separators=(",", ":")).encode("utf-8")
@@ -278,13 +283,24 @@ class ReporterService:
                 self.end_headers()
                 self.wfile.write(body)
 
+            def _content_length(self):
+                """Parsed Content-Length, or None for a malformed header.
+                Malformed means the body extent is unknowable: the caller
+                must close the connection (keep-alive framing is lost)."""
+                raw = self.headers.get("Content-Length", "0")
+                try:
+                    return max(0, int(raw))
+                except (TypeError, ValueError):
+                    self.close_connection = True
+                    return None
+
             def _drain_body(self, post: bool):
                 """Consume any request body before an early answer: the
                 server speaks HTTP/1.1 keep-alive, so unread body bytes
                 would be parsed as the NEXT request line on this socket."""
                 if post:
-                    n = int(self.headers.get("Content-Length", 0))
-                    if n > 0:
+                    n = self._content_length()
+                    if n:
                         self.rfile.read(n)
 
             def _route(self, post: bool):
@@ -300,14 +316,30 @@ class ReporterService:
                         self._drain_body(post)
                         return self._answer(*service.handle_health())
                     if post:
-                        n = int(self.headers.get("Content-Length", 0))
+                        n = self._content_length()
+                        if n is None:  # malformed header: framing unknown
+                            return self._answer(
+                                400, {"error": "invalid Content-Length"})
                         payload = json.loads(self.rfile.read(n).decode("utf-8"))
                     else:
                         params = parse_qs(split.query)
                         if "json" not in params:
                             return self._answer(400, {"error": "No json provided"})
                         payload = json.loads(params["json"][0])
+                except OSError as e:
+                    # the BODY read failed (idle/stall timeout, reset): the
+                    # stream position is unknown, so a keep-alive follow-up
+                    # would parse leftover bytes as a request line — close.
+                    # The reply is best-effort: on a peer reset the write
+                    # raises too, and a dropped client must not traceback.
+                    self.close_connection = True
+                    try:
+                        return self._answer(400, {"error": str(e)})
+                    except OSError:
+                        return None
                 except Exception as e:
+                    # parse errors AFTER a complete read leave the stream
+                    # clean; the connection stays usable
                     return self._answer(400, {"error": str(e)})
 
                 try:
@@ -337,7 +369,13 @@ class ReporterService:
             def log_message(self, fmt, *args):
                 log.debug("http: " + fmt, *args)
 
-        return ThreadingHTTPServer((host, port), Handler)
+        class Server(ThreadingHTTPServer):
+            # socketserver's default listen backlog is 5: a burst of
+            # concurrent clients (the micro-batcher's whole operating
+            # point) overflows it and the kernel RSTs the excess connects
+            request_queue_size = 128
+
+        return Server((host, port), Handler)
 
 
 def load_service_config(path: str, backend: Optional[str] = None) -> Tuple[SegmentMatcher, dict]:

@@ -102,15 +102,67 @@ def main(argv=None) -> int:
             runner=runner,
         )
     else:
-        start = time.time()
-        for line in sys.stdin:
-            now_ms = int(time.time() * 1000)
-            pipeline.feed(line.rstrip("\n"), now_ms)
-            ckpt.maybe_save(now_ms)
-            if args.duration is not None and time.time() - start > args.duration:
-                break
-        pipeline.close(int(time.time() * 1000))
-        ckpt.save()
+        # stdin transport: a stop signal (docker SIGTERM, Ctrl-C) must still
+        # flush half-grown state, and it must do so WITHOUT interrupting a
+        # pipeline mutation mid-flight (the kafka path's documented hazard:
+        # a raise-based handler could snapshot half-applied state).  The
+        # handler only sets a flag; the read loop polls it between records
+        # via a selectors timeout — a pure flag never wakes a blocking
+        # readline (PEP 475 retries it), so stdin is read non-blockingly.
+        # close()+save run only on a CLEAN stop (flag/EOF/duration): a crash
+        # must not overwrite the last good snapshot with drained state.
+        import selectors
+
+        from ..utils.shutdown import StopFlag
+
+        flag = StopFlag().install()
+        fd = sys.stdin.buffer.raw.fileno()
+        # epoll cannot watch REGULAR files (EPERM on `cli < probes.sv`);
+        # file reads never block indefinitely, so the selector — needed for
+        # pipe liveness under a stop signal — is skipped for them
+        sel = None
+        try:
+            try:
+                sel = selectors.DefaultSelector()
+                sel.register(sys.stdin.buffer.raw, selectors.EVENT_READ)
+            except (PermissionError, ValueError):
+                if sel is not None:
+                    sel.close()
+                sel = None
+            start = time.time()
+            buf = b""
+            eof = False
+            while not (flag.requested or eof):
+                now = time.time()
+                if args.duration is not None and now - start > args.duration:
+                    break
+                if sel is not None and not sel.select(timeout=0.5):
+                    ckpt.maybe_save(int(now * 1000))
+                    continue
+                chunk = os.read(fd, 1 << 16)
+                if not chunk:
+                    eof = True
+                else:
+                    buf += chunk
+                now_ms = int(time.time() * 1000)
+                *lines, buf = buf.split(b"\n")
+                for raw in lines:
+                    pipeline.feed(raw.decode("utf-8", "replace").rstrip("\r"), now_ms)
+                ckpt.maybe_save(now_ms)
+            if buf and eof:  # trailing record without newline
+                pipeline.feed(buf.decode("utf-8", "replace").rstrip("\r"),
+                              int(time.time() * 1000))
+            if flag.requested:
+                logging.info("stop signal: flushing before exit")
+            pipeline.close(int(time.time() * 1000))
+            ckpt.save()
+        finally:
+            # embedders may call main() repeatedly: give back the signal
+            # handlers and the selector fd (close/save above run only on a
+            # clean stop — a crash must not overwrite the last snapshot)
+            flag.restore()
+            if sel is not None:
+                sel.close()
     return 0
 
 

@@ -146,24 +146,18 @@ def run_pipeline(
                 runner.on_assigned([tp.partition for tp in assigned])
 
         consumer.subscribe([topic], listener=_Listener())
-    import signal
-    import threading
-
     # Graceful shutdown (docker stop SIGTERM, Ctrl-C SIGINT) must reach the
     # final snapshot+commit below -- but a signal must never interrupt
     # pipeline.feed mid-mutation and then have the half-applied state
-    # snapshotted and committed past.  So the handlers only SET A FLAG; the
-    # loop checks it between messages, making shutdown deterministic.
-    # Handler installation only works from the main thread; elsewhere a
-    # raised KeyboardInterrupt still exits, but lands in the no-commit path.
-    stop_requested = False
-    prev_handlers = []
-    if threading.current_thread() is threading.main_thread():
-        def _on_signal(signum, frame):
-            nonlocal stop_requested
-            stop_requested = True
-        for sig in (signal.SIGTERM, signal.SIGINT):
-            prev_handlers.append((sig, signal.signal(sig, _on_signal)))
+    # snapshotted and committed past.  So the handlers only SET A FLAG
+    # (utils/shutdown.StopFlag; escalate means a second signal
+    # force-terminates a wedged drain); the loop checks it between
+    # messages, making shutdown deterministic.  Handler installation
+    # no-ops off the main thread; there a raised KeyboardInterrupt still
+    # exits, but lands in the no-commit path.
+    from ..utils.shutdown import StopFlag
+
+    stop_flag = StopFlag().install()
 
     start = time.time()
     last_tick = start
@@ -175,9 +169,9 @@ def run_pipeline(
                     time.time() * 1000
                 )
                 pipeline.feed(msg.value, ts_ms, partition=msg.partition)
-                if stop_requested or time.time() - last_tick >= tick_sec:
+                if stop_flag.requested or time.time() - last_tick >= tick_sec:
                     break
-            if stop_requested:
+            if stop_flag.requested:
                 log.info("shutdown requested; flushing final state")
                 break
             now = time.time()
@@ -207,8 +201,7 @@ def run_pipeline(
         # replays from its offsets (dupes allowed, loss and corruption not).
         log.info("async interrupt; exiting without snapshot or commit")
     finally:
-        for sig, h in prev_handlers:
-            signal.signal(sig, h)
+        stop_flag.restore()
         if graceful:
             if runner is not None:
                 # hand-off shutdown: snapshot owned partitions (the next
