@@ -8,15 +8,16 @@ Prints exactly ONE JSON line to stdout:
 Three roles in one file (BENCH_ROLE env):
 
   orchestrator (default)  never initialises a jax backend.  It launches the
-      CPU-oracle baseline subprocess IMMEDIATELY and, concurrently, the
-      device-worker subprocess -- so the reference-point measurement and the
-      scenario build overlap the accelerator wait instead of idling behind
-      it (VERDICT r02 next #1a).  It watches the axon loopback-relay ports
-      to DIAGNOSE a stalled grant (no listener = no chance of a grant; the
-      state is reported in the JSON instead of a bare timeout, #1b), kills
-      a hopeless attempt early, falls back to a CPU device run, and retries
-      the accelerator once more afterwards if the relay has appeared
-      (#1c/#1d).
+      CPU-oracle baseline subprocess IMMEDIATELY and then runs an
+      acquisition schedule over the FULL BENCH_TPU_WAIT budget (default 2 h;
+      VERDICT r04 next #1 -- round 4 gave up after 180 s and missed a relay
+      that returned hours later): poll the axon loopback-relay ports (a
+      connect() costs microseconds; no listener = no chance of a grant),
+      spawn a device attempt whenever a port listens, bank a CPU-device
+      fallback result early while the relay is down, and keep retrying
+      until an accelerator result lands or the budget expires.  Device
+      workers serialise on a cross-process flock so a concurrent watcher
+      bench cannot wedge the single-client tunnel.
 
   device  acquires the backend under a watchdog thread while the metro
       scenario builds on the main thread (the build is numpy+native C++;
@@ -50,8 +51,14 @@ import sys
 import tempfile
 import time
 
-WAIT_DEFAULT = 600.0  # budget for the accelerator grant (relay present)
-GRACE_DEFAULT = 180.0  # budget when no relay is listening at all
+# Total accelerator budget: the orchestrator polls the relay ports (a
+# connect() costs microseconds) and retries device attempts for this long
+# before settling for the banked CPU fallback.  Hours, not minutes: round 4
+# lost its official TPU number to a relay that flapped back 8 h later while
+# the bench had given up after 180 s (VERDICT r04 next #1).
+WAIT_DEFAULT = 7200.0
+# Per-attempt grant budget once a relay port is listening.
+ATTEMPT_WAIT_DEFAULT = 600.0
 
 
 def _stderr(msg: str) -> None:
@@ -162,9 +169,29 @@ def run_device() -> int:
 
     ensure_platform()
     want = os.environ.get("JAX_PLATFORMS", "")
-    wait_s = float(os.environ.get("BENCH_ACQUIRE_WAIT", str(WAIT_DEFAULT)))
+    wait_s = float(os.environ.get("BENCH_ACQUIRE_WAIT", str(ATTEMPT_WAIT_DEFAULT)))
 
     import threading
+
+    # serialise axon clients across processes (watcher vs driver bench): the
+    # tunnel serves one client; a second concurrent init wedges both.  The
+    # lock is held until this worker exits.
+    _axon_lock = None
+    if want != "cpu":
+        from reporter_tpu.utils.relay import acquire_axon_lock, axon_lock_holder
+
+        t0 = time.time()
+        while _axon_lock is None and time.time() - t0 < wait_s:
+            _axon_lock = acquire_axon_lock(timeout=15.0)
+            if _axon_lock is None:
+                _write_status(phase="waiting_for_lock", platform=None,
+                              holder=axon_lock_holder())
+                _stderr("axon client lock held by pid %s; waiting"
+                        % (axon_lock_holder(),))
+        if _axon_lock is None:
+            _stderr("axon client lock not acquired within %.0fs" % wait_s)
+            _write_status(phase="failed", platform=None, error="lock_timeout")
+            return 5
 
     acquired: dict = {}
 
@@ -250,12 +277,24 @@ def run_device() -> int:
     warmup_s = time.time() - t0
     _stderr("warmup/compile %.1fs" % warmup_s)
 
-    # end-to-end throughput (device viterbi + parallel host association)
+    # end-to-end throughput, steady-state pipelined: fleet rep N+1 is
+    # dispatched before rep N's association finishes, exactly how the
+    # service's MicroBatcher overlaps batches in production (max_inflight
+    # 2).  Round 4 measured the reps serially, so the device idled through
+    # every rep's association + fetch quanta -- device_util 0.45 with a
+    # kernel twice as fast as e2e (VERDICT r04 next #2b).
     _write_status(phase="benching", step="e2e", platform=platform)
     reps = int(os.environ.get("BENCH_REPS", "3"))
+    from collections import deque as _deque
+
+    finishes: "_deque" = _deque()
     t0 = time.time()
     for _ in range(reps):
-        matcher.match_many(traces)
+        finishes.append(matcher.match_many_async(traces))
+        if len(finishes) > 1:
+            finishes.popleft()()  # associate rep N-1 under rep N's compute
+    while finishes:
+        finishes.popleft()()
     e2e_wall = time.time() - t0
     tps = n_traces * reps / e2e_wall
     pps = n_points_total * reps / e2e_wall
@@ -478,8 +517,11 @@ def run_device() -> int:
             _stderr("pallas on-chip check failed: %s" % (pallas_info["error"],))
 
     # accuracy: segment agreement vs ground truth, every cohort (VERDICT r02
-    # weak #8) -- matched edges from the same compact/carry programs
+    # weak #8) -- matched edges from the same compact/carry programs.
+    # Per-trace values are kept so the oracle section below can subset them
+    # for an apples-to-apples device-vs-oracle agreement comparison.
     agreement = {}
+    agr_per_trace = {}
     _write_status(phase="benching", step="agreement", platform=platform)
     for cname, T, ss in cohorts:
         px, py, tm, valid = cohort_xy[cname]
@@ -488,36 +530,68 @@ def run_device() -> int:
         else:
             fn, args = _compact_args(px, py, tm, valid)
             edge = unpack_compact(fn(*args, cfg.beam_k))[0][: len(ss)]
-        agreement[cname] = round(
-            float(np.mean([segment_agreement(arrays, edge[i], ss[i]) for i in range(len(ss))])), 4
-        )
+        agr_per_trace[cname] = [
+            segment_agreement(arrays, edge[i], ss[i]) for i in range(len(ss))
+        ]
+        agreement[cname] = round(float(np.mean(agr_per_trace[cname])), 4)
     agr_mean = float(np.mean(list(agreement.values())))
     _stderr("segment agreement vs truth: %s (mean %.3f)" % (agreement, agr_mean))
 
-    # device-vs-oracle agreement on real traces (the "at equal
-    # OSMLR-segment agreement" clause of the north star, BASELINE.md):
-    # match a small mixed subset on the CPU oracle and diff the wire-format
-    # segment sequences the two backends emit
+    # device-vs-oracle on real fleet traces (the "at equal OSMLR-segment
+    # agreement" clause of the north star, BASELINE.md): diff the
+    # wire-format segment sequences the two backends emit over >= 100
+    # traces (VERDICT r04 next #3; round 4's 6-trace sample was too thin to
+    # carry the clause), and report the oracle's own agreement-vs-truth
+    # next to the device's on the SAME subset so "at equal agreement" is
+    # shown, not asserted.
     oracle_cmp = None
     try:
         from reporter_tpu.matching import SegmentMatcher as _SM
 
-        subset = ([s.trace for s in cohorts[0][2][:4]]
-                  + [s.trace for s in cohorts[1][2][:2]])
+        n_sub = {"short": int(os.environ.get("BENCH_ORACLE_SHORT", "80")),
+                 "med": int(os.environ.get("BENCH_ORACLE_MED", "16")),
+                 "long": int(os.environ.get("BENCH_ORACLE_LONG", "4"))}
+        subset = []
+        for cname, _T, ss in cohorts:
+            subset.extend(s.trace for s in ss[: n_sub[cname]])
         cpum = _SM(arrays=arrays, ubodt=ubodt, config=cfg, backend="cpu")
         dev_out = matcher.match_many(subset)
+        t0 = time.time()
         cpu_out = cpum.match_many(subset)
+        oracle_secs = time.time() - t0
         ids = lambda r: [s.get("segment_id") for s in r["segments"]]
         exact = sum(d == c for d, c in zip(dev_out, cpu_out))
         id_match = sum(ids(d) == ids(c) for d, c in zip(dev_out, cpu_out))
+
+        # oracle-vs-truth per cohort on the subset rows, next to the
+        # device-vs-truth values for the same rows
+        oracle_agr = {}
+        device_agr_sub = {}
+        for cname, T, ss in cohorts:
+            k = min(n_sub[cname], len(ss))
+            if not k:
+                continue
+            px, py, tm, valid = cohort_xy[cname]
+            cedge, _coff, _cbrk = cpum._cpu.run_batch(
+                px[:k], py[:k], tm[:k], valid[:k])
+            oracle_agr[cname] = round(float(np.mean(
+                [segment_agreement(arrays, cedge[i], ss[i]) for i in range(k)]
+            )), 4)
+            device_agr_sub[cname] = round(
+                float(np.mean(agr_per_trace[cname][:k])), 4)
         oracle_cmp = {
             "traces": len(subset),
             "identical_records": exact,
             "identical_segment_ids": id_match,
+            "oracle_agreement_by_cohort": oracle_agr,
+            "device_agreement_by_cohort": device_agr_sub,
+            "oracle_secs": round(oracle_secs, 1),
         }
         _stderr("device vs cpu oracle: %d/%d identical records, %d/%d "
-                "identical segment-id sequences"
-                % (exact, len(subset), id_match, len(subset)))
+                "identical segment-id sequences (%.1fs oracle); "
+                "agreement oracle %s vs device %s"
+                % (exact, len(subset), id_match, len(subset), oracle_secs,
+                   oracle_agr, device_agr_sub))
     except Exception as e:  # noqa: BLE001 - diagnostics must not sink the bench
         _stderr("oracle comparison failed: %s" % (e,))
 
@@ -530,6 +604,7 @@ def run_device() -> int:
         "p95_latency_ms": round(p95_ms, 2),
         "dispatch_floor_ms": round(floor_ms, 2),
         "latency_cohort": "short64",
+        "e2e_mode": "pipelined_overlap2",
         "forward": forward,
         "forward_by_cohort": forward_by_cohort,
         "kernel_traces_per_sec": round(kernel_tps, 1),
@@ -737,6 +812,8 @@ def _monitor_device(proc, status_file, wait_s, grace_s, attempts_log, gate=None)
     it (hopeless: no relay and grace expired, or wait_s expired)."""
     t0 = time.time()
     port_seen = False
+    lock_wait_s = 0.0
+    last_poll = time.time()
     while True:
         if gate is not None:
             gate.poll()
@@ -747,7 +824,15 @@ def _monitor_device(proc, status_file, wait_s, grace_s, attempts_log, gate=None)
         port_seen = port_seen or bool(ports)
         if st.get("phase") in ("waiting_for_baseline", "benching"):
             return True  # backend acquired; bench phase gated on the baseline
-        waited = time.time() - t0
+        now = time.time()
+        if st.get("phase") == "waiting_for_lock":
+            # time spent queueing behind another axon client (e.g. the
+            # watcher's own bench) is not acquisition time: extend the kill
+            # budget by it, else a genuine grant after the lock clears is
+            # killed mid-init
+            lock_wait_s += now - last_poll
+        last_poll = now
+        waited = time.time() - t0 - lock_wait_s
         if not port_seen and waited > grace_s:
             attempts_log.append({"outcome": "killed_no_relay", "waited_s": round(waited, 1),
                                  "ports_open": ports})
@@ -773,7 +858,7 @@ def main() -> int:
     # ---- orchestrator ----
     want_cpu = os.environ.get("JAX_PLATFORMS", "") == "cpu"
     wait_s = float(os.environ.get("BENCH_TPU_WAIT", str(WAIT_DEFAULT)))
-    grace_s = float(os.environ.get("BENCH_TPU_GRACE", str(GRACE_DEFAULT)))
+    attempt_wait = float(os.environ.get("BENCH_TPU_GRACE", str(ATTEMPT_WAIT_DEFAULT)))
     run_budget = float(os.environ.get("BENCH_RUN_BUDGET", "2400"))
     tmpdir = tempfile.mkdtemp(prefix="bench_")
     go_file = os.path.join(tmpdir, "baseline_done")
@@ -791,45 +876,74 @@ def main() -> int:
 
     gate = BaselineGate(_spawn("baseline", {"JAX_PLATFORMS": "cpu"}), go_file)
 
-    device_json = None
-    if not want_cpu:
-        _stderr("attempt 1: device worker on axon (wait %.0fs, grace %.0fs if no relay)"
-                % (wait_s, grace_s))
-        sf = status_path("axon1")
-        proc = _spawn("device", {"JAX_PLATFORMS": "axon",
-                                 "BENCH_ACQUIRE_WAIT": str(wait_s),
-                                 "BENCH_GO_FILE": go_file}, sf)
-        if _monitor_device(proc, sf, wait_s + 60, grace_s, attempts, gate):
-            gate.ensure(300)  # free the cores, then let the worker bench
-            rc, device_json = _finish_device(proc, run_budget, sf)
-            attempts.append({"outcome": "completed" if device_json else "died",
-                             "rc": rc, "platform": (device_json or {}).get("platform")})
-            if device_json and device_json.get("platform") == "cpu":
-                _stderr("axon attempt yielded cpu devices; keeping result but noting it")
-    if device_json is None:
-        # the CPU device run contends for the same cores as the baseline:
+    def _run_cpu_fallback():
+        # the CPU device run contends for the same core as the baseline:
         # finish the baseline's timed window before spawning it
         gate.ensure(300)
-        _stderr("device run on cpu (fallback or requested)")
+        _stderr("banking CPU fallback result")
         proc = _spawn("device", {"JAX_PLATFORMS": "cpu", "BENCH_ACQUIRE_WAIT": "120",
                                  "BENCH_GO_FILE": go_file}, status_path("cpu"))
-        rc, device_json = _finish(proc, run_budget)
-        attempts.append({"outcome": "cpu_fallback_completed" if device_json else "cpu_fallback_died",
+        rc, dj = _finish(proc, run_budget)
+        attempts.append({"outcome": "cpu_fallback_completed" if dj else "cpu_fallback_died",
                          "rc": rc})
-        # second chance: the relay may have appeared while the CPU run was
-        # on; one more short accelerator attempt, preferring its result
-        if not want_cpu and _relay_ports_open():
-            _stderr("relay is up now; second accelerator attempt")
-            sf = status_path("axon2")
-            proc = _spawn("device", {"JAX_PLATFORMS": "axon",
-                                     "BENCH_ACQUIRE_WAIT": "300",
-                                     "BENCH_GO_FILE": go_file}, sf)
-            if _monitor_device(proc, sf, 360, 120, attempts, gate):
-                rc, retry_json = _finish_device(proc, run_budget, sf)
-                attempts.append({"outcome": "completed" if retry_json else "died",
-                                 "rc": rc, "platform": (retry_json or {}).get("platform")})
-                if retry_json and retry_json.get("platform") not in (None, "cpu"):
-                    device_json = retry_json
+        return dj
+
+    def _attempt_accel(tag):
+        """One accelerator attempt (relay port is listening).  Returns the
+        worker's JSON or None."""
+        sf = status_path(tag)
+        proc = _spawn("device", {"JAX_PLATFORMS": "axon",
+                                 "BENCH_ACQUIRE_WAIT": str(attempt_wait),
+                                 "BENCH_GO_FILE": go_file}, sf)
+        if not _monitor_device(proc, sf, attempt_wait + 60, attempt_wait,
+                               attempts, gate):
+            return None
+        gate.ensure(300)  # free the core, then let the worker bench
+        rc, dj = _finish_device(proc, run_budget, sf)
+        attempts.append({"outcome": "completed" if dj else "died",
+                         "rc": rc, "platform": (dj or {}).get("platform")})
+        return dj
+
+    # acquisition schedule (VERDICT r04 next #1): poll the relay ports for
+    # the FULL wait budget, attempting only when a port listens (no listener
+    # = no chance of a grant).  A CPU fallback is banked early while the
+    # relay is down so budget exhaustion still prints a result; an on-accel
+    # result always supersedes it.
+    tpu_json = None
+    cpu_json = None
+    cpu_banked = False  # one banking attempt only: a dying fallback must not respawn in a tight loop
+    deadline = time.time() + wait_s
+    attempt_n = 0
+    cooldown_until = 0.0
+    last_log = 0.0
+    while not want_cpu and tpu_json is None and time.time() < deadline:
+        gate.poll()
+        ports = _relay_ports_open()
+        if ports and time.time() >= cooldown_until:
+            attempt_n += 1
+            _stderr("relay %s listening; accelerator attempt %d (%.0fs of "
+                    "budget left)" % (ports, attempt_n, deadline - time.time()))
+            dj = _attempt_accel("axon%d" % attempt_n)
+            if dj and dj.get("platform") not in (None, "cpu"):
+                tpu_json = dj
+            elif dj and cpu_json is None:
+                _stderr("axon attempt yielded cpu devices; keeping as fallback")
+                cpu_json = dj
+            cooldown_until = time.time() + 120.0
+        elif not cpu_banked and not ports:
+            # relay down: bank the fallback now -- the wait continues after
+            cpu_banked = True
+            cpu_json = _run_cpu_fallback()
+        else:
+            if time.time() - last_log > 300:
+                _stderr("relay down; polling (%.0fs of budget left)"
+                        % (deadline - time.time()))
+                last_log = time.time()
+            time.sleep(10.0)
+    device_json = tpu_json or cpu_json
+    if device_json is None:
+        # want_cpu, or every accelerator attempt died without a fallback bank
+        device_json = _run_cpu_fallback()
 
     gate.ensure(run_budget)
     baseline_json = gate.json
@@ -862,7 +976,7 @@ def main() -> int:
     }
     for k in ("platform", "acquire_s", "points_per_sec", "p50_latency_ms", "p95_latency_ms",
               "dispatch_floor_ms",
-              "latency_cohort", "forward", "forward_by_cohort", "kernel_traces_per_sec",
+              "latency_cohort", "e2e_mode", "forward", "forward_by_cohort", "kernel_traces_per_sec",
               "kernel_points_per_sec", "kernel_by_cohort",
               "kernel_secs_by_cohort", "roofline", "profile_dir",
               "device_util", "warmup_s", "pallas", "agreement", "oracle_cmp", "agreement_by_cohort", "device_mb",

@@ -10,10 +10,70 @@ before spending a process on PJRT init.
 
 from __future__ import annotations
 
+import os
 import socket
-from typing import List, Tuple
+from typing import List, Optional, Tuple
 
 RELAY_PORTS: Tuple[int, ...] = (8083, 8082)
+
+# Advisory cross-process lock serialising axon clients: the tunnel serves
+# ONE client at a time, and two concurrent PJRT inits wedge both (observed
+# when the background watcher and a foreground bench raced a returning
+# relay).  Held for the lifetime of the owning process; flock releases it
+# on exit even after a crash.
+AXON_LOCK_PATH = "/tmp/reporter_tpu_axon.lock"
+
+
+def acquire_axon_lock(timeout: float = 0.0, poll: float = 2.0):
+    """Try to take the axon client lock for up to ``timeout`` seconds.
+
+    Returns the held file object (keep a reference; closing it or exiting
+    the process releases the lock) or None on timeout."""
+    import fcntl
+    import time
+
+    try:
+        f = open(AXON_LOCK_PATH, "a+")
+    except OSError:
+        # fixed /tmp path unwritable (stale file from another uid): fall
+        # back to a per-uid lock -- weaker (no cross-user exclusion) but
+        # never crashes the worker before its first status write
+        f = open("%s.%d" % (AXON_LOCK_PATH, os.getuid()), "a+")
+    t0 = time.monotonic()
+    while True:
+        try:
+            fcntl.flock(f, fcntl.LOCK_EX | fcntl.LOCK_NB)
+        except OSError:
+            if time.monotonic() - t0 >= timeout:
+                f.close()
+                return None
+            time.sleep(poll)
+            continue
+        try:  # owner pid, for operator diagnosis only
+            f.seek(0)
+            f.truncate()
+            f.write("%d\n" % os.getpid())
+            f.flush()
+        except OSError:
+            pass
+        return f
+
+
+def axon_lock_holder() -> Optional[int]:
+    """Pid recorded by the current lock holder, or None if unlocked/unknown."""
+    import fcntl
+
+    try:
+        with open(AXON_LOCK_PATH, "r+") as f:
+            try:
+                fcntl.flock(f, fcntl.LOCK_EX | fcntl.LOCK_NB)
+            except OSError:
+                txt = f.read().strip()
+                return int(txt) if txt.isdigit() else -1
+            fcntl.flock(f, fcntl.LOCK_UN)
+            return None
+    except (OSError, ValueError):
+        return None
 
 
 def port_open(port: int, timeout: float = 1.0) -> bool:

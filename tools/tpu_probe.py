@@ -6,7 +6,7 @@ matmul, prints a one-line JSON verdict, and exits 0 only if a non-CPU
 device executed it.  Used by tools/tpu_watch.py to decide whether the
 relay that just appeared is actually granting chips before committing to
 a full bench run.  Exit codes: 0 = TPU live, 2 = init timeout, 3 = init
-error, 4 = got CPU.
+error, 4 = got CPU, 5 = another axon client holds the tunnel lock.
 """
 
 from __future__ import annotations
@@ -23,6 +23,16 @@ TIMEOUT_S = float(os.environ.get("TPU_PROBE_TIMEOUT", "180"))
 def main() -> int:
     os.environ.setdefault("JAX_PLATFORMS", "axon")
     result: dict = {}
+
+    # one axon client at a time (shared flock with bench.py): probing while
+    # a bench owns the tunnel would wedge both
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    from reporter_tpu.utils.relay import acquire_axon_lock, axon_lock_holder
+
+    _lock = acquire_axon_lock(timeout=10.0)
+    if _lock is None:
+        print(json.dumps({"error": "axon lock held by pid %s" % (axon_lock_holder(),)}))
+        return 5
 
     def _init():
         try:

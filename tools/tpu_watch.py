@@ -102,6 +102,12 @@ def main() -> None:
                 [sys.executable, os.path.join(REPO, "tools", "tpu_probe.py")],
                 env, 240, os.path.join(REPO, "tpu_probe_out.json"))
             runs.append({"what": "probe", "rc": rc, "ts": time.strftime("%H:%M:%S")})
+            if rc == 5:
+                # another axon client (most likely the driver's own bench)
+                # owns the tunnel lock; stand well clear of it
+                log("axon lock held elsewhere; backing off 10 min")
+                next_attempt_ok = time.time() + 600
+                continue
             if rc == 0:
                 env2 = dict(env)
                 env2["BENCH_TPU_WAIT"] = "600"
