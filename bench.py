@@ -23,8 +23,7 @@ Three roles in one file (BENCH_ROLE env):
       scenario builds on the main thread (the build is numpy+native C++;
       jax is first touched after the grant).  Then: end-to-end throughput,
       p50/p95 single-trace latency, per-cohort kernel-only throughput and
-      agreement, device utilisation, and -- on TPU -- scan-vs-pallas
-      on-chip parity and throughput (VERDICT r02 next #2).
+      agreement, and device utilisation.
 
   baseline  the reference operating point: the single-process CPU oracle
       (one Meili C++ engine per process, reporter_service.py:52,240;
@@ -251,7 +250,6 @@ def run_device() -> int:
     import jax.numpy as jnp
 
     from reporter_tpu.matching import MatcherConfig, SegmentMatcher
-    from reporter_tpu.matching.matcher import _pad_rows
     from reporter_tpu.synth.generator import segment_agreement
 
     cfg = MatcherConfig()
@@ -268,9 +266,8 @@ def run_device() -> int:
     _stderr("device-resident graph+ubodt: %.0f MB" % hbm_mb)
 
     t0 = time.time()
-    # warm only the single-trace latency shape (bucket 64) plus the
-    # measured scan-vs-pallas gate; the fleet pass below compiles every
-    # batched shape the bench actually dispatches
+    # warm only the single-trace latency shape (bucket 64); the fleet
+    # pass below compiles every batched shape the bench actually dispatches
     _write_status(phase="benching", step="warmup", platform=platform)
     matcher.warmup(lengths=[64])
     matcher.match_many(traces)
@@ -334,25 +331,20 @@ def run_device() -> int:
     # device time -> device_util = device_time / e2e wall (association and
     # dispatch overhead are the rest).
     dg, du, params = matcher._dg, matcher._du, matcher._params
-    pallas_on = bool(getattr(matcher, "_pallas", False))
 
     forward_by_cohort = {}
 
     from reporter_tpu.ops.viterbi import pack_inputs, unpack_compact
 
     def _compact_args(px, py, tm, valid, cohort=None):
-        # mirror SegmentMatcher._dispatch_batch's forward selection AND
-        # batch padding (ladder first, then the pallas block rule) so the
+        # mirror SegmentMatcher._dispatch_batch's batch padding so the
         # kernel-only timing measures exactly the shapes/program e2e
         # dispatches even when env overrides pick off-rung cohort sizes.
-        # Both forwards speak the packed transport ([4,B,T] in, [3,B,T] out).
+        # The forward speaks the packed transport ([4,B,T] in, [3,B,T] out).
         px, py, tm, valid = SegmentMatcher._pad_batch(px, py, tm, valid)
-        B = px.shape[0]
-        # ladder rungs >= 128 are all block multiples, so no extra %128 pad
-        use_pallas = matcher._jit_match_pallas is not None and B >= 128
-        fn = matcher._jit_match_pallas if use_pallas else matcher._jit_match_scan
+        fn = matcher._jit_match_scan
         if cohort:
-            forward_by_cohort[cohort] = "pallas" if use_pallas else "scan"
+            forward_by_cohort[cohort] = "scan"
         return fn, (dg, du, jnp.asarray(pack_inputs(px, py, tm, valid)), params)
 
     # HBM-traffic model for the roofline (VERDICT r03 weak #5): the two
@@ -470,51 +462,9 @@ def run_device() -> int:
     kernel_pps = n_points_total / kernel_secs
     device_util = min(1.0, kernel_secs / (e2e_wall / reps))
     forward_by_cohort["long"] = "carry-scan"
-    forward = "pallas" if pallas_on else "scan"  # availability; per-cohort below
-    _stderr("kernel-only %.1f traces/s / %.0f pts/s (%s forward); e2e %.1f "
+    _stderr("kernel-only %.1f traces/s / %.0f pts/s; e2e %.1f "
             "traces/s (%.0f pts/s); device util %.2f"
-            % (kernel_tps, kernel_pps, forward, tps, pps, device_util))
-
-    # scan-vs-pallas on real hardware (VERDICT r02 next #2): bit-parity of
-    # matched edges + throughput of both forwards on the short cohort
-    pallas_info = None
-    _write_status(phase="benching", step="pallas_cmp", platform=platform)
-    if platform == "tpu" and cfg.beam_k == 8:
-        from reporter_tpu.ops.viterbi import match_batch_compact
-        from reporter_tpu.ops.viterbi_pallas import match_batch_compact_pallas
-
-        px, py, tm, valid = cohort_xy["short"]
-        pad = (-len(px)) % 128
-        if pad:
-            px, py, tm, valid = _pad_rows(pad, px, py, tm, valid)
-        args = (dg, du, jnp.asarray(px), jnp.asarray(py), jnp.asarray(tm),
-                jnp.asarray(valid), params)
-        jit_scan = jax.jit(match_batch_compact, static_argnums=(7,))
-        jit_pls = jax.jit(
-            lambda *a: match_batch_compact_pallas(*a[:7], a[7], interpret=False),
-            static_argnums=(7,))
-        try:
-            r_scan = jit_scan(*args, cfg.beam_k)
-            r_pls = jit_pls(*args, cfg.beam_k)
-            jax.block_until_ready((r_scan.edge, r_pls.edge))
-            agree = float(np.mean(np.asarray(r_scan.edge) == np.asarray(r_pls.edge)))
-            times = {}
-            for label, fn in (("scan", jit_scan), ("pallas", jit_pls)):
-                t0 = time.time()
-                for _ in range(reps):
-                    r = fn(*args, cfg.beam_k)
-                np.asarray(r.edge)  # fetch bounds all reps (in-order queue)
-                times[label] = len(px) * reps / (time.time() - t0)
-            pallas_info = {
-                "parity": round(agree, 6),
-                "scan_traces_per_sec": round(times["scan"], 1),
-                "pallas_traces_per_sec": round(times["pallas"], 1),
-            }
-            _stderr("pallas on-chip: parity %.4f, scan %.1f tr/s, pallas %.1f tr/s"
-                    % (agree, times["scan"], times["pallas"]))
-        except Exception as e:  # noqa: BLE001 - report, don't sink the bench
-            pallas_info = {"error": "%s: %s" % (type(e).__name__, e)}
-            _stderr("pallas on-chip check failed: %s" % (pallas_info["error"],))
+            % (kernel_tps, kernel_pps, tps, pps, device_util))
 
     # accuracy: segment agreement vs ground truth, every cohort (VERDICT r02
     # weak #8) -- matched edges from the same compact/carry programs.
@@ -605,7 +555,6 @@ def run_device() -> int:
         "dispatch_floor_ms": round(floor_ms, 2),
         "latency_cohort": "short64",
         "e2e_mode": "pipelined_overlap2",
-        "forward": forward,
         "forward_by_cohort": forward_by_cohort,
         "kernel_traces_per_sec": round(kernel_tps, 1),
         "kernel_points_per_sec": round(kernel_pps, 1),
@@ -615,7 +564,6 @@ def run_device() -> int:
         "profile_dir": profile_dir,
         "device_util": round(device_util, 3),
         "warmup_s": round(warmup_s, 1),
-        "pallas": pallas_info,
         "agreement": round(agr_mean, 4),
         "oracle_cmp": oracle_cmp,
         "agreement_by_cohort": agreement,
@@ -929,11 +877,12 @@ def main() -> int:
             elif dj and cpu_json is None:
                 _stderr("axon attempt yielded cpu devices; keeping as fallback")
                 cpu_json = dj
+                cpu_banked = True  # a held CPU result is the bank
             cooldown_until = time.time() + 120.0
         elif not cpu_banked and not ports:
             # relay down: bank the fallback now -- the wait continues after
             cpu_banked = True
-            cpu_json = _run_cpu_fallback()
+            cpu_json = cpu_json or _run_cpu_fallback()
         else:
             if time.time() - last_log > 300:
                 _stderr("relay down; polling (%.0fs of budget left)"
@@ -976,10 +925,10 @@ def main() -> int:
     }
     for k in ("platform", "acquire_s", "points_per_sec", "p50_latency_ms", "p95_latency_ms",
               "dispatch_floor_ms",
-              "latency_cohort", "e2e_mode", "forward", "forward_by_cohort", "kernel_traces_per_sec",
+              "latency_cohort", "e2e_mode", "forward_by_cohort", "kernel_traces_per_sec",
               "kernel_points_per_sec", "kernel_by_cohort",
               "kernel_secs_by_cohort", "roofline", "profile_dir",
-              "device_util", "warmup_s", "pallas", "agreement", "oracle_cmp", "agreement_by_cohort", "device_mb",
+              "device_util", "warmup_s", "agreement", "oracle_cmp", "agreement_by_cohort", "device_mb",
               "fleet", "scenario", "edges", "ubodt_rows", "ubodt_load", "ubodt_max_probes",
               "ubodt_max_kicks"):
         if k in device_json:

@@ -10,7 +10,7 @@ max_route_time_factor, turn_penalty_factor.  Adds the TPU-side knobs
 from __future__ import annotations
 
 from dataclasses import dataclass, field, asdict
-from typing import List, Optional
+from typing import List
 
 
 @dataclass
@@ -35,9 +35,6 @@ class MatcherConfig:
     # arrays, so the binding bound is on points (B*T), with a row cap on top
     max_device_batch: int = 2048
     max_device_points: int = 2048 * 64
-    # pallas Viterbi forward (ops/viterbi_pallas.py): None = auto (TPU with
-    # beam_k == 8), True/False = force.  $REPORTER_PALLAS overrides.
-    use_pallas: Optional[bool] = None
     # devices to shard the trace batch over (dp axis of a jax Mesh).  1 =
     # single device; >1 routes every match_many batch through dp-sharded
     # jits (parallel/mesh.py semantics in the product path).  Must be a

@@ -91,8 +91,6 @@ class SegmentMatcher:
     # -- backends ----------------------------------------------------------
 
     def _init_jax(self):
-        import os
-
         import jax
 
         from ..ops.viterbi import (
@@ -164,45 +162,17 @@ class SegmentMatcher:
             self._jit_match_carry = jax.jit(
                 match_batch_carry_packed, static_argnums=(4,))
 
-        use_pallas = self.cfg.use_pallas
-        env = os.environ.get("REPORTER_PALLAS", "").strip().lower()
-        if env:
-            use_pallas = env not in ("0", "false", "no", "off")
-        if use_pallas is None:  # auto: the kernel is specialised for K == 8
-            use_pallas = (
-                jax.devices()[0].platform == "tpu" and self.cfg.beam_k == 8
-            )
-        if self._mesh is not None and use_pallas:
-            # the pallas forward does not partition under sharded jit; the
-            # mesh path runs the scan forward (the transition/UBODT work —
-            # where the time goes — shards either way)
-            log.info("devices=%d: pallas forward disabled in mesh mode", self._n_dp)
-            use_pallas = False
-        self._pallas = bool(use_pallas) and self.cfg.beam_k == 8
-        # the scan forward is always compiled: it serves every batch smaller
-        # than the pallas kernel's 128-row block (padding a single streaming
-        # trace to 128 rows made p50 latency ~1.5 s in round 3 — VERDICT r03
-        # weak #2), and is the only forward when pallas is off
+        # one forward for every batch shape: the lax.scan program.  A
+        # hand-written pallas Viterbi forward was carried (and measured)
+        # for three rounds and never beat the scan on chip -- XLA already
+        # fuses this program's hot loops, and the kernel's 128-row block
+        # constraint hurt single-trace latency; it was deleted per VERDICT
+        # r04 next #5 (measurements and design notes: docs/pallas-decision.md)
         if gp_jits is not None:
             self._jit_match_scan = gp_jits["compact"]
         else:
             self._jit_match_scan = jax.jit(
                 match_batch_compact_packed, static_argnums=(4,))
-        self._jit_match_pallas = None
-        if self._pallas:
-            from ..ops.viterbi import pack_compact, unpack_inputs
-            from ..ops.viterbi_pallas import match_batch_compact_pallas
-
-            # off-TPU (forced-on for tests) the kernel runs interpreted
-            interp = jax.devices()[0].platform != "tpu"
-
-            def _compact_pallas(dg, du, xin, p, k):
-                px, py, tm, v = unpack_inputs(xin)
-                return pack_compact(match_batch_compact_pallas(
-                    dg, du, px, py, tm, v, p, k, interpret=interp
-                ))
-
-            self._jit_match_pallas = jax.jit(_compact_pallas, static_argnums=(4,))
 
     def _make_gp_jits(self):
         """shard_map'd compact/carry jits for the dp×gp mesh: batch arrays
@@ -271,19 +241,8 @@ class SegmentMatcher:
             from ..ops.viterbi import pack_inputs
 
             B = px.shape[0]
-            # forward selection: the pallas kernel needs a 128-row batch
-            # multiple, so it only ever serves batches that are already at
-            # least one full block — padding small batches up to 128 would
-            # multiply single-trace latency by the full-block kernel cost
-            # (VERDICT r03 weak #2).  Smaller batches take the scan forward.
             fn = self._jit_match_scan
-            if self._jit_match_pallas is not None and B >= 128:
-                if B % 128:
-                    px, py, times, valid = _pad_rows(
-                        128 - B % 128, px, py, times, valid
-                    )
-                fn = self._jit_match_pallas
-            elif self._mesh is not None and px.shape[0] % self._n_dp:
+            if self._mesh is not None and px.shape[0] % self._n_dp:
                 # dp sharding splits the batch axis evenly across chips
                 px, py, times, valid = _pad_rows(
                     self._n_dp - px.shape[0] % self._n_dp, px, py, times, valid
@@ -417,6 +376,20 @@ class SegmentMatcher:
             pending.clear()
             if not work and not long_handles:
                 return results  # type: ignore[return-value]
+            if len(work) + len(long_handles) == 1:
+                # single chunk: nothing to overlap -- fetch inline rather
+                # than taxing the streaming latency path with a thread
+                if work:
+                    idxs_, handle_, times_ = work[0]
+                    edge, offset, breaks = self._collect_batch(handle_)
+                    self._associate_and_store(
+                        idxs_, edge, offset, breaks, times_, results)
+                else:
+                    group, (edge, offset, breaks), times_ = self._fetch_long(
+                        long_handles[0])
+                    self._associate_and_store(
+                        group, edge, offset, breaks, times_, results)
+                return results  # type: ignore[return-value]
             fetched: "_queue.Queue" = _queue.Queue(maxsize=2)
 
             def _fetch_all():
@@ -510,9 +483,8 @@ class SegmentMatcher:
     # batch-dimension padding ladder: the jitted kernels compile once per
     # (B, T) shape, so B snaps up to a small fixed set instead of every
     # power of two (VERDICT r03 next #3: prune the compiled shape set).
-    # Below one pallas block the rungs are sparse (worst case 4x row waste,
-    # only where absolute cost is small); at >=128 the rungs are the pow2
-    # block multiples the pallas forward serves.
+    # Sparse low rungs bound worst-case row waste at 4x, only where the
+    # absolute cost is small; dense pow2 rungs above.
     _BATCH_LADDER = (1, 4, 16, 64, 128, 256, 512, 1024, 2048)
 
     @classmethod
@@ -570,6 +542,17 @@ class SegmentMatcher:
         order = sorted(idxs, key=lambda i: -len(traces[i]["trace"]))
         handles = []
         for g in range(0, len(order), cap):
+            # bound pinned device memory across groups: before dispatching
+            # group k, force-fetch group k-2's deferred tail (group-serial
+            # behaviour had this bound implicitly; fully-async dispatch of
+            # many groups would pin every group's inputs + tail at once)
+            if len(handles) >= 2:
+                h = handles[len(handles) - 2]
+                if h[2] is not None:
+                    from ..ops.viterbi import unpack_compact as _unpack
+
+                    h[1].append(_unpack(h[2]))
+                    handles[len(handles) - 2] = (h[0], h[1], None, h[3])
             group = order[g : g + cap]
             T_max = max(len(traces[i]["trace"]) for i in group)
             n_chunks = -(-T_max // W)
@@ -657,75 +640,18 @@ class SegmentMatcher:
                     for i, (a, o) in enumerate(zip(lat, lon))
                 ],
             }])
-        self._autotune_forward()
         log.info("matcher warmup: %d shapes in %.1fs", len(lengths), _time.time() - t0)
         return _time.time() - t0
 
     def _probe_edge_coords(self):
-        """Endpoints of the graph's first edge — the dummy-trace span shared
-        by warmup and the forward autotune (keep the two probes identical)."""
+        """Endpoints of the graph's first edge — the dummy-trace span used
+        by warmup."""
         return (
             float(self.arrays.node_x[self.arrays.edge_from[0]]),
             float(self.arrays.node_y[self.arrays.edge_from[0]]),
             float(self.arrays.node_x[self.arrays.edge_to[0]]),
             float(self.arrays.node_y[self.arrays.edge_to[0]]),
         )
-
-    def _autotune_forward(self, reps: int = 3) -> None:
-        """Measure scan vs pallas on two full [128, 64] blocks and DROP the
-        pallas forward if it doesn't win: the kernel must pay for its
-        block-size constraint with measured throughput, not assumption
-        (VERDICT r03 weak #3).  cfg.use_pallas=True (or $REPORTER_PALLAS)
-        skips the tune — an explicit force stays forced."""
-        import time as _time
-
-        if self._jit_match_pallas is None or self.cfg.use_pallas:
-            return
-        import os
-
-        if os.environ.get("REPORTER_PALLAS", "").strip():
-            return
-
-        # two full pallas blocks at the streaming window length: the gate
-        # only ever routes B >= 128 to pallas, and fleet batches are block
-        # multiples, so a multi-block shape is what the decision is for (a
-        # single block under-weights pallas' per-block overheads)
-        from ..ops.viterbi import pack_inputs
-
-        B, T = 256, 64
-        ax, ay, bx, by = self._probe_edge_coords()
-        px = np.tile(np.linspace(ax, bx, T, dtype=np.float32), (B, 1))
-        py = np.tile(np.linspace(ay, by, T, dtype=np.float32), (B, 1))
-        tm = np.tile(np.arange(T, dtype=np.float32) * 5.0, (B, 1))
-        valid = np.ones((B, T), bool)
-        args = (self._dg, self._du,
-                self._put_packed(pack_inputs(px, py, tm, valid)), self._params)
-        times = {}
-        try:
-            for name, fn in (("scan", self._jit_match_scan),
-                             ("pallas", self._jit_match_pallas)):
-                np.asarray(fn(*args, self.cfg.beam_k))
-                t0 = _time.time()
-                for _ in range(reps):
-                    r = fn(*args, self.cfg.beam_k)
-                # fetch, not block_until_ready: the tune must time what the
-                # product pays, and block_until_ready has been observed
-                # returning early on the tunneled backend
-                np.asarray(r)
-                times[name] = (_time.time() - t0) / reps
-        except Exception:  # pragma: no cover - tuning must never gate boot
-            log.exception("forward autotune failed; keeping scan only")
-            self._jit_match_pallas = None
-            return
-        if times["pallas"] >= times["scan"]:
-            log.info("forward autotune: pallas %.1f ms >= scan %.1f ms on "
-                     "[%d, %d]; dropping the pallas forward",
-                     times["pallas"] * 1e3, times["scan"] * 1e3, B, T)
-            self._jit_match_pallas = None
-        else:
-            log.info("forward autotune: pallas %.1f ms < scan %.1f ms on "
-                     "[%d, %d]; keeping pallas for full blocks",
-                     times["pallas"] * 1e3, times["scan"] * 1e3, B, T)
 
     def match(self, trace: dict) -> dict:
         return self.match_many([trace])[0]

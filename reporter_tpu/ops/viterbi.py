@@ -309,7 +309,7 @@ def backtrace(scores_mat: jnp.ndarray, backptr: jnp.ndarray, valid: jnp.ndarray)
     """Reverse scan over stored backpointers for one trace.  scores_mat/backptr
     [T, K], valid [T] -> chosen slot per point [T] (-1 unmatched).  Segment
     boundaries: padded or unmatched successors restart the chain at the local
-    argmax.  Shared by the lax.scan forward and the pallas forward."""
+    argmax."""
     T = scores_mat.shape[0]
 
     def back(carry, inputs):
