@@ -487,6 +487,35 @@ def run_device() -> int:
     agr_mean = float(np.mean(list(agreement.values())))
     _stderr("segment agreement vs truth: %s (mean %.3f)" % (agreement, agr_mean))
 
+    # UBODT coverage: how often the fleet drives into the delta bound
+    # (VERDICT r04 next #4).  misses_within_maxroute is the subset of table
+    # misses a larger delta / on-line router could have answered -- the
+    # potential accuracy cost of the bound; docs/ubodt-delta.md carries the
+    # delta-sweep evidence behind the default.
+    ubodt_miss = None
+    try:
+        from reporter_tpu.ops.diagnostics import ubodt_probe_stats
+
+        jstats = jax.jit(ubodt_probe_stats, static_argnums=(4,))
+        delta_m = float(os.environ.get("BENCH_DELTA", "3000"))
+        tot = np.zeros(4, np.int64)
+        for cname, T, ss in cohorts:
+            px, py, tm, valid = cohort_xy[cname]
+            xin = jnp.asarray(pack_inputs(px, py, tm, valid))
+            tot += np.asarray(
+                jstats(dg, du, xin, params, cfg.beam_k, delta_m), np.int64)
+        pairs = int(tot[0])
+        ubodt_miss = {
+            "probe_pairs": pairs,
+            "miss_frac": round(int(tot[1]) / max(pairs, 1), 5),
+            "costly_miss_frac": round(int(tot[2]) / max(pairs, 1), 5),
+            "provable_delta_trunc_frac": round(int(tot[3]) / max(pairs, 1), 5),
+            "delta_m": delta_m,
+        }
+        _stderr("ubodt probes: %s" % (ubodt_miss,))
+    except Exception as e:  # noqa: BLE001 - diagnostics must not sink the bench
+        _stderr("ubodt probe stats failed: %s" % (e,))
+
     # device-vs-oracle on real fleet traces (the "at equal OSMLR-segment
     # agreement" clause of the north star, BASELINE.md): diff the
     # wire-format segment sequences the two backends emit over >= 100
@@ -565,6 +594,7 @@ def run_device() -> int:
         "device_util": round(device_util, 3),
         "warmup_s": round(warmup_s, 1),
         "agreement": round(agr_mean, 4),
+        "ubodt_miss": ubodt_miss,
         "oracle_cmp": oracle_cmp,
         "agreement_by_cohort": agreement,
         "device_mb": round(hbm_mb, 1),
@@ -928,7 +958,7 @@ def main() -> int:
               "latency_cohort", "e2e_mode", "forward_by_cohort", "kernel_traces_per_sec",
               "kernel_points_per_sec", "kernel_by_cohort",
               "kernel_secs_by_cohort", "roofline", "profile_dir",
-              "device_util", "warmup_s", "agreement", "oracle_cmp", "agreement_by_cohort", "device_mb",
+              "device_util", "warmup_s", "agreement", "ubodt_miss", "oracle_cmp", "agreement_by_cohort", "device_mb",
               "fleet", "scenario", "edges", "ubodt_rows", "ubodt_load", "ubodt_max_probes",
               "ubodt_max_kicks"):
         if k in device_json:

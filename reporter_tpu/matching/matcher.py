@@ -547,12 +547,10 @@ class SegmentMatcher:
             # behaviour had this bound implicitly; fully-async dispatch of
             # many groups would pin every group's inputs + tail at once)
             if len(handles) >= 2:
-                h = handles[len(handles) - 2]
-                if h[2] is not None:
-                    from ..ops.viterbi import unpack_compact as _unpack
-
-                    h[1].append(_unpack(h[2]))
-                    handles[len(handles) - 2] = (h[0], h[1], None, h[3])
+                grp, parts, tail, tms = handles[-2]
+                if tail is not None:
+                    parts.append(unpack_compact(tail))
+                    handles[-2] = (grp, parts, None, tms)
             group = order[g : g + cap]
             T_max = max(len(traces[i]["trace"]) for i in group)
             n_chunks = -(-T_max // W)

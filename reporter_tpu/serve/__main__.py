@@ -78,15 +78,46 @@ def main(argv):
     term_to_keyboard_interrupt()
 
     try:
-        # pre-compile the hot shapes AFTER binding (clients queue in the
-        # accept backlog rather than getting refused); "warmup": false
-        # disables.  Inside the try: a SIGTERM during the warmup compiles
-        # (tens of seconds cold) must also shut down cleanly.
+        # pre-compile the hot shapes BEHIND the bound socket, on a
+        # background thread: the service accepts (and /health answers, with
+        # "warming": true) from the first second, while cold-start compiles
+        # proceed -- a cold boot must not leave clients dark for the
+        # compile set (the reference client's socket budget is 10 s,
+        # HttpClient.java:80-88).  Requests racing the warmup just compile
+        # their shape inline, exactly as with warmup disabled; the jit
+        # cache dedups.  "warmup": false disables.
+        warm_thread = None
+        stop_warm = None
         if conf.get("warmup", True):
-            matcher.warmup()
+            import threading
+
+            service.warming = True
+            stop_warm = threading.Event()
+
+            def _warm():
+                try:
+                    # shape-by-shape so a shutdown can stop between
+                    # compiles (an in-flight XLA compile itself is not
+                    # interruptible)
+                    for n in matcher.cfg.length_buckets:
+                        if stop_warm.is_set():
+                            break
+                        matcher.warmup(lengths=[n])
+                finally:
+                    service.warming = False
+
+            warm_thread = threading.Thread(
+                target=_warm, daemon=True, name="warmup")
+            warm_thread.start()
         httpd.serve_forever()
     except KeyboardInterrupt:
         logging.info("shutting down (signal)")
+        if stop_warm is not None:
+            # let the in-flight warmup compile finish before tearing down
+            # the runtime under it (bounded: anything longer than one
+            # compile is the container's SIGKILL to take)
+            stop_warm.set()
+            warm_thread.join(timeout=120.0)
         httpd.server_close()
     return 0
 

@@ -213,6 +213,10 @@ class ReporterService:
         m = self.matcher
         return 200, {
             "status": "ok",
+            # True while the boot-time background warmup is still compiling
+            # shapes: the service answers (first requests just compile
+            # inline), so warming is informational, not a failure state
+            "warming": bool(getattr(self, "warming", False)),
             "backend": m.backend,
             "devices": int(getattr(m.cfg, "devices", 1)),
             "graph_devices": int(getattr(m.cfg, "graph_devices", 1)),

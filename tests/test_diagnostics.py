@@ -1,0 +1,74 @@
+"""ubodt_probe_stats: the delta-bound coverage counter (ops/diagnostics)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from reporter_tpu.matching import MatcherConfig
+from reporter_tpu.ops.diagnostics import ubodt_probe_stats
+from reporter_tpu.ops.viterbi import MatchParams, pack_inputs
+from reporter_tpu.synth import TraceSynthesizer
+from reporter_tpu.synth.generator import cohort_xy
+from reporter_tpu.tiles.arrays import build_graph_arrays
+from reporter_tpu.tiles.network import grid_city
+from reporter_tpu.tiles.ubodt import build_ubodt
+
+
+@pytest.fixture(scope="module")
+def city():
+    net = grid_city(rows=10, cols=10, spacing_m=200.0)
+    arrays = build_graph_arrays(net, cell_size=100.0)
+    return net, arrays
+
+
+def _stats(arrays, ubodt, cfg, straces, T, delta):
+    dg = arrays.to_device()
+    du = ubodt.to_device()
+    p = MatchParams.from_config(cfg)
+    px, py, tm, valid = cohort_xy(arrays, straces, T)
+    xin = jnp.asarray(pack_inputs(px, py, tm, valid))
+    return np.asarray(
+        jax.jit(ubodt_probe_stats, static_argnums=(4,))(
+            dg, du, xin, p, cfg.beam_k, delta)
+    )
+
+
+def test_full_delta_has_low_miss_rate(city):
+    """With delta covering the whole city, almost no probe can miss for
+    delta reasons (remaining misses are genuine no-path pairs)."""
+    net, arrays = city
+    cfg = MatcherConfig(ubodt_delta=10000.0)
+    ubodt = build_ubodt(arrays, delta=10000.0)
+    synth = TraceSynthesizer(arrays, seed=3)
+    stats = _stats(
+        arrays, ubodt, cfg, synth.batch(8, 32, dt=5.0, sigma=3.0), 32, 10000.0)
+    pairs, miss, costly, beyond = (int(v) for v in stats)
+    assert pairs > 0
+    # no hop is provably beyond a 10 km table on a ~2 km city
+    assert beyond == 0
+    # dense sampling on a connected grid: nearly every probe is answerable
+    assert costly / pairs < 0.05
+
+
+def test_tiny_delta_drives_misses_up(city):
+    """Shrinking delta below the sampling gap turns answerable probes into
+    costly misses (forced transition breaks), and most become PROVABLE
+    truncations (gc > delta) -- the accuracy bound the counter surfaces."""
+    net, arrays = city
+    synth = TraceSynthesizer(arrays, seed=3)
+    traces = synth.batch(8, 32, dt=30.0, sigma=3.0)  # sparse: ~300+ m hops
+
+    def fracs(delta):
+        cfg = MatcherConfig(ubodt_delta=delta)
+        ubodt = build_ubodt(arrays, delta=delta)
+        stats = _stats(arrays, ubodt, cfg, traces, 32, delta)
+        pairs = max(int(stats[0]), 1)
+        return int(stats[2]) / pairs, int(stats[3]) / pairs
+
+    costly_low, trunc_low = fracs(6000.0)
+    costly_high, trunc_high = fracs(300.0)
+    assert costly_high > costly_low
+    assert costly_high > 0.1  # a 300 m table cannot answer 300+ m hops
+    assert trunc_high > 0.05  # and many misses are provably the bound's fault
+    assert trunc_low == 0.0  # no 30 s hop exceeds a 6 km table's reach

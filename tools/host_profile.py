@@ -26,6 +26,12 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 def main():
     os.environ.setdefault("BENCH_GRID", "60")  # smaller city: fast build
     sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    # drop unselected PJRT factories BEFORE first backend use: registered
+    # plugins initialise even when JAX_PLATFORMS=cpu, and a dead tunnel
+    # blocks that init forever (utils/jaxenv docstring)
+    from reporter_tpu.utils.jaxenv import ensure_platform
+
+    ensure_platform(os.environ.get("JAX_PLATFORMS") or "cpu")
     import numpy as np
 
     from bench import build_scenario
