@@ -1,0 +1,96 @@
+#!/usr/bin/env python3
+"""Offline analysis of a jax.profiler chrome-trace capture.
+
+Groups on-device XLA op time by the *source line* XLA recorded for each
+fusion (the bench's kernels all trace back to reporter_tpu/ops/*.py), so a
+`bench_profile/**/vm.trace.json.gz` becomes a stage attribution:
+
+    candidates.py   candidate sweep (grid gathers + distance/min selection)
+    hashtable.py    UBODT probes (two bucket-row gathers + select)
+    viterbi.py      emission/transition assembly, scan, backtrace, compact
+
+This is the on-chip evidence for the which-stage-dominates question
+(VERDICT r04 next #7: the round-4 claim 'transitions ~95%' was CPU-only).
+
+Run:  python tools/trace_analyze.py bench_profile/plugins/profile/<ts>/vm.trace.json.gz
+"""
+
+from __future__ import annotations
+
+import collections
+import gzip
+import json
+import sys
+
+
+def analyze(path: str) -> dict:
+    with gzip.open(path) as f:
+        tr = json.load(f)
+    ev = tr["traceEvents"]
+
+    # device pid + thread names
+    pid_dev = None
+    tids = {}
+    for e in ev:
+        if e.get("ph") != "M":
+            continue
+        if e.get("name") == "process_name" and "TPU" in str(e.get("args", {}).get("name", "")):
+            pid_dev = e["pid"]
+        if e.get("name") == "thread_name":
+            tids[(e.get("pid"), e.get("tid"))] = e["args"]["name"]
+    if pid_dev is None:
+        raise SystemExit("no TPU process in trace")
+
+    # args are attached to the first occurrence of each op name; collect
+    name_src: dict = {}
+    by_file = collections.defaultdict(float)
+    by_line = collections.defaultdict(float)
+    by_module = collections.defaultdict(float)
+    total = 0.0
+    for e in ev:
+        if e.get("ph") != "X" or e.get("pid") != pid_dev:
+            continue
+        tname = tids.get((e.get("pid"), e.get("tid")), "")
+        dur = e.get("dur", 0) / 1e3  # us -> ms
+        if tname == "XLA Modules":
+            by_module[e["name"].split("(")[0]] += dur
+            continue
+        if tname != "XLA Ops":
+            continue
+        total += dur
+        args = e.get("args") or {}
+        if "source" in args:
+            name_src[e["name"]] = args["source"]
+        src = name_src.get(e["name"], "")
+        fname = src.rsplit("/", 1)[-1].split(":")[0] if src else "(no source)"
+        by_file[fname] += dur
+        if src:
+            by_line[src.replace("/root/repo/", "")] += dur
+
+    return {
+        "path": path,
+        "device_total_ms": round(total, 1),
+        "by_module_ms": {k: round(v, 1) for k, v in sorted(
+            by_module.items(), key=lambda kv: -kv[1]) if v > 0.05},
+        "by_file_ms": {k: round(v, 1) for k, v in sorted(
+            by_file.items(), key=lambda kv: -kv[1])},
+        "top_lines_ms": {k: round(v, 1) for k, v in sorted(
+            by_line.items(), key=lambda kv: -kv[1])[:14]},
+    }
+
+
+def main() -> int:
+    paths = sys.argv[1:]
+    if not paths:
+        import glob
+
+        paths = sorted(glob.glob(
+            "bench_profile/plugins/profile/*/vm.trace.json.gz"))
+    for p in paths:
+        out = analyze(p)
+        print(json.dumps(out, indent=1))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
