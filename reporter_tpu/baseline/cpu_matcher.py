@@ -30,17 +30,27 @@ class CPUViterbiMatcher:
         self.ubodt = ubodt
         self.cfg = cfg
 
-    # -- candidate lookup (numpy over all shape segments in 3x3 cells) -----
+    # -- candidate lookup (numpy over shape segments in the 2x2 quadrant
+    # cell block -- the same rule as the device sweep, ops/candidates.py:
+    # cell_size >= 2*search_radius makes only the neighbour on the point's
+    # own side of each axis reachable).  NB sharing the rule means the
+    # backend-diff test cannot catch a bug in the rule itself; the
+    # independent check for candidate completeness is agreement vs
+    # synthesized ground truth (bench + tests/test_synth.py), which does
+    # not pass through this code. ------------------------------------------
 
     def _candidates(self, x: float, y: float) -> List[Tuple[int, float, float]]:
         """[(edge, offset_m, dist_m)] within the search radius, one per edge,
         nearest K first."""
         a = self.arrays
         cx, cy = a.cell_of(x, y)
+        fx = (x - a.grid_x0) / a.cell_size
+        fy = (y - a.grid_y0) / a.cell_size
+        sx = 1 if fx - np.floor(fx) >= 0.5 else -1
+        sy = 1 if fy - np.floor(fy) >= 0.5 else -1
         items: List[int] = []
-        for dy in (-1, 0, 1):
-            for dx in (-1, 0, 1):
-                gx, gy = cx + dx, cy + dy
+        for gy in (cy, cy + sy):
+            for gx in (cx, cx + sx):
                 if 0 <= gx < a.grid_nx and 0 <= gy < a.grid_ny:
                     row = a.grid_items[gy * a.grid_nx + gx]
                     items.extend(int(s) for s in row[row >= 0])

@@ -71,12 +71,14 @@ class SegmentMatcher:
             if network is None:
                 raise ValueError("need a network or prebuilt arrays")
             arrays = build_graph_arrays(
-                network, cell_size=max(100.0, self.cfg.search_radius)
+                network, cell_size=max(100.0, 2.0 * self.cfg.search_radius)
             )
-        if arrays.cell_size < self.cfg.search_radius:
+        if arrays.cell_size < 2.0 * self.cfg.search_radius:
             raise ValueError(
-                "spatial grid cell_size %.1f < search_radius %.1f: 3x3 query "
-                "neighbourhood would miss candidates" % (arrays.cell_size, self.cfg.search_radius)
+                "spatial grid cell_size %.1f < 2*search_radius %.1f: the 2x2 "
+                "quadrant candidate sweep (ops/candidates.py) would miss "
+                "candidates; rebuild the grid with a larger cell_size"
+                % (arrays.cell_size, 2.0 * self.cfg.search_radius)
             )
         self.arrays = arrays
         self.ubodt = ubodt or build_ubodt(arrays, delta=self.cfg.ubodt_delta)

@@ -1,15 +1,37 @@
 """Candidate edge lookup on device.
 
-For each GPS point: gather the shape segments in the 3x3 spatial-grid
+For each GPS point: gather the shape segments in the 2x2 quadrant
 neighbourhood of the point's cell, project the point onto every segment, and
 keep the K nearest within the search radius, deduplicated per edge.  The
 grid's cells store their candidate records INLINE (tiles/arrays.py
-cell_rows), so the whole 3x3 sweep is nine contiguous row-gathers — one
-aligned DMA per cell — rather than 9*cap scattered per-item gathers.
+cell_rows), so the whole sweep is four contiguous row-gathers — one aligned
+DMA per cell — rather than 4*cap scattered per-item gathers.
+
+2x2, not 3x3: the grid guarantees ``cell_size >= 2 * search_radius``
+(enforced at matcher construction), so a search disk centred anywhere in a
+cell can only reach the neighbour on the point's own side of each axis —
+the quadrant block {cx, cx+sx} x {cy, cy+sy} with sx/sy chosen by which
+half of the cell the point is in.  The round-4 3x3 sweep gathered 2.25x
+more rows than needed, and the on-chip attribution showed the candidate
+stage dominating kernel time (~57 %; docs/onchip-attribution.md).
+
+Trade-off note: at the reference operating point (radius 50 m, cell 100 m,
+unchanged from round 4) this is a pure 2.25x shrink.  For a *larger*
+radius the matcher now builds 2r cells, whose ~4x capacity makes the
+4-cell sweep gather ~16/9 of what a 3x3-over-r-cells sweep would — the
+quadrant rule still wins on gather count (4 DMAs vs 9) but not on volume.
+If large radii become a real operating point, reintroduce the 3x3 sweep
+behind a static grid attribute rather than resizing cells.
+
+The selection avoids wide index-gathers (the other on-chip cost): distances
+are computed once over the [4*cap] row block, a single top-k picks the
+4K-nearest pool, and the pool's ROWS are re-gathered once ([pool, 8] — one
+gather) with the projection recomputed on the pool (bit-identical floats,
+same inputs) instead of index-gathering seven [4*cap] component arrays.
 
 This replaces Meili's per-point candidate search (C++ R-tree walk) with a
-dense, vmappable gather — the shapes are static so XLA tiles it onto the VPU,
-and the whole [batch, T] candidate sweep is one fused kernel.
+dense, vmappable gather — the shapes are static so XLA tiles it onto the
+VPU, and the whole [batch, T] candidate sweep is one fused kernel.
 
 A candidate is (edge, offset-along-edge, perpendicular distance).  Invalid
 slots carry edge = -1 and dist = +inf.
@@ -33,39 +55,63 @@ class Candidates(NamedTuple):
     cy: jnp.ndarray  # [..., K] f32 snapped y
 
 
-def find_candidates(dg: DeviceGraph, px, py, k: int, search_radius: float) -> Candidates:
-    """Candidates for a single point (px, py scalars).  vmap over points/batch."""
-    nx = dg.grid_dims[0]
-    ny = dg.grid_dims[1]
-    cell = dg.cell_size
-    cx0 = jnp.clip(jnp.floor((px - dg.grid_origin[0]) / cell).astype(jnp.int32), 0, nx - 1)
-    cy0 = jnp.clip(jnp.floor((py - dg.grid_origin[1]) / cell).astype(jnp.int32), 0, ny - 1)
+def _project(px, py, rows, search_radius):
+    """Project a point onto each row's shape segment.
 
-    # 3x3 neighbourhood, clamped at the border (duplicate cells are harmless:
-    # duplicates of one segment dedup below)
-    offs = jnp.array([-1, 0, 1], jnp.int32)
-    ncx = jnp.clip(cx0 + offs[None, :], 0, nx - 1)  # [1,3]
-    ncy = jnp.clip(cy0 + offs[:, None], 0, ny - 1)  # [3,1]
-    cells = (ncy * nx + ncx).reshape(-1)  # [9]
-
-    # the whole 3x3 sweep is NINE contiguous row-gathers (one aligned DMA
-    # per cell): each cell row carries its cap candidate records inline
-    # (ax, ay, bx, by, off, len, edge-bits per record; empty slots edge -1)
-    rows = dg.cell_rows[cells].reshape(-1, 8)  # [9*cap, 8]
+    rows: [N, 8] gathered cell records -> (t, qx, qy, d) each [N], with
+    d = +inf outside the radius or on empty slots.  Pure elementwise math —
+    calling it twice on the same rows gives bit-identical floats, which the
+    pool re-gather below relies on."""
     ax, ay, bx, by = rows[:, 0], rows[:, 1], rows[:, 2], rows[:, 3]
-    off0, slen = rows[:, 4], rows[:, 5]
     edge_of = jax.lax.bitcast_convert_type(rows[:, 6], jnp.int32)
     valid = edge_of >= 0
 
     dx = bx - ax
     dy = by - ay
     len2 = dx * dx + dy * dy
-    t = jnp.where(len2 > 0, ((px - ax) * dx + (py - ay) * dy) / jnp.where(len2 > 0, len2, 1.0), 0.0)
+    t = jnp.where(
+        len2 > 0,
+        ((px - ax) * dx + (py - ay) * dy) / jnp.where(len2 > 0, len2, 1.0),
+        0.0,
+    )
     t = jnp.clip(t, 0.0, 1.0)
     qx = ax + t * dx
     qy = ay + t * dy
     d = jnp.hypot(px - qx, py - qy)
     d = jnp.where(valid & (d <= search_radius), d, jnp.inf)
+    return t, qx, qy, d, edge_of
+
+
+def find_candidates(dg: DeviceGraph, px, py, k: int, search_radius: float) -> Candidates:
+    """Candidates for a single point (px, py scalars).  vmap over points/batch.
+
+    PRECONDITION: ``search_radius <= dg.cell_size / 2``.  SegmentMatcher
+    enforces it at construction; a direct caller that violates it gets
+    silently incomplete candidates (the quadrant block cannot cover the
+    disk), because the radius is a traced value and cannot be checked at
+    trace time here."""
+    nx = dg.grid_dims[0]
+    ny = dg.grid_dims[1]
+    cell = dg.cell_size
+    fx = (px - dg.grid_origin[0]) / cell
+    fy = (py - dg.grid_origin[1]) / cell
+    cx0 = jnp.clip(jnp.floor(fx).astype(jnp.int32), 0, nx - 1)
+    cy0 = jnp.clip(jnp.floor(fy).astype(jnp.int32), 0, ny - 1)
+
+    # quadrant neighbour: the half of the cell the point is in decides the
+    # only reachable neighbour per axis (cell_size >= 2*search_radius).
+    # Border clamping duplicates a cell; duplicates dedup below.
+    sx = jnp.where(fx - jnp.floor(fx) >= 0.5, 1, -1).astype(jnp.int32)
+    sy = jnp.where(fy - jnp.floor(fy) >= 0.5, 1, -1).astype(jnp.int32)
+    ncx = jnp.clip(jnp.stack([cx0, cx0 + sx]), 0, nx - 1)  # [2]
+    ncy = jnp.clip(jnp.stack([cy0, cy0 + sy]), 0, ny - 1)  # [2]
+    cells = (ncy[:, None] * nx + ncx[None, :]).reshape(-1)  # [4]
+
+    # the whole sweep is FOUR contiguous row-gathers (one aligned DMA per
+    # cell): each cell row carries its cap candidate records inline
+    # (ax, ay, bx, by, off, len, edge-bits per record; empty slots edge -1)
+    rows = dg.cell_rows[cells].reshape(-1, 8)  # [4*cap, 8]
+    _, _, _, d, _ = _project(px, py, rows, search_radius)
 
     # Select a widened pool of nearest shape segments, dedup per edge, then
     # narrow to K.  Deduping *after* a width-K selection would let one curvy
@@ -74,24 +120,26 @@ def find_candidates(dg: DeviceGraph, px, py, k: int, search_radius: float) -> Ca
     # edge without losing the edges behind them.
     m = min(4 * k, d.shape[0])
     _, pool_idx = jax.lax.top_k(-d, m)  # ascending distance order
-    pool_d = d[pool_idx]
-    # edge ids come from the already-gathered rows (a local [9*cap] array),
-    # not another HBM gather
-    pool_edge = jnp.where(jnp.isfinite(pool_d), edge_of[pool_idx], -1)
+
+    # ONE row-gather for the pool, then recompute the projection on [m]
+    # rows (bit-identical to d[pool_idx] — same inputs, same ops) instead
+    # of index-gathering each component array separately
+    pool_rows = rows[pool_idx]  # [m, 8]
+    t_p, qx_p, qy_p, d_p, edge_p = _project(px, py, pool_rows, search_radius)
+    pool_edge = jnp.where(jnp.isfinite(d_p), edge_p, -1)
 
     # keep only the nearest (earliest) slot of each edge
     same = (pool_edge[None, :] == pool_edge[:, None]) & (pool_edge[None, :] >= 0)
     earlier = jnp.triu(jnp.ones((m, m), jnp.bool_), 1)  # [i, j] true iff i < j
     dup = jnp.any(same & earlier, axis=0)
-    pool_d = jnp.where(dup, jnp.inf, pool_d)
+    d_p = jnp.where(dup, jnp.inf, d_p)
 
-    _, sel = jax.lax.top_k(-pool_d, k)
-    top_idx = pool_idx[sel]
-    top_d = pool_d[sel]
-    top_edge = jnp.where(jnp.isfinite(top_d), edge_of[top_idx], -1)
-    top_off = off0[top_idx] + t[top_idx] * slen[top_idx]
-    top_qx = qx[top_idx]
-    top_qy = qy[top_idx]
+    _, sel = jax.lax.top_k(-d_p, k)  # [k] indices into the pool
+    top_d = d_p[sel]
+    top_edge = jnp.where(jnp.isfinite(top_d), pool_edge[sel], -1)
+    top_off = pool_rows[sel, 4] + t_p[sel] * pool_rows[sel, 5]
+    top_qx = qx_p[sel]
+    top_qy = qy_p[sel]
 
     return Candidates(edge=top_edge, offset=top_off, dist=top_d, cx=top_qx, cy=top_qy)
 

@@ -76,9 +76,13 @@ def ubodt_lookup(u: DeviceUBODT, src: jnp.ndarray, dst: jnp.ndarray):
     b2 = device_pair_hash2(src, dst, u.bmask)
     r1 = u.packed[b1]  # [..., 128]: one aligned lane-row DMA per probe
     r2 = u.packed[b2]
-    rows = jnp.concatenate([r1, r2], axis=-1)
-    rows = rows.reshape(rows.shape[:-1] + (2 * BUCKET, ROW_W))
-    return _select(rows, src, dst)
+    # select per bucket and combine: keys are unique, so at most one bucket
+    # hits and an elementwise min/max merges exactly.  (Concatenating the
+    # two row sets first materialised a [..., 2*BUCKET*ROW_W] array — ~11 ms
+    # of pure layout work per kernel rep on chip, docs/onchip-attribution.md)
+    d1, t1, f1 = _select(r1.reshape(r1.shape[:-1] + (BUCKET, ROW_W)), src, dst)
+    d2, t2, f2 = _select(r2.reshape(r2.shape[:-1] + (BUCKET, ROW_W)), src, dst)
+    return jnp.minimum(d1, d2), jnp.minimum(t1, t2), jnp.maximum(f1, f2)
 
 
 def _ubodt_lookup_sharded(u: DeviceUBODT, src: jnp.ndarray, dst: jnp.ndarray):

@@ -10,8 +10,8 @@ Key structures
   - flattened *shape segments*: every edge polyline is broken into straight
     segments; candidate lookup is point-to-segment projection over these
   - a fixed-capacity *spatial grid* over shape segments; a query inspects the
-    3x3 cell neighbourhood, so ``cell_size`` must be >= the candidate search
-    radius
+    2x2 quadrant cell neighbourhood (ops/candidates.py), so ``cell_size``
+    must be >= TWICE the candidate search radius
   - CSR out-adjacency for host-side Dijkstra (UBODT build, path reconstruction)
   - a segment table mapping a dense int32 segment index to the 46-bit OSMLR id,
     with per-edge offsets within the segment so partial traversals are
@@ -47,8 +47,8 @@ class DeviceGraph(NamedTuple):
     # CELL-MAJOR candidate rows [n_cells, cap*8] f32: for every grid cell,
     # its (up to cap) shape segments as interleaved 8-lane records (ax, ay,
     # bx, by, off, len, edge-id-bits, pad; empty slots carry edge -1).  A
-    # point's whole 3x3-cell candidate sweep is then NINE contiguous
-    # row-gathers — one aligned DMA per cell — instead of 9*cap scattered
+    # point's whole quadrant-cell candidate sweep is then FOUR contiguous
+    # row-gathers — one aligned DMA per cell — instead of 4*cap scattered
     # item gathers; same layout rationale as the UBODT's 128-lane buckets.
     # (Rank-2 with a flat minor dim on purpose: TPU layouts tile the two
     # minor dims to (8, 128), so a rank-3 [cells, cap, 8] would pad 16x.)
@@ -264,8 +264,9 @@ def build_graph_arrays(
             off += float(edge_len[ei])
         seg_len[s] = off
 
-    # spatial grid over shape segments (conservative bbox insertion).  The 3x3
-    # query neighbourhood covers a search radius <= cell_size.
+    # spatial grid over shape segments (conservative bbox insertion).  The
+    # 2x2 quadrant query neighbourhood covers a search radius <= cell_size/2
+    # (ops/candidates.py).
     x_min = float(min(shp_ax.min(), shp_bx.min()))
     y_min = float(min(shp_ay.min(), shp_by.min()))
     x_max = float(max(shp_ax.max(), shp_bx.max()))

@@ -81,7 +81,8 @@ def test_candidates_sorted_and_deduped(arrays):
     # a point near an intersection sees several edges
     x = float(arrays.node_x[12])
     y = float(arrays.node_y[12]) + 5.0
-    got = find_candidates(dg, jnp.float32(x), jnp.float32(y), 16, 60.0)
+    # radius must respect the quadrant-sweep precondition: <= cell_size/2
+    got = find_candidates(dg, jnp.float32(x), jnp.float32(y), 16, 50.0)
     edges = [int(e) for e in np.asarray(got.edge) if e >= 0]
     assert len(edges) == len(set(edges)), "duplicate edges in beam"
     d = np.asarray(got.dist)

@@ -28,17 +28,17 @@ def analyze(path: str) -> dict:
         tr = json.load(f)
     ev = tr["traceEvents"]
 
-    # device pid + thread names
-    pid_dev = None
+    # device pids (all of them: a mesh capture has one per chip) + threads
+    dev_pids = set()
     tids = {}
     for e in ev:
         if e.get("ph") != "M":
             continue
         if e.get("name") == "process_name" and "TPU" in str(e.get("args", {}).get("name", "")):
-            pid_dev = e["pid"]
+            dev_pids.add(e["pid"])
         if e.get("name") == "thread_name":
             tids[(e.get("pid"), e.get("tid"))] = e["args"]["name"]
-    if pid_dev is None:
+    if not dev_pids:
         raise SystemExit("no TPU process in trace")
 
     # args are attached to the first occurrence of each op name; collect
@@ -48,7 +48,7 @@ def analyze(path: str) -> dict:
     by_module = collections.defaultdict(float)
     total = 0.0
     for e in ev:
-        if e.get("ph") != "X" or e.get("pid") != pid_dev:
+        if e.get("ph") != "X" or e.get("pid") not in dev_pids:
             continue
         tname = tids.get((e.get("pid"), e.get("tid")), "")
         dur = e.get("dur", 0) / 1e3  # us -> ms
@@ -69,6 +69,7 @@ def analyze(path: str) -> dict:
 
     return {
         "path": path,
+        "devices": len(dev_pids),
         "device_total_ms": round(total, 1),
         "by_module_ms": {k: round(v, 1) for k, v in sorted(
             by_module.items(), key=lambda kv: -kv[1]) if v > 0.05},
