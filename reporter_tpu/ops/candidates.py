@@ -23,15 +23,18 @@ quadrant rule still wins on gather count (4 DMAs vs 9) but not on volume.
 If large radii become a real operating point, reintroduce the 3x3 sweep
 behind a static grid attribute rather than resizing cells.
 
-The selection avoids wide index-gathers (the other on-chip cost): distances
-are computed once over the [4*cap] row block, a single top-k picks the
-4K-nearest pool, and the pool's ROWS are re-gathered once ([pool, 8] — one
-gather) with the projection recomputed on the pool (bit-identical floats,
-same inputs) instead of index-gathering seven [4*cap] component arrays.
+Selection is GATHER-FREE: the round-4 profiler traces showed every small
+per-point index-gather (pool pick, final component pick) landing in TPU
+scalar memory (S(1) in the layout) at ~10 ms per fused op per kernel rep.
+Here the pool/top-k picks are ONE-HOT MATMULS instead — [m, N] x [N, C]
+on the MXU with Precision.HIGHEST, which is bit-exact (each output is a
+sum of one f32 value times 1.0; the bf16-triple decomposition reconstructs
+f32 exactly) and runs where this kernel has abundant idle capacity.
 
 This replaces Meili's per-point candidate search (C++ R-tree walk) with a
-dense, vmappable gather — the shapes are static so XLA tiles it onto the
-VPU, and the whole [batch, T] candidate sweep is one fused kernel.
+dense, vmappable gather+matmul — the shapes are static so XLA tiles it
+onto the VPU/MXU, and the whole [batch, T] candidate sweep is one fused
+kernel.
 
 A candidate is (edge, offset-along-edge, perpendicular distance).  Invalid
 slots carry edge = -1 and dist = +inf.
@@ -46,6 +49,11 @@ import jax.numpy as jnp
 
 from ..tiles.arrays import DeviceGraph
 
+# finite stand-in for +inf through the one-hot matmuls (inf * 0 = nan).
+# Plain float on purpose: a module-level jnp constant would initialise the
+# XLA backend at import time and break jax.distributed.initialize ordering
+BIG = 1e30
+
 
 class Candidates(NamedTuple):
     edge: jnp.ndarray  # [..., K] i32, -1 invalid
@@ -55,31 +63,13 @@ class Candidates(NamedTuple):
     cy: jnp.ndarray  # [..., K] f32 snapped y
 
 
-def _project(px, py, rows, search_radius):
-    """Project a point onto each row's shape segment.
-
-    rows: [N, 8] gathered cell records -> (t, qx, qy, d) each [N], with
-    d = +inf outside the radius or on empty slots.  Pure elementwise math —
-    calling it twice on the same rows gives bit-identical floats, which the
-    pool re-gather below relies on."""
-    ax, ay, bx, by = rows[:, 0], rows[:, 1], rows[:, 2], rows[:, 3]
-    edge_of = jax.lax.bitcast_convert_type(rows[:, 6], jnp.int32)
-    valid = edge_of >= 0
-
-    dx = bx - ax
-    dy = by - ay
-    len2 = dx * dx + dy * dy
-    t = jnp.where(
-        len2 > 0,
-        ((px - ax) * dx + (py - ay) * dy) / jnp.where(len2 > 0, len2, 1.0),
-        0.0,
-    )
-    t = jnp.clip(t, 0.0, 1.0)
-    qx = ax + t * dx
-    qy = ay + t * dy
-    d = jnp.hypot(px - qx, py - qy)
-    d = jnp.where(valid & (d <= search_radius), d, jnp.inf)
-    return t, qx, qy, d, edge_of
+def _pick(idx: jnp.ndarray, cols: jnp.ndarray) -> jnp.ndarray:
+    """Select rows of ``cols`` [N, C] at ``idx`` [m] as a one-hot matmul
+    -> [m, C].  Exact f32 (see module docstring); replaces a scalar-unit
+    gather with MXU work."""
+    onehot = (idx[:, None] == jnp.arange(cols.shape[0], dtype=idx.dtype)[None, :])
+    return jax.lax.dot(onehot.astype(jnp.float32), cols,
+                       precision=jax.lax.Precision.HIGHEST)
 
 
 def find_candidates(dg: DeviceGraph, px, py, k: int, search_radius: float) -> Candidates:
@@ -107,41 +97,69 @@ def find_candidates(dg: DeviceGraph, px, py, k: int, search_radius: float) -> Ca
     ncy = jnp.clip(jnp.stack([cy0, cy0 + sy]), 0, ny - 1)  # [2]
     cells = (ncy[:, None] * nx + ncx[None, :]).reshape(-1)  # [4]
 
-    # the whole sweep is FOUR contiguous row-gathers (one aligned DMA per
-    # cell): each cell row carries its cap candidate records inline
-    # (ax, ay, bx, by, off, len, edge-bits per record; empty slots edge -1)
-    rows = dg.cell_rows[cells].reshape(-1, 8)  # [4*cap, 8]
-    _, _, _, d, _ = _project(px, py, rows, search_radius)
+    # FOUR contiguous row-gathers (one aligned DMA per cell); each row is 8
+    # plane-major component runs of cap values (SoA — the unpack below
+    # reads contiguous runs, not stride-8 picks)
+    cap = dg.cell_rows.shape[1] // 8
+    block = dg.cell_rows[cells].reshape(4, 8, cap)
+    ax = block[:, 0, :].reshape(-1)  # [N], N = 4*cap
+    ay = block[:, 1, :].reshape(-1)
+    bx = block[:, 2, :].reshape(-1)
+    by = block[:, 3, :].reshape(-1)
+    off0 = block[:, 4, :].reshape(-1)
+    slen = block[:, 5, :].reshape(-1)
+    edge_f = block[:, 6, :].reshape(-1)  # float edge id, -1.0 empty
+    valid = edge_f >= 0
+
+    dx = bx - ax
+    dy = by - ay
+    len2 = dx * dx + dy * dy
+    t = jnp.where(
+        len2 > 0,
+        ((px - ax) * dx + (py - ay) * dy) / jnp.where(len2 > 0, len2, 1.0),
+        0.0,
+    )
+    t = jnp.clip(t, 0.0, 1.0)
+    qx = ax + t * dx
+    qy = ay + t * dy
+    d = jnp.hypot(px - qx, py - qy)
+    d = jnp.where(valid & (d <= search_radius), d, BIG)  # BIG = miss
+    off_full = off0 + t * slen
 
     # Select a widened pool of nearest shape segments, dedup per edge, then
     # narrow to K.  Deduping *after* a width-K selection would let one curvy
     # edge (many shape segments near the point) crowd every distinct edge out
     # of the beam; the 4x pool keeps up to 4 co-located polyline pieces per
     # edge without losing the edges behind them.
-    m = min(4 * k, d.shape[0])
+    n = d.shape[0]
+    m = min(4 * k, n)
     _, pool_idx = jax.lax.top_k(-d, m)  # ascending distance order
-
-    # ONE row-gather for the pool, then recompute the projection on [m]
-    # rows (bit-identical to d[pool_idx] — same inputs, same ops) instead
-    # of index-gathering each component array separately
-    pool_rows = rows[pool_idx]  # [m, 8]
-    t_p, qx_p, qy_p, d_p, edge_p = _project(px, py, pool_rows, search_radius)
-    pool_edge = jnp.where(jnp.isfinite(d_p), edge_p, -1)
+    cols = jnp.stack([d, edge_f, off_full, qx, qy], axis=1)  # [N, 5]
+    pool = _pick(pool_idx, cols)  # [m, 5]
+    pd, pedge_f, poff, pqx, pqy = (pool[:, j] for j in range(5))
+    pool_edge = jnp.where(pd < BIG / 2, pedge_f.astype(jnp.int32), -1)
 
     # keep only the nearest (earliest) slot of each edge
     same = (pool_edge[None, :] == pool_edge[:, None]) & (pool_edge[None, :] >= 0)
     earlier = jnp.triu(jnp.ones((m, m), jnp.bool_), 1)  # [i, j] true iff i < j
     dup = jnp.any(same & earlier, axis=0)
-    d_p = jnp.where(dup, jnp.inf, d_p)
+    pd = jnp.where(dup, BIG, pd)
 
-    _, sel = jax.lax.top_k(-d_p, k)  # [k] indices into the pool
-    top_d = d_p[sel]
-    top_edge = jnp.where(jnp.isfinite(top_d), pool_edge[sel], -1)
-    top_off = pool_rows[sel, 4] + t_p[sel] * pool_rows[sel, 5]
-    top_qx = qx_p[sel]
-    top_qy = qy_p[sel]
+    # a sparse grid can have fewer pool slots than the beam (4*cap < k);
+    # select what exists and pad the rest with invalid slots
+    kk = min(k, m)
+    _, sel = jax.lax.top_k(-pd, kk)  # [kk] indices into the pool
+    pool2 = jnp.stack([pd, pedge_f, poff, pqx, pqy], axis=1)  # [m, 5]
+    top = _pick(sel, pool2)  # [kk, 5]
+    if kk < k:
+        pad = jnp.zeros((k - kk, 5), jnp.float32)
+        pad = pad.at[:, 0].set(BIG).at[:, 1].set(-1.0)
+        top = jnp.concatenate([top, pad], axis=0)
+    td, tedge_f, toff, tqx, tqy = (top[:, j] for j in range(5))
+    top_d = jnp.where(td < BIG / 2, td, jnp.inf)
+    top_edge = jnp.where(td < BIG / 2, tedge_f.astype(jnp.int32), -1)
 
-    return Candidates(edge=top_edge, offset=top_off, dist=top_d, cx=top_qx, cy=top_qy)
+    return Candidates(edge=top_edge, offset=toff, dist=top_d, cx=tqx, cy=tqy)
 
 
 def find_candidates_batch(dg: DeviceGraph, px, py, k: int, search_radius: float) -> Candidates:

@@ -107,10 +107,13 @@ def _ubodt_lookup_sharded(u: DeviceUBODT, src: jnp.ndarray, dst: jnp.ndarray):
         # out-of-range buckets contribute entries that match nothing (-2)
         return jnp.where(inr[..., None], r, -2)
 
-    rows = jnp.concatenate([local_rows(b1), local_rows(b2)], axis=-1)
-    rows = rows.reshape(rows.shape[:-1] + (2 * BUCKET, ROW_W))
-    dist, time, first = _select(rows, src, dst)
-    dist = jax.lax.pmin(dist, u.shard_axis)
-    time = jax.lax.pmin(time, u.shard_axis)
-    first = jax.lax.pmax(first, u.shard_axis)
+    r1 = local_rows(b1)
+    r2 = local_rows(b2)
+    # per-bucket select + min/max merge, like the unsharded path: avoids
+    # materialising the concatenated [..., 2*BUCKET*ROW_W] layout
+    d1, t1, f1 = _select(r1.reshape(r1.shape[:-1] + (BUCKET, ROW_W)), src, dst)
+    d2, t2, f2 = _select(r2.reshape(r2.shape[:-1] + (BUCKET, ROW_W)), src, dst)
+    dist = jax.lax.pmin(jnp.minimum(d1, d2), u.shard_axis)
+    time = jax.lax.pmin(jnp.minimum(t1, t2), u.shard_axis)
+    first = jax.lax.pmax(jnp.maximum(f1, f2), u.shard_axis)
     return dist, time, first

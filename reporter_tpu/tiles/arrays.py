@@ -44,12 +44,13 @@ class DeviceGraph(NamedTuple):
     # to-node-bits, from-node-bits, len, speed, head0, head1, pad, pad
     edge_rows: "jnp.ndarray"
     edge_seg: "jnp.ndarray"  # [n_edges] i32 dense segment index (histograms)
-    # CELL-MAJOR candidate rows [n_cells, cap*8] f32: for every grid cell,
-    # its (up to cap) shape segments as interleaved 8-lane records (ax, ay,
-    # bx, by, off, len, edge-id-bits, pad; empty slots carry edge -1).  A
-    # point's whole quadrant-cell candidate sweep is then FOUR contiguous
+    # CELL-MAJOR candidate planes [n_cells, 8*cap] f32: for every grid
+    # cell, its (up to cap) shape segments as 8 contiguous component planes
+    # (ax, ay, bx, by, off, len, edge-value, pad; empty slots edge -1.0).
+    # A point's whole quadrant-cell candidate sweep is then FOUR contiguous
     # row-gathers — one aligned DMA per cell — instead of 4*cap scattered
-    # item gathers; same layout rationale as the UBODT's 128-lane buckets.
+    # item gathers, and the component unpack reads contiguous cap-runs
+    # (plane-major/SoA; see GraphArrays._cell_rows for why).
     # (Rank-2 with a flat minor dim on purpose: TPU layouts tile the two
     # minor dims to (8, 128), so a rank-3 [cells, cap, 8] would pad 16x.)
     cell_rows: "jnp.ndarray"
@@ -111,9 +112,25 @@ class GraphArrays:
         cy = int(np.clip((y - self.grid_y0) // self.cell_size, 0, self.grid_ny - 1))
         return cx, cy
 
-    def _shp_packed(self) -> np.ndarray:
-        """Interleaved [n_items, 8] f32 shape rows (see DeviceGraph)."""
+    def _cell_rows(self) -> np.ndarray:
+        """Cell-major [n_cells, 8*cap] f32 candidate planes (see DeviceGraph).
+
+        PLANE-major (SoA) within each cell: 8 contiguous planes of cap
+        values — ax*cap, ay*cap, bx*cap, by*cap, off*cap, len*cap,
+        edge*cap, pad — so the device sweep's per-component unpack is
+        contiguous cap-runs instead of stride-8 element picks (the round-4
+        interleaved layout made that unpack ~20 % of kernel time on chip,
+        docs/onchip-attribution.md).  The edge id is stored as its FLOAT
+        VALUE (-1.0 for empty slots), exact for ids < 2**24 (asserted at
+        build), so selection can flow through the one-hot-matmul path in
+        ops/candidates.py without bitcasts."""
+        items = self.grid_items  # [n_cells, cap], -1 padded
+        n_cells, cap = items.shape
         n = len(self.shp_ax)
+        if self.num_edges >= (1 << 24):  # data validation, not a debug assert
+            raise ValueError(
+                "%d edges: ids no longer exact in float32 candidate planes; "
+                "shard the region into smaller tile sets" % self.num_edges)
         packed = np.zeros((n, 8), np.float32)
         packed[:, 0] = self.shp_ax
         packed[:, 1] = self.shp_ay
@@ -121,21 +138,13 @@ class GraphArrays:
         packed[:, 3] = self.shp_by
         packed[:, 4] = self.shp_off
         packed[:, 5] = self.shp_len
-        packed[:, 6] = np.asarray(self.shp_edge, np.int32).view(np.float32)
-        return packed
-
-    def _cell_rows(self) -> np.ndarray:
-        """Cell-major [n_cells, cap*8] f32 candidate rows (see DeviceGraph).
-        Empty slots carry edge-id -1 (bit pattern) so the device sweep can
-        mask them without a separate item array."""
-        items = self.grid_items  # [n_cells, cap], -1 padded
-        n_cells, cap = items.shape
-        packed = self._shp_packed()
+        packed[:, 6] = np.asarray(self.shp_edge, np.float32)
         rows = packed[np.where(items >= 0, items, 0)]  # [n_cells, cap, 8]
         empty = items < 0
         rows[empty] = 0.0
-        rows[empty, 6] = np.array(-1, np.int32).view(np.float32)
-        return np.ascontiguousarray(rows.reshape(n_cells, cap * 8))
+        rows[empty, 6] = -1.0
+        return np.ascontiguousarray(
+            rows.transpose(0, 2, 1).reshape(n_cells, 8 * cap))
 
     def _edge_rows(self) -> np.ndarray:
         """Interleaved [n_edges, 8] f32 per-edge rows (see DeviceGraph)."""
