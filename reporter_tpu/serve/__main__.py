@@ -25,13 +25,22 @@ def main(argv):
     # With the env set, the single positional may be the bind address.
     args = list(argv[1:])
     env_conf = os.environ.get("MATCHER_CONF_FILE")
-    def _looks_like_addr(a):
-        return (":" in a or a.isdigit()) and not os.path.exists(a)
 
-    if args and not (env_conf and _looks_like_addr(args[0])):
+    def _parses_as_addr(a):
+        # host:port, :port, or a bare port -- a typo'd config path with a
+        # ':' in it must NOT silently become a bind address (ADVICE r04)
+        _host, _sep, port = a.rpartition(":")
+        return (port or a).isdigit()
+
+    if args and not (env_conf and len(args) == 1 and _parses_as_addr(args[0])
+                     and not os.path.exists(args[0])):
         conf_path, addr_args = args[0], args[1:]
+        chosen = "positional argument"
     else:
         conf_path, addr_args = env_conf, args
+        chosen = "MATCHER_CONF_FILE"
+    if conf_path:
+        logging.info("config: %s (from %s)", conf_path, chosen)
     if not conf_path:
         sys.stderr.write(
             "usage: python -m reporter_tpu.serve <config.json> [host:port]\n"
@@ -112,6 +121,10 @@ def main(argv):
         httpd.serve_forever()
     except KeyboardInterrupt:
         logging.info("shutting down (signal)")
+        # flip the drain flag first: handlers close their connection after
+        # the in-flight request, bounding server_close's handler join even
+        # for clients actively streaming keep-alive requests
+        service.draining = True
         if stop_warm is not None:
             # let the in-flight warmup compile finish before tearing down
             # the runtime under it (bounded: anything longer than one

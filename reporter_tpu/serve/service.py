@@ -160,6 +160,11 @@ class ReporterService:
         self._counter_lock = threading.Lock()
         self._n_requests = 0
         self._n_errors = 0
+        # graceful-shutdown drain: once True, every handler closes its
+        # connection after the in-flight request, so server_close's join
+        # of non-daemon handler threads is bounded by one request even for
+        # clients actively streaming keep-alive requests (ADVICE r04)
+        self.draining = False
 
     # -- request handling --------------------------------------------------
 
@@ -293,10 +298,18 @@ class ReporterService:
                 must close the connection (keep-alive framing is lost)."""
                 raw = self.headers.get("Content-Length", "0")
                 try:
-                    return max(0, int(raw))
+                    n = int(raw)
                 except (TypeError, ValueError):
                     self.close_connection = True
                     return None
+                if n < 0:
+                    # a negative length is as malformed as a non-numeric
+                    # one: clamping it to 0 would leave the request's body
+                    # bytes unread on a keep-alive socket, to be parsed as
+                    # the next request line (ADVICE r04)
+                    self.close_connection = True
+                    return None
+                return n
 
             def _drain_body(self, post: bool):
                 """Consume any request body before an early answer: the
@@ -308,6 +321,8 @@ class ReporterService:
                         self.rfile.read(n)
 
             def _route(self, post: bool):
+                if service.draining:
+                    self.close_connection = True  # answer, then drain out
                 try:
                     split = urlsplit(self.path)
                     action = split.path.split("/")[-1]

@@ -116,42 +116,69 @@ def main(argv=None) -> int:
         from ..utils.shutdown import StopFlag
 
         flag = StopFlag().install()
-        fd = sys.stdin.buffer.raw.fileno()
-        # epoll cannot watch REGULAR files (EPERM on `cli < probes.sv`);
-        # file reads never block indefinitely, so the selector — needed for
-        # pipe liveness under a stop signal — is skipped for them
+        # an embedder may have replaced sys.stdin with a non-file object
+        # (the finally-block below exists for exactly such callers): only
+        # take the raw-fd fast path when the real buffer is there, else
+        # fall back to plain line iteration with the flag polled per line
+        # (ADVICE r04)
+        raw_stdin = getattr(getattr(sys.stdin, "buffer", None), "raw", None)
         sel = None
         try:
-            try:
-                sel = selectors.DefaultSelector()
-                sel.register(sys.stdin.buffer.raw, selectors.EVENT_READ)
-            except (PermissionError, ValueError):
-                if sel is not None:
-                    sel.close()
-                sel = None
             start = time.time()
-            buf = b""
-            eof = False
-            while not (flag.requested or eof):
-                now = time.time()
-                if args.duration is not None and now - start > args.duration:
-                    break
-                if sel is not None and not sel.select(timeout=0.5):
-                    ckpt.maybe_save(int(now * 1000))
-                    continue
-                chunk = os.read(fd, 1 << 16)
-                if not chunk:
-                    eof = True
-                else:
-                    buf += chunk
-                now_ms = int(time.time() * 1000)
-                *lines, buf = buf.split(b"\n")
-                for raw in lines:
-                    pipeline.feed(raw.decode("utf-8", "replace").rstrip("\r"), now_ms)
-                ckpt.maybe_save(now_ms)
-            if buf and eof:  # trailing record without newline
-                pipeline.feed(buf.decode("utf-8", "replace").rstrip("\r"),
-                              int(time.time() * 1000))
+            if raw_stdin is None:
+                it = iter(sys.stdin)
+                while True:
+                    try:
+                        line = next(it)
+                    except StopIteration:
+                        break
+                    except UnicodeDecodeError:
+                        continue  # strict embedder wrapper; raw path
+                        # substitutes U+FFFD -- skip, don't abort the stream
+                    # feed BEFORE the stop checks: a line already consumed
+                    # from the iterator must not be dropped on shutdown
+                    now_ms = int(time.time() * 1000)
+                    pipeline.feed(line.rstrip("\n").rstrip("\r"), now_ms)
+                    ckpt.maybe_save(now_ms)
+                    if flag.requested or (
+                            args.duration is not None
+                            and time.time() - start > args.duration):
+                        break
+            else:
+                fd = raw_stdin.fileno()
+                # epoll cannot watch REGULAR files (EPERM on
+                # `cli < probes.sv`); file reads never block indefinitely,
+                # so the selector — needed for pipe liveness under a stop
+                # signal — is skipped for them
+                try:
+                    sel = selectors.DefaultSelector()
+                    sel.register(raw_stdin, selectors.EVENT_READ)
+                except (PermissionError, ValueError):
+                    if sel is not None:
+                        sel.close()
+                    sel = None
+                buf = b""
+                eof = False
+                while not (flag.requested or eof):
+                    now = time.time()
+                    if args.duration is not None and now - start > args.duration:
+                        break
+                    if sel is not None and not sel.select(timeout=0.5):
+                        ckpt.maybe_save(int(now * 1000))
+                        continue
+                    chunk = os.read(fd, 1 << 16)
+                    if not chunk:
+                        eof = True
+                    else:
+                        buf += chunk
+                    now_ms = int(time.time() * 1000)
+                    *lines, buf = buf.split(b"\n")
+                    for raw in lines:
+                        pipeline.feed(raw.decode("utf-8", "replace").rstrip("\r"), now_ms)
+                    ckpt.maybe_save(now_ms)
+                if buf and eof:  # trailing record without newline
+                    pipeline.feed(buf.decode("utf-8", "replace").rstrip("\r"),
+                                  int(time.time() * 1000))
             if flag.requested:
                 logging.info("stop signal: flushing before exit")
             pipeline.close(int(time.time() * 1000))
