@@ -1,0 +1,268 @@
+/* CPython extension: wire-format record materialisation.
+ *
+ * The batched C++ association walk (reporter_native.cc rn_associate_batch*)
+ * returns columnar arrays; turning them into the list-of-dicts wire format
+ * was a pure-Python loop costing ~8 us per record -- at fleet scale that
+ * loop alone rivalled the device kernel time (tools/host_profile.py).
+ * This extension builds the same records in C against the buffer protocol.
+ *
+ * Byte-for-byte parity with the Python loop in
+ * reporter_tpu/matching/assoc_native.py (which remains as the fallback):
+ *   - identical dict key insertion order (JSON serialisation order);
+ *   - rounding via the REAL builtins.round (correct decimal rounding --
+ *     not a C reimplementation that could differ in the last digit);
+ *   - negative start/end/length sentinel is the Python int -1.
+ *
+ * Environment note: pybind11 is not available in this image; the plain
+ * CPython C API is the sanctioned binding path.
+ */
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+typedef struct {
+    Py_buffer buf;
+    int held;
+} BufGuard;
+
+/* fmt_expected: the set of acceptable single-char struct format codes
+ * (e.g. "lq" for int64 -- numpy may report either on LP64). */
+static int get_buf(PyObject *obj, BufGuard *g, const char *fmt_expected,
+                   Py_ssize_t itemsize) {
+    if (PyObject_GetBuffer(obj, &g->buf, PyBUF_CONTIG_RO | PyBUF_FORMAT) < 0)
+        return -1;
+    g->held = 1;
+    if (g->buf.itemsize != itemsize) {
+        PyErr_Format(PyExc_TypeError,
+                     "expected itemsize %zd (%s), got %zd",
+                     itemsize, fmt_expected, g->buf.itemsize);
+        return -1;
+    }
+    /* same-width dtype confusion (e.g. f64 where i64 is expected) must not
+     * silently reinterpret bits */
+    const char *f = g->buf.format;
+    if (f && ((f[0] && f[1] != '\0') || !strchr(fmt_expected, f[0]))) {
+        PyErr_Format(PyExc_TypeError, "expected format one of '%s', got '%s'",
+                     fmt_expected, f);
+        return -1;
+    }
+    return 0;
+}
+
+static void release_all(BufGuard *gs, int n) {
+    for (int i = 0; i < n; ++i)
+        if (gs[i].held) PyBuffer_Release(&gs[i].buf);
+}
+
+/* round(value, nd) via builtins.round; returns new ref or NULL */
+static PyObject *py_round(PyObject *round_fn, double v, PyObject *nd) {
+    PyObject *f = PyFloat_FromDouble(v);
+    if (!f) return NULL;
+    PyObject *r = PyObject_CallFunctionObjArgs(round_fn, f, nd, NULL);
+    Py_DECREF(f);
+    return r;
+}
+
+static PyObject *build_records(PyObject *self, PyObject *args) {
+    (void)self;
+    long B_l;
+    PyObject *o_rec_start, *o_has_seg, *o_seg_id, *o_t0, *o_t1, *o_length,
+        *o_internal, *o_qlen, *o_bshape, *o_eshape, *o_way_start, *o_way_ids;
+    if (!PyArg_ParseTuple(args, "lOOOOOOOOOOOO", &B_l, &o_rec_start,
+                          &o_has_seg, &o_seg_id, &o_t0, &o_t1, &o_length,
+                          &o_internal, &o_qlen, &o_bshape, &o_eshape,
+                          &o_way_start, &o_way_ids))
+        return NULL;
+
+    BufGuard g[12];
+    memset(g, 0, sizeof(g));
+    PyObject *result = NULL, *round_fn = NULL, *nd1 = NULL, *nd3 = NULL;
+    PyObject *k_way = NULL, *k_int = NULL, *k_qlen = NULL, *k_bsi = NULL,
+        *k_esi = NULL, *k_sid = NULL, *k_st = NULL, *k_et = NULL,
+        *k_len = NULL, *neg1 = NULL;
+
+    if (get_buf(o_rec_start, &g[0], "lq", 8) < 0) goto done;
+    if (get_buf(o_has_seg, &g[1], "B", 1) < 0) goto done;
+    if (get_buf(o_seg_id, &g[2], "lq", 8) < 0) goto done;
+    if (get_buf(o_t0, &g[3], "d", 8) < 0) goto done;
+    if (get_buf(o_t1, &g[4], "d", 8) < 0) goto done;
+    if (get_buf(o_length, &g[5], "d", 8) < 0) goto done;
+    if (get_buf(o_internal, &g[6], "B", 1) < 0) goto done;
+    if (get_buf(o_qlen, &g[7], "d", 8) < 0) goto done;
+    if (get_buf(o_bshape, &g[8], "i", 4) < 0) goto done;
+    if (get_buf(o_eshape, &g[9], "i", 4) < 0) goto done;
+    if (get_buf(o_way_start, &g[10], "lq", 8) < 0) goto done;
+    if (get_buf(o_way_ids, &g[11], "lq", 8) < 0) goto done;
+
+    const long long *rec_start = (const long long *)g[0].buf.buf;
+    const unsigned char *has_seg = (const unsigned char *)g[1].buf.buf;
+    const long long *seg_id = (const long long *)g[2].buf.buf;
+    const double *t0 = (const double *)g[3].buf.buf;
+    const double *t1 = (const double *)g[4].buf.buf;
+    const double *length = (const double *)g[5].buf.buf;
+    const unsigned char *internal = (const unsigned char *)g[6].buf.buf;
+    const double *qlen = (const double *)g[7].buf.buf;
+    const int *bshape = (const int *)g[8].buf.buf;
+    const int *eshape = (const int *)g[9].buf.buf;
+    const long long *way_start = (const long long *)g[10].buf.buf;
+    const long long *way_ids = (const long long *)g[11].buf.buf;
+
+    Py_ssize_t B = (Py_ssize_t)B_l;
+    Py_ssize_t n_rec_max = g[1].buf.len;           /* has_seg length bound */
+    Py_ssize_t n_ws = g[10].buf.len / 8;           /* way_start entries */
+    Py_ssize_t n_wi = g[11].buf.len / 8;           /* way_ids entries */
+    if (g[0].buf.len / 8 < B + 1) {
+        PyErr_SetString(PyExc_ValueError, "rec_start shorter than B+1");
+        goto done;
+    }
+
+    PyObject *builtins = PyEval_GetBuiltins();      /* borrowed */
+    round_fn = PyMapping_GetItemString(builtins, "round");
+    if (!round_fn) goto done;
+    nd1 = PyLong_FromLong(1);
+    nd3 = PyLong_FromLong(3);
+    neg1 = PyLong_FromLong(-1);
+    k_way = PyUnicode_InternFromString("way_ids");
+    k_int = PyUnicode_InternFromString("internal");
+    k_qlen = PyUnicode_InternFromString("queue_length");
+    k_bsi = PyUnicode_InternFromString("begin_shape_index");
+    k_esi = PyUnicode_InternFromString("end_shape_index");
+    k_sid = PyUnicode_InternFromString("segment_id");
+    k_st = PyUnicode_InternFromString("start_time");
+    k_et = PyUnicode_InternFromString("end_time");
+    k_len = PyUnicode_InternFromString("length");
+    if (!nd1 || !nd3 || !neg1 || !k_way || !k_int || !k_qlen || !k_bsi ||
+        !k_esi || !k_sid || !k_st || !k_et || !k_len)
+        goto done;
+
+    result = PyList_New(B);
+    if (!result) goto done;
+
+    for (Py_ssize_t b = 0; b < B; ++b) {
+        long long r0 = rec_start[b], r1 = rec_start[b + 1];
+        if (r0 < 0 || r1 < r0 || r1 > n_rec_max || r1 + 1 > n_ws) {
+            PyErr_SetString(PyExc_ValueError, "record bounds out of range");
+            goto done;
+        }
+        PyObject *recs = PyList_New((Py_ssize_t)(r1 - r0));
+        if (!recs) goto done;
+        PyList_SET_ITEM(result, b, recs);  /* steals */
+        for (long long r = r0; r < r1; ++r) {
+            PyObject *rec = PyDict_New();
+            if (!rec) goto done;
+            PyList_SET_ITEM(recs, (Py_ssize_t)(r - r0), rec); /* steals */
+
+            long long w0 = way_start[r], w1 = way_start[r + 1];
+            if (w0 < 0 || w1 < w0 || w1 > n_wi) {
+                PyErr_SetString(PyExc_ValueError, "way bounds out of range");
+                goto done;
+            }
+            PyObject *ways = PyList_New((Py_ssize_t)(w1 - w0));
+            if (!ways) goto done;
+            for (long long w = w0; w < w1; ++w) {
+                PyObject *wid = PyLong_FromLongLong(way_ids[w]);
+                if (!wid) { Py_DECREF(ways); goto done; }
+                PyList_SET_ITEM(ways, (Py_ssize_t)(w - w0), wid);
+            }
+            int rc = PyDict_SetItem(rec, k_way, ways);
+            Py_DECREF(ways);
+            if (rc < 0) goto done;
+
+            PyObject *bv = internal[r] ? Py_True : Py_False;
+            if (PyDict_SetItem(rec, k_int, bv) < 0) goto done;
+
+            PyObject *v = py_round(round_fn, qlen[r], nd1);
+            if (!v) goto done;
+            rc = PyDict_SetItem(rec, k_qlen, v);
+            Py_DECREF(v);
+            if (rc < 0) goto done;
+
+            v = PyLong_FromLong(bshape[r]);
+            if (!v) goto done;
+            rc = PyDict_SetItem(rec, k_bsi, v);
+            Py_DECREF(v);
+            if (rc < 0) goto done;
+
+            v = PyLong_FromLong(eshape[r]);
+            if (!v) goto done;
+            rc = PyDict_SetItem(rec, k_esi, v);
+            Py_DECREF(v);
+            if (rc < 0) goto done;
+
+            if (has_seg[r]) {
+                v = PyLong_FromLongLong(seg_id[r]);
+                if (!v) goto done;
+                rc = PyDict_SetItem(rec, k_sid, v);
+                Py_DECREF(v);
+                if (rc < 0) goto done;
+
+                v = t0[r] >= 0 ? py_round(round_fn, t0[r], nd3)
+                               : (Py_INCREF(neg1), neg1);
+                if (!v) goto done;
+                rc = PyDict_SetItem(rec, k_st, v);
+                Py_DECREF(v);
+                if (rc < 0) goto done;
+
+                v = t1[r] >= 0 ? py_round(round_fn, t1[r], nd3)
+                               : (Py_INCREF(neg1), neg1);
+                if (!v) goto done;
+                rc = PyDict_SetItem(rec, k_et, v);
+                Py_DECREF(v);
+                if (rc < 0) goto done;
+
+                v = length[r] >= 0 ? py_round(round_fn, length[r], nd3)
+                                   : (Py_INCREF(neg1), neg1);
+                if (!v) goto done;
+                rc = PyDict_SetItem(rec, k_len, v);
+                Py_DECREF(v);
+                if (rc < 0) goto done;
+            } else {
+                v = py_round(round_fn, t0[r], nd3);
+                if (!v) goto done;
+                rc = PyDict_SetItem(rec, k_st, v);
+                Py_DECREF(v);
+                if (rc < 0) goto done;
+
+                v = py_round(round_fn, t1[r], nd3);
+                if (!v) goto done;
+                rc = PyDict_SetItem(rec, k_et, v);
+                Py_DECREF(v);
+                if (rc < 0) goto done;
+
+                if (PyDict_SetItem(rec, k_len, neg1) < 0) goto done;
+            }
+        }
+    }
+    goto cleanup;
+
+done:
+    Py_CLEAR(result);
+cleanup:
+    Py_XDECREF(round_fn);
+    Py_XDECREF(nd1);
+    Py_XDECREF(nd3);
+    Py_XDECREF(neg1);
+    Py_XDECREF(k_way);
+    Py_XDECREF(k_int);
+    Py_XDECREF(k_qlen);
+    Py_XDECREF(k_bsi);
+    Py_XDECREF(k_esi);
+    Py_XDECREF(k_sid);
+    Py_XDECREF(k_st);
+    Py_XDECREF(k_et);
+    Py_XDECREF(k_len);
+    release_all(g, 12);
+    return result;
+}
+
+static PyMethodDef methods[] = {
+    {"build_records", build_records, METH_VARARGS,
+     "Columnar association output -> list[B] of list of wire-format dicts"},
+    {NULL, NULL, 0, NULL},
+};
+
+static struct PyModuleDef moduledef = {
+    PyModuleDef_HEAD_INIT, "_records", NULL, -1, methods,
+    NULL, NULL, NULL, NULL,
+};
+
+PyMODINIT_FUNC PyInit__records(void) { return PyModule_Create(&moduledef); }

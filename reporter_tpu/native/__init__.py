@@ -226,3 +226,60 @@ def parse_shard_bytes(data: bytes, lib=None):
         np.asarray(lons, np.float64),
         np.asarray(accs, np.int32),
     )
+
+
+# -- CPython extension: wire-format record materialisation -------------------
+#
+# Separate from the ctypes CDLL above because it constructs Python objects
+# (lists/dicts) directly -- that needs the CPython C API, not a plain shared
+# library.  Same lazy-compile contract: accelerates, never gates.
+
+_EXT_SRC = os.path.join(_NATIVE_DIR, "records_ext.c")
+_ext_lock = threading.Lock()
+_ext_mod = None
+_ext_tried = False
+
+
+def get_records_ext(force_rebuild: bool = False):
+    """Compile (lazily) and import native/records_ext.c; None on failure."""
+    global _ext_mod, _ext_tried
+    with _ext_lock:
+        if (_ext_mod is not None or _ext_tried) and not force_rebuild:
+            return _ext_mod
+        _ext_tried = True
+        if not os.path.exists(_EXT_SRC):
+            return None
+        import sysconfig
+
+        suffix = sysconfig.get_config_var("EXT_SUFFIX") or ".so"
+        ext_path = os.path.join(_NATIVE_DIR, "_records%s" % suffix)
+        try:
+            stale = not (
+                os.path.exists(ext_path)
+                and os.path.getmtime(ext_path) >= os.path.getmtime(_EXT_SRC)
+            )
+            if stale or force_rebuild:
+                # compile to a temp path and atomically replace: dlopen
+                # caches by inode, and gcc truncating a still-mapped .so in
+                # place could crash a process executing it (same hazard
+                # get_lib's rebuild path documents)
+                inc = sysconfig.get_paths()["include"]
+                tmp = ext_path + ".build"
+                subprocess.run(
+                    ["gcc", "-O2", "-fPIC", "-shared", "-I", inc,
+                     "-o", tmp, _EXT_SRC],
+                    check=True, capture_output=True, timeout=120,
+                )
+                os.replace(tmp, ext_path)
+            import importlib.util
+
+            # spec name "_records" so the loader finds PyInit__records; the
+            # module is returned without touching sys.modules
+            spec = importlib.util.spec_from_file_location("_records", ext_path)
+            mod = importlib.util.module_from_spec(spec)
+            spec.loader.exec_module(mod)
+            _ext_mod = mod
+        except Exception as e:  # noqa: BLE001 - never gate on the fast path
+            log.warning("records extension unavailable, using Python loop: %s", e)
+            _ext_mod = None
+        return _ext_mod

@@ -127,3 +127,32 @@ def test_native_mt_matches_single_thread(setup, monkeypatch):
     )
     for out in outs:
         assert out == oracle
+
+
+def test_records_extension_matches_python_loop(setup, monkeypatch):
+    """The CPython record materialiser (native/records_ext.c) must be
+    byte-identical to the pure-Python column loop it replaces: same key
+    order, same builtins.round results, same -1 sentinels."""
+    from reporter_tpu.matching import assoc_native as an
+    from reporter_tpu import native as rn
+
+    lib = get_lib()
+    if lib is None or rn.get_records_ext() is None:
+        pytest.skip("no native compiler available")
+    arrays, ubodt = setup
+    cfg, edge, offset, breaks, abs_tm = _matched_batch(arrays, ubodt)
+    B, T = edge.shape
+    n_pts = np.full(B, T, np.int32)
+    kw = dict(
+        queue_thresh_mps=cfg.queue_speed_threshold_kph / 3.6,
+        back_tol=2.0 * cfg.sigma_z + 5.0,
+    )
+    fast = associate_segments_batch(
+        arrays, ubodt, edge, offset, breaks, abs_tm, n_pts, lib=lib, **kw)
+    monkeypatch.setattr(an, "get_records_ext", lambda: None)
+    slow = associate_segments_batch(
+        arrays, ubodt, edge, offset, breaks, abs_tm, n_pts, lib=lib, **kw)
+    assert fast == slow
+    import json
+
+    assert json.dumps(fast) == json.dumps(slow)
