@@ -102,3 +102,43 @@ def test_candidates_batch_shape(arrays):
     got = find_candidates_batch(dg, px, py, 8, 50.0)
     assert got.edge.shape == (3, 7, 8)
     assert got.dist.shape == (3, 7, 8)
+
+
+def test_candidates_brute_force_at_cell_boundaries(arrays):
+    """Quadrant-sweep adversarial points: exactly on and just around cell
+    boundaries and half-cell lines, where the sx/sy neighbour choice flips.
+    The brute-force scan is the independent completeness oracle (it shares
+    no code with the quadrant rule)."""
+    import jax
+    import jax.numpy as jnp
+
+    from reporter_tpu.ops.candidates import find_candidates
+
+    dg = arrays.to_device()
+    fn = jax.jit(find_candidates, static_argnums=(3,))
+    cell = arrays.cell_size
+    x0, y0 = arrays.grid_x0, arrays.grid_y0
+    eps = [0.0, 1e-3, -1e-3, 0.49 * cell, 0.5 * cell, 0.51 * cell]
+    checked = 0
+    for cx in (2, 3, 4):
+        for cy in (2, 3, 4):
+            for ex in eps:
+                for ey in (0.0, 0.5 * cell, 1e-3):
+                    x = float(x0 + cx * cell + ex)
+                    y = float(y0 + cy * cell + ey)
+                    got = fn(dg, jnp.float32(x), jnp.float32(y), 16,
+                             jnp.float32(50.0))
+                    got_edges = {
+                        int(e) for e in np.asarray(got.edge) if e >= 0
+                    }
+                    # float32 vs float64 projection can flip membership for
+                    # segments within ~1 cm of the radius: require
+                    # narrow(49.99) <= got <= wide(50.01)
+                    want_wide = brute_force_candidates(arrays, x, y, 50.01)
+                    want_narrow = brute_force_candidates(arrays, x, y, 49.99)
+                    if len(want_wide) > 16:
+                        continue
+                    assert got_edges <= set(want_wide), (x, y)
+                    assert set(want_narrow) <= got_edges, (x, y)
+                    checked += 1
+    assert checked > 100
