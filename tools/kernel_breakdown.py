@@ -10,6 +10,12 @@ transition/UBODT stage, and the sequential scan machinery — the evidence
 needed before optimising any one of them (e.g. a temporal-parallel Viterbi
 only pays if `full - transitions` dominates).
 
+WARNING: stage ratios measured on the CPU backend DO NOT transfer to the
+chip (round 4 measured "transitions ~95%" here; the on-chip traces said
+candidates ~57% — docs/onchip-attribution.md).  For device claims, run this
+on the real chip (--platform axon) or analyse a profiler capture with
+tools/trace_analyze.py.
+
 Timing fetches a scalar reduction per rep (block_until_ready is optimistic
 on the tunneled backend); tables are jit arguments, never closures.
 
@@ -55,6 +61,10 @@ def main():
     from reporter_tpu.tiles.ubodt import build_ubodt
 
     print("platform:", jax.devices()[0], flush=True)
+    if jax.devices()[0].platform != "tpu":
+        print("WARNING: CPU-backend stage ratios do not transfer to the chip "
+              "(docs/onchip-attribution.md); use trace_analyze.py for device "
+              "claims", flush=True)
     cfg = MatcherConfig()
     k = cfg.beam_k
     t0 = time.time()
