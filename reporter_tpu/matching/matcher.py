@@ -258,17 +258,24 @@ class SegmentMatcher:
             return ("jax", B, res)
         return ("cpu", self._cpu.run_batch(px, py, times, valid))
 
-    @staticmethod
-    def _start_host_copy(res) -> None:
+    _host_copy_ok = True  # class-wide: disabled after the first failure
+
+    @classmethod
+    def _start_host_copy(cls, res) -> None:
         """Begin the device->host transfer without blocking, so the later
         np.asarray finds the bytes already moving.  On deployments with a
         fixed per-sync round-trip cost this overlaps the transfer with
-        whatever the host does next; a backend without the PJRT async-copy
-        hook just skips it."""
+        whatever the host does next.  Purely an accelerant: a backend
+        without (or with a broken) PJRT async-copy hook disables it after
+        the first failure and the blocking fetch path is unaffected."""
+        if not cls._host_copy_ok:
+            return
         try:
             res.copy_to_host_async()
-        except AttributeError:
-            pass
+        except Exception:  # noqa: BLE001 - never fail a dispatch over a hint
+            cls._host_copy_ok = False
+            log.info("copy_to_host_async unavailable; async host-copy hint "
+                     "disabled", exc_info=True)
 
     def _collect_batch(self, handle):
         """Block on a _dispatch_batch handle -> (edge, offset, break) numpy.
