@@ -119,14 +119,17 @@ def main() -> None:
                              "ts": time.strftime("%H:%M:%S")})
                 if ok:
                     log("TPU BENCH CAPTURED -> tpu_bench_out.json")
-                    # stage attribution on the real chip (evidence for the
-                    # which-stage-dominates question; see kernel_breakdown)
+                    # stage attribution: the bench itself wrote fresh
+                    # profiler traces (BENCH_PROFILE default on); analyse
+                    # them offline — no extra chip time needed, and the
+                    # per-source-line grouping is the evidence the on-chip
+                    # claims rest on (docs/onchip-attribution.md)
                     rc3, _, _ = run_capture(
                         [sys.executable,
-                         os.path.join(REPO, "tools", "kernel_breakdown.py"),
-                         "--platform", "axon"],
-                        env, 1200, os.path.join(REPO, "tpu_breakdown_out.txt"))
-                    runs.append({"what": "breakdown", "rc": rc3,
+                         os.path.join(REPO, "tools", "trace_analyze.py")],
+                        dict(os.environ), 300,
+                        os.path.join(REPO, "tpu_trace_attrib.json"))
+                    runs.append({"what": "trace_attrib", "rc": rc3,
                                  "ts": time.strftime("%H:%M:%S")})
                     # one successful capture is the job (bench JSON +
                     # breakdown + warmed XLA cache).  Exit rather than keep
@@ -138,8 +141,8 @@ def main() -> None:
                     # collision risk) alive when the bench itself is in.
                     write_state(relay_open=True, open_ports=open_ports,
                                 checks=checks, runs=runs[-8:], pid=os.getpid(),
-                                done=True, breakdown_ok=(rc3 == 0))
-                    log("capture complete (breakdown rc=%s); watcher exiting"
+                                done=True, trace_attrib_ok=(rc3 == 0))
+                    log("capture complete (trace_attrib rc=%s); watcher exiting"
                         % rc3)
                     return
                 # back off after a failing attempt -- a consistently
