@@ -183,10 +183,10 @@ def run_device() -> int:
         while _axon_lock is None and time.time() - t0 < wait_s:
             _axon_lock = acquire_axon_lock(timeout=15.0)
             if _axon_lock is None:
+                holder = axon_lock_holder()
                 _write_status(phase="waiting_for_lock", platform=None,
-                              holder=axon_lock_holder())
-                _stderr("axon client lock held by pid %s; waiting"
-                        % (axon_lock_holder(),))
+                              holder=holder)
+                _stderr("axon client lock held by pid %s; waiting" % (holder,))
         if _axon_lock is None:
             _stderr("axon client lock not acquired within %.0fs" % wait_s)
             _write_status(phase="failed", platform=None, error="lock_timeout")
@@ -488,9 +488,10 @@ def run_device() -> int:
     _stderr("segment agreement vs truth: %s (mean %.3f)" % (agreement, agr_mean))
 
     # UBODT coverage: how often the fleet drives into the delta bound
-    # (VERDICT r04 next #4).  misses_within_maxroute is the subset of table
-    # misses a larger delta / on-line router could have answered -- the
-    # potential accuracy cost of the bound; docs/ubodt-delta.md carries the
+    # (VERDICT r04 next #4).  costly_miss = misses that force a transition
+    # break (pair within breakage distance); provable_delta_trunc = the
+    # subset whose straight-line distance alone proves the table could not
+    # hold the route at this delta.  docs/ubodt-delta.md carries the
     # delta-sweep evidence behind the default.
     ubodt_miss = None
     try:

@@ -390,27 +390,25 @@ class SegmentMatcher:
                 # than taxing the streaming latency path with a thread
                 if work:
                     idxs_, handle_, times_ = work[0]
-                    edge, offset, breaks = self._collect_batch(handle_)
-                    self._associate_and_store(
-                        idxs_, edge, offset, breaks, times_, results)
+                    res = self._collect_batch(handle_)
                 else:
-                    group, (edge, offset, breaks), times_ = self._fetch_long(
-                        long_handles[0])
-                    self._associate_and_store(
-                        group, edge, offset, breaks, times_, results)
+                    idxs_, res, times_ = self._fetch_long(long_handles[0])
+                self._associate_and_store(idxs_, *res, times_, results)
                 return results  # type: ignore[return-value]
             fetched: "_queue.Queue" = _queue.Queue(maxsize=2)
 
             def _fetch_all():
+                # every item is (row_indices, (edge, offset, breaks), times);
+                # None terminates, an exception object relays failure
                 try:
                     for idxs_, handle_, times_ in work:
                         fetched.put(
-                            ("chunk", idxs_, self._collect_batch(handle_), times_))
+                            (idxs_, self._collect_batch(handle_), times_))
                     for h in long_handles:
-                        fetched.put(("long", self._fetch_long(h)))
-                    fetched.put(("done",))
+                        fetched.put(self._fetch_long(h))
+                    fetched.put(None)
                 except BaseException as e:  # noqa: BLE001 - relayed to caller
-                    fetched.put(("error", e))
+                    fetched.put(e)
 
             collector = threading.Thread(
                 target=_fetch_all, daemon=True, name="match-collect")
@@ -418,18 +416,12 @@ class SegmentMatcher:
             try:
                 while True:
                     item = fetched.get()
-                    if item[0] == "chunk":
-                        _, idxs_, (edge, offset, breaks), times_ = item
-                        self._associate_and_store(
-                            idxs_, edge, offset, breaks, times_, results)
-                    elif item[0] == "long":
-                        group, (edge, offset, breaks), times_ = item[1]
-                        self._associate_and_store(
-                            group, edge, offset, breaks, times_, results)
-                    elif item[0] == "error":
-                        raise item[1]
-                    else:
+                    if item is None:
                         break
+                    if isinstance(item, BaseException):
+                        raise item
+                    idxs_, res, times_ = item
+                    self._associate_and_store(idxs_, *res, times_, results)
             except BaseException:
                 # unblock the collector (it may be parked on the bounded
                 # queue) and let it run its remaining fetches to completion
