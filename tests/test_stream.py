@@ -411,3 +411,30 @@ def test_stream_swallows_garbage_records(grid_matcher, tmp_path):
     assert pipeline.dropped == n_junk  # every junk record swallowed
     files = glob.glob(os.path.join(out, "*", "*", "*", "*"))
     assert files, "garbage starved the pipeline of its valid tiles"
+
+
+def test_cli_stdin_fallback_for_embedders(tmp_path, monkeypatch):
+    """An embedder that replaced sys.stdin with a plain text object (no
+    .buffer.raw) must still stream records through the CLI: the fallback
+    line-iteration loop feeds every consumed line and flushes on EOF."""
+    import io
+    import sys
+
+    from reporter_tpu.stream.__main__ import main
+
+    lines = "".join(
+        "1|u-%d|37.75|-122.45|5|%d\n" % (i % 2, 1000 + i * 5)
+        for i in range(30)
+    )
+    monkeypatch.setattr(sys, "stdin", io.StringIO(lines))
+    out_dir = tmp_path / "tiles"
+    rc = main([
+        "--format", "|sv||1|2|3|4|5",
+        "--reporter-url", "local",
+        "--privacy", "1",
+        "--quantisation", "3600",
+        "--source", "test",
+        "--output", str(out_dir),
+        "--flush-interval", "1",
+    ])
+    assert rc == 0
