@@ -48,16 +48,38 @@ def device_pair_hash2(src: jnp.ndarray, dst: jnp.ndarray, mask: int) -> jnp.ndar
     return (h & jnp.uint32(mask)).astype(jnp.int32)
 
 
+def _entry_spread_matrix() -> jnp.ndarray:
+    """[LANES, LANES] 0/1 matrix: column l' sums the F_SRC and F_DST lanes of
+    l's own entry, so (mask @ A) == 2 marks EVERY lane of a hit entry."""
+    lanes = BUCKET * ROW_W
+    l = jnp.arange(lanes)
+    same_entry = (l[:, None] // ROW_W) == (l[None, :] // ROW_W)
+    is_key = (l[:, None] % ROW_W == F_SRC) | (l[:, None] % ROW_W == F_DST)
+    return (same_entry & is_key).astype(jnp.float32)
+
+
 def _select(rows: jnp.ndarray, src: jnp.ndarray, dst: jnp.ndarray):
-    """rows: [..., E, ROW_W] candidate entries -> (dist, time, first) with
-    +inf / -1 on miss.  Keys are unique so at most one entry hits; min/max
-    reduces resolve the select without another gather."""
-    hit = (rows[..., F_SRC] == src[..., None]) & (rows[..., F_DST] == dst[..., None])
-    dist_f = jax.lax.bitcast_convert_type(rows[..., F_DIST], jnp.float32)
-    time_f = jax.lax.bitcast_convert_type(rows[..., F_TIME], jnp.float32)
-    dist = jnp.min(jnp.where(hit, dist_f, jnp.inf), axis=-1)
-    time = jnp.min(jnp.where(hit, time_f, jnp.inf), axis=-1)
-    first = jnp.max(jnp.where(hit, rows[..., F_FE], -1), axis=-1)
+    """rows: [..., BUCKET*ROW_W] interleaved lane rows -> (dist, time, first)
+    with +inf / -1 on miss.  Keys are unique so at most one entry hits.
+
+    Works entirely in the native 128-lane layout: lane l holds field
+    (l % ROW_W) of entry (l // ROW_W).  The per-entry src AND dst match is
+    resolved by summing the two key-lane indicators with one static 0/1
+    matmul over the lane axis (sums are small integers, exact at any matmul
+    precision), then min/max lane-reduces pick each result field.  The
+    previous reshape to (..., BUCKET, ROW_W) = (16, 8) minor dims tile-pads
+    16-128x on TPU and blew HBM at fleet shapes (s32[512,63,8,8,16,8]
+    padded 1008 MB -> 15.75 GB; measured compile OOM on v5e, 2026-07-31).
+    """
+    lanes = rows.shape[-1]
+    fld = jax.lax.iota(jnp.int32, lanes) % ROW_W
+    m = ((rows == src[..., None]) & (fld == F_SRC)) | (
+        (rows == dst[..., None]) & (fld == F_DST))
+    both = jnp.dot(m.astype(jnp.float32), _entry_spread_matrix()) == 2.0
+    vf = jax.lax.bitcast_convert_type(rows, jnp.float32)
+    dist = jnp.min(jnp.where(both & (fld == F_DIST), vf, jnp.inf), axis=-1)
+    time = jnp.min(jnp.where(both & (fld == F_TIME), vf, jnp.inf), axis=-1)
+    first = jnp.max(jnp.where(both & (fld == F_FE), rows, -1), axis=-1)
     return dist, time, first
 
 
@@ -80,8 +102,8 @@ def ubodt_lookup(u: DeviceUBODT, src: jnp.ndarray, dst: jnp.ndarray):
     # hits and an elementwise min/max merges exactly.  (Concatenating the
     # two row sets first materialised a [..., 2*BUCKET*ROW_W] array — ~11 ms
     # of pure layout work per kernel rep on chip, docs/onchip-attribution.md)
-    d1, t1, f1 = _select(r1.reshape(r1.shape[:-1] + (BUCKET, ROW_W)), src, dst)
-    d2, t2, f2 = _select(r2.reshape(r2.shape[:-1] + (BUCKET, ROW_W)), src, dst)
+    d1, t1, f1 = _select(r1, src, dst)
+    d2, t2, f2 = _select(r2, src, dst)
     return jnp.minimum(d1, d2), jnp.minimum(t1, t2), jnp.maximum(f1, f2)
 
 
@@ -111,8 +133,8 @@ def _ubodt_lookup_sharded(u: DeviceUBODT, src: jnp.ndarray, dst: jnp.ndarray):
     r2 = local_rows(b2)
     # per-bucket select + min/max merge, like the unsharded path: avoids
     # materialising the concatenated [..., 2*BUCKET*ROW_W] layout
-    d1, t1, f1 = _select(r1.reshape(r1.shape[:-1] + (BUCKET, ROW_W)), src, dst)
-    d2, t2, f2 = _select(r2.reshape(r2.shape[:-1] + (BUCKET, ROW_W)), src, dst)
+    d1, t1, f1 = _select(r1, src, dst)
+    d2, t2, f2 = _select(r2, src, dst)
     dist = jax.lax.pmin(jnp.minimum(d1, d2), u.shard_axis)
     time = jax.lax.pmin(jnp.minimum(t1, t2), u.shard_axis)
     first = jax.lax.pmax(jnp.maximum(f1, f2), u.shard_axis)

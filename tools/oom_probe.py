@@ -1,0 +1,87 @@
+"""AOT compile-check the fleet kernel shapes on the real chip.
+
+Round-5 post-mortem tool: the (BUCKET, ROW_W) UBODT select reshape
+tile-padded 16-128x and the [512, 64] fleet shape OOM'd HBM at COMPILE
+time (32.91G of 15.75G, tpu_bench_out.json.err 2026-07-31).  This probe
+lowers the compact kernel for each fleet shape with ShapeDtypeStruct
+inputs sized like the real bench scenario and prints the compiler's own
+memory analysis -- no fleet data, no full warmup, a few chip-minutes.
+
+Usage: JAX_PLATFORMS=axon python tools/oom_probe.py
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def main() -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "axon")
+    import jax
+    import numpy as np
+
+    from reporter_tpu.utils.relay import acquire_axon_lock
+
+    lock = acquire_axon_lock(timeout=120)
+    if lock is None:
+        print(json.dumps({"error": "axon_lock_timeout"}))
+        return 5
+    dev = jax.devices()[0]
+    print("device:", dev.platform, dev.device_kind, file=sys.stderr)
+
+    from reporter_tpu.matching import MatcherConfig, SegmentMatcher
+    from reporter_tpu.tiles.arrays import build_graph_arrays
+    from reporter_tpu.tiles.network import grid_city
+    from reporter_tpu.tiles.ubodt import DeviceUBODT, build_ubodt
+
+    # small host-side scenario purely for pytree structure + params
+    net = grid_city(rows=12, cols=12, spacing_m=120.0)
+    arrays = build_graph_arrays(net)
+    ubodt = build_ubodt(arrays, delta=1500.0)
+    cfg = MatcherConfig()
+    matcher = SegmentMatcher(arrays=arrays, ubodt=ubodt, config=cfg)
+
+    # blow the UBODT table leaf up to the bench's real bucket count so the
+    # resident-argument share of HBM is realistic (~537 MB table)
+    real_buckets = int(os.environ.get("OOM_PROBE_UBODT_BUCKETS", str(1 << 20)))
+    du_struct = DeviceUBODT(
+        jax.ShapeDtypeStruct((real_buckets, matcher._du.packed.shape[1]),
+                             matcher._du.packed.dtype),
+        real_buckets - 1)
+    dg_struct = jax.tree_util.tree_map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), matcher._dg)
+    p_struct = jax.tree_util.tree_map(
+        lambda x: jax.ShapeDtypeStruct(np.shape(x), np.asarray(x).dtype),
+        matcher._params)
+
+    shapes = [(512, 64), (128, 256), (16, 1024), (1024, 64)]
+    out = {}
+    for B, T in shapes:
+        xin = jax.ShapeDtypeStruct((4, B, T), np.float32)
+        try:
+            lowered = matcher._jit_match_scan.lower(
+                dg_struct, du_struct, xin, p_struct, cfg.beam_k)
+            compiled = lowered.compile()
+            ma = compiled.memory_analysis()
+            rec = {
+                "ok": True,
+                "temp_gb": round(ma.temp_size_in_bytes / 2**30, 3),
+                "arg_gb": round(ma.argument_size_in_bytes / 2**30, 3),
+                "out_gb": round(ma.output_size_in_bytes / 2**30, 3),
+            }
+        except Exception as e:  # noqa: BLE001 - report any compile failure
+            msg = str(e)
+            rec = {"ok": False, "error": msg[:400]}
+        out["%dx%d" % (B, T)] = rec
+        print("shape %dx%d -> %s" % (B, T, rec), file=sys.stderr)
+    print(json.dumps(out))
+    # usable as a gate: nonzero when any shape failed to compile
+    return 0 if all(r.get("ok") for r in out.values()) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
