@@ -274,22 +274,33 @@ def run_device() -> int:
     warmup_s = time.time() - t0
     _stderr("warmup/compile %.1fs" % warmup_s)
 
-    # end-to-end throughput, steady-state pipelined: fleet rep N+1 is
-    # dispatched before rep N's association finishes, exactly how the
-    # service's MicroBatcher overlaps batches in production (max_inflight
-    # 2).  Round 4 measured the reps serially, so the device idled through
-    # every rep's association + fetch quanta -- device_util 0.45 with a
-    # kernel twice as fast as e2e (VERDICT r04 next #2b).
+    # end-to-end throughput, steady-state pipelined: fleet rep N+1 (and up
+    # to BENCH_INFLIGHT-1 more) dispatched before rep N's association
+    # finishes -- the service MicroBatcher's operating mode (its
+    # max_inflight shares the measured default of 4).  Round 4 measured
+    # the reps serially, so the device idled through every rep's
+    # association + fetch quanta -- device_util 0.45 with a kernel twice
+    # as fast as e2e (VERDICT r04 next #2b).
     _write_status(phase="benching", step="e2e", platform=platform)
-    reps = int(os.environ.get("BENCH_REPS", "5"))
+    # 10 reps: at 5 the ~70 ms tunnel sync quanta on the pipeline's fill
+    # and drain edges are a measurable bias on a ~1 s window (measured
+    # 2026-07-31: inflight 4 read 2639 tr/s at 5 reps, 3116 at 10)
+    reps = int(os.environ.get("BENCH_REPS", "10"))
+    # in-flight fleet reps: N+1 (and N+2, ...) dispatched before rep N's
+    # association finishes.  2 = the service MicroBatcher's minimum
+    # operating mode; 4 (measured best on v5e, 2026-07-31: 3116 vs 2321
+    # tr/s e2e, device_util 1.0 vs 0.87) hides every sync quantum and the
+    # whole of host association under device compute, pinning one extra
+    # fleet's packed arrays per slot.
+    inflight = max(1, int(os.environ.get("BENCH_INFLIGHT", "4")))
     from collections import deque as _deque
 
     finishes: "_deque" = _deque()
     t0 = time.time()
     for _ in range(reps):
         finishes.append(matcher.match_many_async(traces))
-        if len(finishes) > 1:
-            finishes.popleft()()  # associate rep N-1 under rep N's compute
+        if len(finishes) >= inflight:
+            finishes.popleft()()  # associate rep N-k under rep N's compute
     while finishes:
         finishes.popleft()()
     e2e_wall = time.time() - t0
@@ -584,7 +595,7 @@ def run_device() -> int:
         "p95_latency_ms": round(p95_ms, 2),
         "dispatch_floor_ms": round(floor_ms, 2),
         "latency_cohort": "short64",
-        "e2e_mode": "pipelined_overlap2",
+        "e2e_mode": "pipelined_overlap%d" % inflight,
         "forward_by_cohort": forward_by_cohort,
         "kernel_traces_per_sec": round(kernel_tps, 1),
         "kernel_points_per_sec": round(kernel_pps, 1),

@@ -34,7 +34,7 @@ log = logging.getLogger(__name__)
 # (16 + 12) * max_device_points bytes <= ~3.7 MB at the default budget, so 8
 # bounds pinned transport memory at ~30 MB per match_many call — and the
 # MicroBatcher's composite worst case is (max_inflight + 2) * depth chunks
-# (~118 MB at its defaults; see serve/service.py), which must fit HBM
+# (~178 MB at its defaults; see serve/service.py), which must fit HBM
 # headroom next to the graph + UBODT.  Depth matters doubly on deployments
 # with a fixed per-sync cost: a fleet whose chunk count fits the depth
 # dispatches entirely before the first blocking fetch, so the whole batch

@@ -66,6 +66,7 @@ def main(argv):
         matcher,
         max_batch=int(batch.get("max_batch", 64)),
         max_wait_ms=float(batch.get("max_wait_ms", 10.0)),
+        max_inflight=int(batch.get("max_inflight", 4)),
     )
     httpd = service.make_server(host, int(port))
     logging.info("reporter_tpu service on %s:%s (backend=%s)", host, port, matcher.backend)

@@ -66,12 +66,16 @@ class MicroBatcher:
     in the worst case (one dispatching, max_inflight queued, one finishing)
     -- so size max_device_points for (max_inflight + 2) * PIPELINE_DEPTH
     chunks, not PIPELINE_DEPTH alone.  At the defaults (depth 8,
-    max_inflight 2, ~3.7 MB of packed transport per chunk) that composite
-    is ~118 MB of HBM next to the graph + UBODT.
+    max_inflight 4, ~3.7 MB of packed transport per chunk) that composite
+    is ~178 MB of HBM next to the graph + UBODT.  Depth 4 is the measured
+    v5e optimum: it hides every dispatch sync quantum and the whole of
+    host association under device compute (e2e 3116 vs 2321 tr/s at
+    depth 2, device_util 1.0 vs 0.87 --
+    docs/measurements/bench_tpu_2026-07-31_inflight4.json).
     """
 
     def __init__(self, matcher: SegmentMatcher, max_batch: int = 64, max_wait_ms: float = 10.0,
-                 max_inflight: int = 2):
+                 max_inflight: int = 4):
         self.matcher = matcher
         self.max_batch = max_batch
         self.max_wait = max_wait_ms / 1000.0
@@ -148,12 +152,14 @@ class ReporterService:
         threshold_sec: Optional[int] = None,
         max_batch: int = 64,
         max_wait_ms: float = 10.0,
+        max_inflight: int = 4,
     ):
         if threshold_sec is None:
             threshold_sec = int(os.environ.get("THRESHOLD_SEC", matcher.cfg.threshold_sec))
         self.threshold_sec = threshold_sec
         self.matcher = matcher
-        self.batcher = MicroBatcher(matcher, max_batch=max_batch, max_wait_ms=max_wait_ms)
+        self.batcher = MicroBatcher(matcher, max_batch=max_batch, max_wait_ms=max_wait_ms,
+                                    max_inflight=max_inflight)
         import time as _time
 
         self._t_boot = _time.time()

@@ -1,0 +1,113 @@
+"""On-chip microbenchmark of UBODT-style row gathers.
+
+The round-5 on-chip attribution pins 123 of 199 ms device time on the two
+bucket-row gathers (`ops/hashtable.py:99-100`), with an application-level
+rate of ~24 GB/s of useful rows.  This probe measures raw `table[idx]`
+row-gather rates on the real chip across layouts to answer ONE question:
+is the gather row-count-bound (each 512 B row fetch pays a full (8,128)
+tile / fixed DMA cost, so halving row bytes buys nothing) or
+byte-bound (smaller rows => proportionally faster)?
+
+Variants, all reading the same total ~2 GB of useful rows:
+  r128        [2^20, 128] i32 table, 4M random rows   (the real layout)
+  r128_sorted same, indices sorted                     (locality effect)
+  r128_x2     two 2M gathers (the real two-probe shape)
+  r64         [2^21, 64] i32 table, 8M random rows    (half-size rows)
+  r256        [2^19, 256] i32 table, 2M random rows   (double-size rows)
+
+Usage: JAX_PLATFORMS=axon python tools/gather_probe.py
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def main() -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "axon")
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from reporter_tpu.utils.relay import acquire_axon_lock
+
+    lock = acquire_axon_lock(timeout=120)
+    if lock is None:
+        print(json.dumps({"error": "axon_lock_timeout"}))
+        return 5
+    dev = jax.devices()[0]
+    print("device:", dev.platform, dev.device_kind, file=sys.stderr)
+
+    rng = np.random.default_rng(0)
+    out = {}
+
+    def bench(name, n_buckets, row_w, n_idx, n_gathers=1, sort=False):
+        shape = (n_idx,) if isinstance(n_idx, int) else tuple(n_idx)
+        size = int(np.prod(shape))
+        table = jnp.asarray(
+            rng.integers(0, 1 << 30, (n_buckets, row_w), dtype=np.int32))
+        idx_np = rng.integers(0, n_buckets, (n_gathers,) + shape,
+                              dtype=np.int32)
+        if sort:
+            idx_np = np.sort(idx_np.reshape(n_gathers, -1), axis=1).reshape(
+                idx_np.shape)
+        idx = jnp.asarray(idx_np)
+
+        # per-query keys: the consumer must depend on BOTH the row content
+        # and the query, or XLA rewrites sum(f(t[ix])) into a per-row
+        # precompute + scalar gather and the row reads vanish (observed:
+        # "33 TB/s" on the first version of this probe).  The repeat loop
+        # lives INSIDE the jit with per-iteration index perturbation:
+        # host-side repeats of an identical call return in ~0.1 ms over the
+        # tunnel (result memoisation), which no wall clock can see through.
+        q = jnp.asarray(rng.integers(0, 1 << 30, (n_gathers,) + shape,
+                                     dtype=np.int32))
+        LOOPS = 8
+
+        @jax.jit
+        def run(t, ix, qq):
+            def body(i, acc):
+                a = acc
+                # decorrelate iterations with a multiplicative hash: a +i
+                # walk gives consecutive iterations DRAM-page locality and
+                # inflates the measured rate ~8x (observed: "946 GB/s")
+                salt = (i * jnp.int32(-1640531527)) >> 7
+                for g in range(ix.shape[0]):
+                    rows = t[(ix[g] ^ salt) & (t.shape[0] - 1)]
+                    m = jnp.where(rows == qq[g][..., None], rows, 0)
+                    a = a + jnp.sum(m, dtype=jnp.int32)
+                return a
+            return jax.lax.fori_loop(0, LOOPS, body, jnp.int32(0))
+
+        np.asarray(run(table, idx, q))  # compile + warm (fetch = the sync)
+        t0 = time.time()
+        np.asarray(run(table, jnp.asarray(idx_np ^ 1), q))
+        dt = (time.time() - t0) / LOOPS
+        useful_gb = n_gathers * size * row_w * 4 / 1e9
+        rec = {
+            "rows_per_s_m": round(n_gathers * size / dt / 1e6, 1),
+            "useful_gb_per_s": round(useful_gb / dt, 1),
+            "ms": round(dt * 1000, 1),
+        }
+        out[name] = rec
+        print("%-12s -> %s" % (name, rec), file=sys.stderr)
+        del table, idx
+
+    N = 1 << 22  # 4M rows of 512 B = 2.1 GB useful per measurement
+    bench("r128", 1 << 20, 128, N)
+    bench("r128_sorted", 1 << 20, 128, N, sort=True)
+    bench("r128_x2", 1 << 20, 128, N // 2, n_gathers=2)
+    bench("r128_4d", 1 << 20, 128, (512, 63, 8, 8))  # the kernel's shape
+    bench("r64", 1 << 21, 64, N * 2)
+    bench("r256", 1 << 19, 256, N // 2)
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

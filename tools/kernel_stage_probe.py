@@ -1,0 +1,196 @@
+"""On-chip stage isolation for the compact kernel's UBODT probe cost.
+
+Times `match_batch_compact_packed` at the short-cohort fleet shape
+[512, 64] in three configs:
+  full    -- as shipped
+  noprobe -- ubodt_lookup stubbed to constants (gathers + select removed)
+  noselect-- _select replaced by a plain lane-reduce (gathers kept)
+
+The table is a random REAL-SIZED [2^20, 128] int32 cuckoo image so the
+gather physics (row count, table footprint) match the bench; results are
+all-miss garbage, which costs the same as hits.  Each timed call
+perturbs the input slightly -- the tunnel relay memoises identical
+executions, so repeating the same args measures nothing.
+
+Usage: JAX_PLATFORMS=axon python tools/kernel_stage_probe.py
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def main() -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "axon")
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from reporter_tpu.utils.relay import acquire_axon_lock
+
+    lock = acquire_axon_lock(timeout=120)
+    if lock is None:
+        print(json.dumps({"error": "axon_lock_timeout"}))
+        return 5
+    print("device:", jax.devices()[0].device_kind, file=sys.stderr)
+
+    from reporter_tpu import ops
+    from reporter_tpu.matching import MatcherConfig, SegmentMatcher
+    from reporter_tpu.ops import hashtable as ht
+    from reporter_tpu.ops import viterbi as vt
+    from reporter_tpu.tiles.arrays import build_graph_arrays
+    from reporter_tpu.tiles.network import grid_city
+    from reporter_tpu.tiles.ubodt import DeviceUBODT, build_ubodt
+
+    net = grid_city(rows=16, cols=16, spacing_m=150.0)
+    arrays = build_graph_arrays(net)
+    ubodt = build_ubodt(arrays, delta=2000.0)
+    cfg = MatcherConfig()
+    matcher = SegmentMatcher(arrays=arrays, ubodt=ubodt, config=cfg)
+    dg = matcher._dg
+    params = matcher._params
+
+    rng = np.random.default_rng(0)
+    n_buckets = 1 << 20
+    du = DeviceUBODT(
+        jnp.asarray(rng.integers(0, 1 << 30, (n_buckets, 128),
+                                 dtype=np.int32)),
+        n_buckets - 1)
+
+    B, T = 512, 64
+    # plausible in-bbox tracks so the candidate stage does real work
+    x0 = float(np.mean(arrays.node_x)); y0 = float(np.mean(arrays.node_y))
+    px = x0 + rng.normal(0, 400, (B, T)).cumsum(axis=1) * 0.1
+    py = y0 + rng.normal(0, 400, (B, T)).cumsum(axis=1) * 0.1
+    tm = np.arange(T, dtype=np.float32)[None, :].repeat(B, 0) * 5.0
+    valid = np.ones((B, T), np.float32)
+    xin0 = np.asarray(vt.pack_inputs(px, py, tm, valid))
+
+    LOOPS = 8
+
+    def timeit(fn, label):
+        # Through the tunnel, block_until_ready is a no-op -- the sync
+        # happens on the device-to-host fetch.  So: repeat the kernel
+        # in-jit with a per-iteration input perturbation (the relay
+        # memoises identical executions) and time one scalar fetch; the
+        # ~70 ms transport floor is shared by every config and the 8x
+        # kernel repetition dominates the differences.
+        def looped(dgx, dux, xin, p, k):
+            def body(i, acc):
+                r = fn(dgx, dux, xin + i.astype(jnp.float32) * 1e-3, p, k)
+                return acc + jnp.sum(r)
+            return jax.lax.fori_loop(0, LOOPS, body, jnp.int32(0))
+
+        f = jax.jit(looped, static_argnums=(4,))
+        xin = jnp.asarray(xin0)
+        np.asarray(f(dg, du, xin, params, cfg.beam_k))  # compile + warm
+        ts = []
+        for i in range(1, 4):
+            xv = jnp.asarray(xin0 + np.float32(i) * 1e-2)
+            t0 = time.time()
+            np.asarray(f(dg, du, xv, params, cfg.beam_k))
+            ts.append(time.time() - t0)
+        ms = round(min(ts) * 1000 / LOOPS, 1)
+        print("%-9s min %.1f ms/iter  (calls %s ms)" %
+              (label, ms, [round(t * 1000) for t in ts]), file=sys.stderr)
+        return ms
+
+    out = {}
+    out["full"] = timeit(vt.match_batch_compact_packed, "full")
+
+    real_lookup = ht.ubodt_lookup
+    real_select = ht._select
+
+    def stub_lookup(u, src, dst):
+        s, d = jnp.broadcast_arrays(src, dst)
+        z = (s + d).astype(jnp.float32)
+        return z * 0 + 750.0, z * 0 + 30.0, jnp.zeros_like(s)
+
+    try:
+        vt.ubodt_lookup = stub_lookup
+        out["noprobe"] = timeit(vt.match_batch_compact_packed, "noprobe")
+    finally:
+        vt.ubodt_lookup = real_lookup
+
+    def cheap_select(rows, src, dst):
+        vf = jax.lax.bitcast_convert_type(rows, jnp.float32)
+        dist = jnp.min(jnp.abs(vf), axis=-1)
+        return dist, dist * 0.1, jnp.max(rows, axis=-1)
+
+    try:
+        ht._select = cheap_select
+        out["noselect"] = timeit(vt.match_batch_compact_packed, "noselect")
+    finally:
+        ht._select = real_select
+
+    from reporter_tpu.tiles.ubodt import (
+        F_DIST, F_DST, F_FE, F_SRC, F_TIME, ROW_W)
+
+    def roll_select(rows, src, dst):
+        # per-entry src AND dst via a static +1 lane roll instead of the
+        # [LANES, LANES] spread matmul; field values picked by rolling the
+        # hit flag onto each field lane
+        lanes = rows.shape[-1]
+        fld = jax.lax.iota(jnp.int32, lanes) % ROW_W
+        m_src = (rows == src[..., None]) & (fld == F_SRC)
+        m_dst = (rows == dst[..., None]) & (fld == F_DST)
+        hit = jnp.roll(m_src, F_DST - F_SRC, axis=-1) & m_dst
+        vf = jax.lax.bitcast_convert_type(rows, jnp.float32)
+        dist = jnp.min(jnp.where(
+            jnp.roll(hit, F_DIST - F_DST, axis=-1), vf, jnp.inf), axis=-1)
+        time_ = jnp.min(jnp.where(
+            jnp.roll(hit, F_TIME - F_DST, axis=-1), vf, jnp.inf), axis=-1)
+        first = jnp.max(jnp.where(
+            jnp.roll(hit, F_FE - F_DST, axis=-1), rows, -1), axis=-1)
+        return dist, time_, first
+
+    try:
+        ht._select = roll_select
+        out["rollsel"] = timeit(vt.match_batch_compact_packed, "rollsel")
+    finally:
+        ht._select = real_select
+
+    # end-state mock of the wide single-hash layout: BUCKET=32, one 1 KB
+    # row per (src, dst) pair, select over 256 lanes with a local spread
+    # matrix.  Table values are garbage (all-miss == same cost as hits).
+    du_wide = DeviceUBODT(
+        jnp.asarray(rng.integers(0, 1 << 30, (n_buckets, 256),
+                                 dtype=np.int32)),
+        n_buckets - 1)
+    lanes = 256
+    li = np.arange(lanes)
+    same_entry = (li[:, None] // 8) == (li[None, :] // 8)
+    is_key = (li[:, None] % 8 == 0) | (li[:, None] % 8 == 1)
+    spread = jnp.asarray((same_entry & is_key).astype(np.float32))
+
+    def wide_lookup(u, src, dst):
+        src, dst = jnp.broadcast_arrays(src, dst)
+        b1 = ht.device_pair_hash(src, dst, du_wide.bmask)
+        rows = du_wide.packed[b1]  # [..., 256]: ONE 1 KB DMA per pair
+        fld = jax.lax.iota(jnp.int32, lanes) % 8
+        m = ((rows == src[..., None]) & (fld == 0)) | (
+            (rows == dst[..., None]) & (fld == 1))
+        both = jnp.dot(m.astype(jnp.float32), spread) == 2.0
+        vf = jax.lax.bitcast_convert_type(rows, jnp.float32)
+        dist = jnp.min(jnp.where(both & (fld == 2), vf, jnp.inf), axis=-1)
+        time_ = jnp.min(jnp.where(both & (fld == 3), vf, jnp.inf), axis=-1)
+        first = jnp.max(jnp.where(both & (fld == 4), rows, -1), axis=-1)
+        return dist, time_, first
+
+    try:
+        vt.ubodt_lookup = wide_lookup
+        out["wide32"] = timeit(vt.match_batch_compact_packed, "wide32")
+    finally:
+        vt.ubodt_lookup = real_lookup
+
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
