@@ -225,6 +225,19 @@ def test_thread_pool_env_bounds_concurrency(monkeypatch, service_matcher):
         srv.server_close()
 
 
+def test_max_inflight_plumbs_to_batcher(service_matcher):
+    """batch.max_inflight (config) must bound the MicroBatcher's dispatch
+    -> finisher hand-off queue: that depth is what overlaps host
+    association with device compute (measured v5e optimum 4 —
+    docs/measurements/bench_tpu_2026-07-31_inflight4.json)."""
+    from reporter_tpu.serve.service import ReporterService
+
+    svc = ReporterService(service_matcher, max_inflight=3)
+    assert svc.batcher._finish_q.maxsize == 3
+    svc_default = ReporterService(service_matcher)
+    assert svc_default.batcher._finish_q.maxsize == 4
+
+
 def test_concurrent_requests_micro_batch(service_url):
     """32 parallel /report calls must all succeed and be aggregated into
     fewer device batches than requests (the MicroBatcher's whole point:
