@@ -700,6 +700,15 @@ def _finish(proc, timeout):
 # frozen, kill it rather than burn the remaining run budget (a mid-run relay
 # drop has been observed; device calls then block indefinitely)
 RELAY_DEAD_KILL_S = 360.0
+# ...and the relay ports can be OPEN while the tunnel's compile helper is
+# wedged (observed 2026-07-31: a worker froze at the first 'benching'
+# status with both ports listening for 10+ minutes).  A frozen status
+# file therefore eventually kills the worker even with the relay up; the
+# threshold sits well above the longest legitimate silent stretch (a
+# cold-cache compile wave, ~8 min observed on the tunnel) so real
+# progress is never cut, while a wedge costs 20 min instead of the full
+# 40 min run budget.
+STATUS_FROZEN_KILL_S = 1200.0
 
 
 def _finish_device(proc, timeout, status_file):
@@ -738,6 +747,7 @@ def _finish_device(proc, timeout, status_file):
     t0 = time.time()
     last_st = None
     dead_since = None
+    frozen_since = None
     while True:
         if proc.poll() is not None:
             return _result(kill=False)
@@ -746,7 +756,19 @@ def _finish_device(proc, timeout, status_file):
             return _result(kill=True)
         st = _read_status(status_file)
         on_accel = (st or {}).get("platform") not in (None, "cpu")
-        if not on_accel or _relay_ports_open() or st != last_st:
+        progressed = not on_accel or st != last_st
+        # ports-open wedge: status frozen long past any legitimate compile
+        # wave kills the worker regardless of relay state
+        if progressed:
+            frozen_since = None
+        elif frozen_since is None:
+            frozen_since = time.time()
+        elif time.time() - frozen_since > STATUS_FROZEN_KILL_S:
+            _stderr("worker status frozen %.0fs (relay ports %s); killing "
+                    "device worker" % (time.time() - frozen_since,
+                                       _relay_ports_open() or "closed"))
+            return _result(kill=True)
+        if progressed or _relay_ports_open():
             dead_since = None
             last_st = st
         elif dead_since is None:

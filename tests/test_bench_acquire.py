@@ -166,3 +166,46 @@ def test_axon_yielding_cpu_is_kept_as_bank(rig):
     assert rc == 0
     assert out["platform"] == "cpu"
     assert rig["cpu_runs"] == 0  # the axon-cpu result IS the bank
+
+
+def test_finish_device_kills_ports_open_wedge(monkeypatch, tmp_path):
+    """A worker whose status file freezes mid-bench with relay ports OPEN
+    (the 2026-07-31 tunnel compile-helper wedge: 'benching' status, both
+    ports listening, zero progress for 10+ min) must be killed after
+    STATUS_FROZEN_KILL_S instead of running out the full run budget."""
+    sf = tmp_path / "status.json"
+    sf.write_text(json.dumps({"phase": "benching", "platform": "tpu", "t": 1.0}))
+
+    killed = []
+
+    class WedgedProc:
+        class _Out:
+            @staticmethod
+            def read():
+                return b""
+
+        stdout = _Out()
+        returncode = None
+
+        def poll(self):
+            return 1 if killed else None
+
+        def kill(self):
+            killed.append(True)
+            self.returncode = 1
+
+        def wait(self):
+            return self.returncode
+
+    now = [0.0]
+    monkeypatch.setattr(bench.time, "time", lambda: now[0])
+    monkeypatch.setattr(bench.time, "sleep",
+                        lambda s: now.__setitem__(0, now[0] + s))
+    monkeypatch.setattr(bench, "_relay_ports_open", lambda: [8083, 8082])
+
+    run_budget = 2400.0
+    rc, dj = bench._finish_device(WedgedProc(), run_budget, str(sf))
+    assert killed, "wedged worker was not killed"
+    assert dj is None
+    # killed by the frozen-status watchdog, well before the run budget
+    assert bench.STATUS_FROZEN_KILL_S <= now[0] < run_budget - 60
