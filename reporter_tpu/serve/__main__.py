@@ -12,7 +12,7 @@ import os
 import sys
 
 from ..utils.jaxenv import ensure_platform
-from .service import ReporterService, load_service_config
+from .service import ReporterService, build_matcher, parse_service_config
 
 
 def main(argv):
@@ -47,7 +47,9 @@ def main(argv):
             "       (or set MATCHER_CONF_FILE)\n")
         return 1
     try:
-        matcher, conf = load_service_config(conf_path)
+        # cheap half only (no jax, no network IO): a broken config still
+        # fails fast, before the socket binds
+        cfg, conf = parse_service_config(conf_path)
     except Exception as e:
         sys.stderr.write("Problem with config file: %s\n" % (e,))
         return 1
@@ -61,15 +63,21 @@ def main(argv):
         host = os.environ.get("MATCHER_BIND_ADDR", "0.0.0.0")
         port = os.environ.get("MATCHER_LISTEN_PORT", "8002")
 
+    # deferred boot: bind the socket with NO matcher, then build the
+    # engine (network + UBODT + backend init) on the warmup thread.  A
+    # wedged accelerator init used to leave the service completely dark --
+    # no bind, no /health (observed on the tunnel backend, 2026-07-31);
+    # now /health answers "warming" from the first second and /report
+    # returns retryable 503s until the engine attaches.
     batch = conf.get("batch", {})
     service = ReporterService(
-        matcher,
+        None,
         max_batch=int(batch.get("max_batch", 64)),
         max_wait_ms=float(batch.get("max_wait_ms", 10.0)),
         max_inflight=int(batch.get("max_inflight", 4)),
     )
     httpd = service.make_server(host, int(port))
-    logging.info("reporter_tpu service on %s:%s (backend=%s)", host, port, matcher.backend)
+    logging.info("reporter_tpu service on %s:%s (engine deferred)", host, port)
 
     # containers stop with SIGTERM: stop accepting, let in-flight handlers
     # finish (non-daemon handler threads + block_on_close make server_close
@@ -88,50 +96,70 @@ def main(argv):
     term_to_keyboard_interrupt()
 
     try:
-        # pre-compile the hot shapes BEHIND the bound socket, on a
-        # background thread: the service accepts (and /health answers, with
-        # "warming": true) from the first second, while cold-start compiles
-        # proceed -- a cold boot must not leave clients dark for the
-        # compile set (the reference client's socket budget is 10 s,
-        # HttpClient.java:80-88).  Requests racing the warmup just compile
-        # their shape inline, exactly as with warmup disabled; the jit
-        # cache dedups.  "warmup": false disables.
-        warm_thread = None
-        stop_warm = None
-        if conf.get("warmup", True):
-            import threading
+        # build the engine, then pre-compile the hot shapes, all BEHIND
+        # the bound socket on a background thread: the service accepts
+        # (and /health answers, with "warming": true) from the first
+        # second, while backend init + cold-start compiles proceed -- a
+        # cold boot must not leave clients dark (the reference client's
+        # socket budget is 10 s, HttpClient.java:80-88).  Requests racing
+        # the warmup just compile their shape inline, exactly as with
+        # warmup disabled; the jit cache dedups.  "warmup": false skips
+        # only the shape pre-compiles.
+        import threading
 
-            service.warming = True
-            stop_warm = threading.Event()
+        service.warming = True
+        stop_warm = threading.Event()
 
-            def _warm():
+        def _warm():
+            try:
                 try:
+                    matcher = build_matcher(cfg, conf)
+                    service.attach_matcher(matcher)
+                except Exception:
+                    # a failed engine build must not leave a zombie
+                    # listener returning 503s forever: log and stop the
+                    # serve loop (main exits nonzero on batcher is None)
+                    logging.exception("engine build failed; shutting down")
+                    threading.Thread(target=httpd.shutdown,
+                                     daemon=True).start()
+                    return
+                logging.info("engine live (backend=%s, %d edges)",
+                             matcher.backend, matcher.arrays.num_edges)
+                if conf.get("warmup", True):
                     # shape-by-shape so a shutdown can stop between
                     # compiles (an in-flight XLA compile itself is not
-                    # interruptible)
-                    for n in matcher.cfg.length_buckets:
-                        if stop_warm.is_set():
-                            break
-                        matcher.warmup(lengths=[n])
-                finally:
-                    service.warming = False
+                    # interruptible).  A warmup failure past this point is
+                    # non-fatal: the engine serves, shapes compile inline.
+                    try:
+                        for n in matcher.cfg.length_buckets:
+                            if stop_warm.is_set():
+                                break
+                            matcher.warmup(lengths=[n])
+                    except Exception:
+                        logging.exception(
+                            "shape warmup failed; serving without pre-compiles")
+            finally:
+                service.warming = False
 
-            warm_thread = threading.Thread(
-                target=_warm, daemon=True, name="warmup")
-            warm_thread.start()
+        warm_thread = threading.Thread(
+            target=_warm, daemon=True, name="warmup")
+        warm_thread.start()
         httpd.serve_forever()
+        if service.batcher is None:
+            # serve loop ended with no engine: the build failed
+            httpd.server_close()
+            return 1
     except KeyboardInterrupt:
         logging.info("shutting down (signal)")
         # flip the drain flag first: handlers close their connection after
         # the in-flight request, bounding server_close's handler join even
         # for clients actively streaming keep-alive requests
         service.draining = True
-        if stop_warm is not None:
-            # let the in-flight warmup compile finish before tearing down
-            # the runtime under it (bounded: anything longer than one
-            # compile is the container's SIGKILL to take)
-            stop_warm.set()
-            warm_thread.join(timeout=120.0)
+        # let the in-flight engine build / warmup compile finish before
+        # tearing down the runtime under it (bounded: anything longer is
+        # the container's SIGKILL to take)
+        stop_warm.set()
+        warm_thread.join(timeout=120.0)
         httpd.server_close()
     return 0
 

@@ -148,18 +148,27 @@ class ReporterService:
 
     def __init__(
         self,
-        matcher: SegmentMatcher,
+        matcher: Optional[SegmentMatcher],
         threshold_sec: Optional[int] = None,
         max_batch: int = 64,
         max_wait_ms: float = 10.0,
         max_inflight: int = 4,
     ):
-        if threshold_sec is None:
-            threshold_sec = int(os.environ.get("THRESHOLD_SEC", matcher.cfg.threshold_sec))
-        self.threshold_sec = threshold_sec
-        self.matcher = matcher
-        self.batcher = MicroBatcher(matcher, max_batch=max_batch, max_wait_ms=max_wait_ms,
-                                    max_inflight=max_inflight)
+        """``matcher=None`` defers the engine: the HTTP socket can bind and
+        /health can answer before the accelerator backend is even
+        initialised (a wedged PJRT init was observed to leave the old
+        bind-after-init boot dark indefinitely, 2026-07-31).  /report and
+        /trace_attributes_batch return 503 until ``attach_matcher`` runs,
+        which the reference's client treats as a retryable failure
+        (HttpClient.java:80-88: 3 retries on its 10 s budget)."""
+        self._batch_params = dict(max_batch=max_batch, max_wait_ms=max_wait_ms,
+                                  max_inflight=max_inflight)
+        self._threshold_arg = threshold_sec
+        self.matcher = None
+        self.batcher = None
+        self.threshold_sec = None
+        if matcher is not None:
+            self.attach_matcher(matcher)
         import time as _time
 
         self._t_boot = _time.time()
@@ -171,6 +180,18 @@ class ReporterService:
         # of non-daemon handler threads is bounded by one request even for
         # clients actively streaming keep-alive requests (ADVICE r04)
         self.draining = False
+
+    def attach_matcher(self, matcher: SegmentMatcher) -> None:
+        """Bring a deferred service live: resolve the report threshold and
+        start the MicroBatcher.  ``batcher`` is assigned last — handlers
+        read it once, so a request races either to 503 or to a fully
+        wired engine, never halfway."""
+        threshold = self._threshold_arg
+        if threshold is None:
+            threshold = int(os.environ.get("THRESHOLD_SEC", matcher.cfg.threshold_sec))
+        self.threshold_sec = int(threshold)
+        self.matcher = matcher
+        self.batcher = MicroBatcher(matcher, **self._batch_params)
 
     # -- request handling --------------------------------------------------
 
@@ -196,11 +217,14 @@ class ReporterService:
         return None, rl, tl
 
     def handle_report(self, trace: dict) -> Tuple[int, dict]:
+        batcher = self.batcher
+        if batcher is None:
+            return 503, {"error": "service initialising"}
         err, rl, tl = self.validate(trace)
         if err:
             return 400, {"error": err}
         try:
-            match = self.batcher.match(trace)
+            match = batcher.match(trace)
             data = report_fn(match, trace, self.threshold_sec, rl, tl,
                              mode=trace.get("match_options", {}).get("mode", "auto"))
             self._count(ok=True)
@@ -224,21 +248,26 @@ class ReporterService:
         m = self.matcher
         return 200, {
             "status": "ok",
-            # True while the boot-time background warmup is still compiling
-            # shapes: the service answers (first requests just compile
-            # inline), so warming is informational, not a failure state
-            "warming": bool(getattr(self, "warming", False)),
-            "backend": m.backend,
-            "devices": int(getattr(m.cfg, "devices", 1)),
-            "graph_devices": int(getattr(m.cfg, "graph_devices", 1)),
-            "edges": int(m.arrays.num_edges),
-            "ubodt_rows": int(m.ubodt.num_rows),
+            # True while boot-time work is still in flight: backend init +
+            # engine build (matcher fields below are null until attached)
+            # and the background shape warmup.  The service answers either
+            # way (requests racing the warmup just compile inline), so
+            # warming is informational, not a failure state
+            "warming": bool(getattr(self, "warming", False)) or m is None,
+            "backend": m.backend if m else None,
+            "devices": int(getattr(m.cfg, "devices", 1)) if m else None,
+            "graph_devices": int(getattr(m.cfg, "graph_devices", 1)) if m else None,
+            "edges": int(m.arrays.num_edges) if m else None,
+            "ubodt_rows": int(m.ubodt.num_rows) if m else None,
             "uptime_s": round(_time.time() - self._t_boot, 1),
             "requests": self._n_requests,
             "errors": self._n_errors,
         }
 
     def handle_batch(self, body: dict) -> Tuple[int, dict]:
+        batcher = self.batcher
+        if batcher is None:
+            return 503, {"error": "service initialising"}
         traces = body.get("traces")
         if not isinstance(traces, list) or not traces:
             return 400, {"error": "traces must be a non-empty array"}
@@ -249,7 +278,7 @@ class ReporterService:
                 return 400, {"error": "trace %d: %s" % (i, err)}
             validated.append((trace, rl, tl))
         try:
-            matches = self.batcher.match_many([t for t, _, _ in validated])
+            matches = batcher.match_many([t for t, _, _ in validated])
             results = [
                 report_fn(m, t, self.threshold_sec, rl, tl,
                           mode=t.get("match_options", {}).get("mode", "auto"))
@@ -403,18 +432,11 @@ class ReporterService:
         return Server((host, port), Handler)
 
 
-def load_service_config(path: str, backend: Optional[str] = None) -> Tuple[SegmentMatcher, dict]:
-    """Service config JSON:
-
-    {
-      "network": {"type": "grid", "rows": 8, "cols": 8, "spacing_m": 200}
-               | {"type": "file", "path": "network.json"}
-               | {"type": "tiles", "path": "tiles_dir"}        (native codec)
-      "matcher": { MatcherConfig fields / meili keys },
-      "backend": "jax" | "cpu",
-      "batch": {"max_batch": 64, "max_wait_ms": 10}
-    }
-    """
+def parse_service_config(path: str) -> Tuple["MatcherConfig", dict]:
+    """Parse + validate the cheap half of the config (no jax, no network
+    IO): malformed JSON, bad matcher keys, and an unknown network type all
+    fail HERE so a deferred boot still rejects a broken config before the
+    socket binds."""
     with open(path) as f:
         conf = json.load(f)
     mconf = conf.get("matcher", {})
@@ -422,6 +444,17 @@ def load_service_config(path: str, backend: Optional[str] = None) -> Tuple[Segme
         cfg = MatcherConfig.from_meili(mconf)
     else:
         cfg = MatcherConfig.from_dict(mconf)
+    kind = conf.get("network", {"type": "grid"}).get("type", "grid")
+    if kind not in ("grid", "file", "tiles"):
+        raise ValueError("unknown network type %r" % (kind,))
+    return cfg, conf
+
+
+def build_matcher(cfg: "MatcherConfig", conf: dict,
+                  backend: Optional[str] = None) -> SegmentMatcher:
+    """The expensive half: load/build the network, build the UBODT, and
+    initialise the device backend.  Safe to run on a background thread
+    behind an already-bound socket (__main__'s deferred boot)."""
     netspec = conf.get("network", {"type": "grid"})
     kind = netspec.get("type", "grid")
     if kind == "grid":
@@ -434,13 +467,30 @@ def load_service_config(path: str, backend: Optional[str] = None) -> Tuple[Segme
     elif kind == "file":
         with open(netspec["path"]) as f:
             net = RoadNetwork.from_dict(json.load(f))
-    elif kind == "tiles":
+    else:  # "tiles" -- parse_service_config rejected anything else
         from ..tiles.codec import load_network_tiles
 
         net = load_network_tiles(netspec["path"])
-    else:
-        raise ValueError("unknown network type %r" % (kind,))
-    matcher = SegmentMatcher(
+    return SegmentMatcher(
         network=net, config=cfg, backend=backend or conf.get("backend", "jax")
     )
-    return matcher, conf
+
+
+def load_service_config(path: str, backend: Optional[str] = None) -> Tuple[SegmentMatcher, dict]:
+    """Service config JSON:
+
+    {
+      "network": {"type": "grid", "rows": 8, "cols": 8, "spacing_m": 200}
+               | {"type": "file", "path": "network.json"}
+               | {"type": "tiles", "path": "tiles_dir"}        (native codec)
+      "matcher": { MatcherConfig fields / meili keys },
+      "backend": "jax" | "cpu",
+      "batch": {"max_batch": 64, "max_wait_ms": 10, "max_inflight": 4}
+    }
+
+    Eager parse + build in one call (library/tests convenience); the
+    service CLI uses parse_service_config + build_matcher so the socket
+    binds before the expensive half runs.
+    """
+    cfg, conf = parse_service_config(path)
+    return build_matcher(cfg, conf, backend), conf

@@ -71,11 +71,17 @@ trap 'kill $SERVE_PID 2>/dev/null || true' EXIT
 
 UP=0
 for _ in $(seq 1 120); do
+    # the socket binds before the engine builds (deferred boot): readiness
+    # is /health reporting an attached engine (backend non-null) — NOT
+    # warming false, which would also gate on the full shape-compile set
     python - <<EOF && UP=1 && break || sleep 1
-import socket, sys
-s = socket.socket()
-s.settimeout(1)
-sys.exit(0 if s.connect_ex(("127.0.0.1", $PORT)) == 0 else 1)
+import json, sys, urllib.request
+try:
+    h = json.load(urllib.request.urlopen(
+        "http://127.0.0.1:$PORT/health", timeout=2))
+except Exception:
+    sys.exit(1)
+sys.exit(0 if h.get("status") == "ok" and h.get("backend") else 1)
 EOF
 done
 if [ "$UP" != 1 ]; then

@@ -225,6 +225,47 @@ def test_thread_pool_env_bounds_concurrency(monkeypatch, service_matcher):
         srv.server_close()
 
 
+class TestDeferredBoot:
+    """The CLI binds the socket with NO engine and builds it behind the
+    socket (a wedged accelerator init must not leave the service dark --
+    no bind, no /health; observed on the tunnel backend 2026-07-31)."""
+
+    def test_deferred_service_health_then_attach(self, service_matcher):
+        from reporter_tpu.serve.service import ReporterService
+
+        svc = ReporterService(None)
+        code, h = svc.handle_health()
+        assert code == 200 and h["status"] == "ok"
+        assert h["warming"] is True and h["backend"] is None
+        code, out = svc.handle_report({"uuid": "v"})
+        assert code == 503 and "initialising" in out["error"]
+        code, out = svc.handle_batch({"traces": [{"uuid": "v"}]})
+        assert code == 503 and "initialising" in out["error"]
+
+        svc.attach_matcher(service_matcher)
+        code, h = svc.handle_health()
+        assert h["warming"] is False and h["backend"] == service_matcher.backend
+        assert h["edges"] == int(service_matcher.arrays.num_edges)
+        assert svc.threshold_sec == service_matcher.cfg.threshold_sec
+        # a real request now round-trips
+        trace = street_trace(service_matcher.arrays)
+        code, out = svc.handle_report(trace)
+        assert code == 200 and "segment_matcher" in out
+
+    def test_cli_engine_build_failure_exits_nonzero(self, tmp_path):
+        """A failed engine build (missing network file) must stop the
+        bound listener and exit 1, not serve 503s forever."""
+        import reporter_tpu.serve.__main__ as cli
+
+        conf = tmp_path / "conf.json"
+        conf.write_text(json.dumps({
+            "network": {"type": "file", "path": str(tmp_path / "missing.json")},
+            "warmup": False,
+        }))
+        rc = cli.main(["serve", str(conf), "127.0.0.1:0"])
+        assert rc == 1
+
+
 def test_max_inflight_plumbs_to_batcher(service_matcher):
     """batch.max_inflight (config) must bound the MicroBatcher's dispatch
     -> finisher hand-off queue: that depth is what overlaps host
