@@ -41,34 +41,66 @@ class CPUViterbiMatcher:
 
     def _candidates(self, x: float, y: float) -> List[Tuple[int, float, float]]:
         """[(edge, offset_m, dist_m)] within the search radius, one per edge,
-        nearest K first."""
+        nearest K first.
+
+        A literal mirror of the device sweep (ops/candidates.py
+        find_candidates), including its rounding and tie-breaks -- a ranking
+        that differs in the last ulp flips near-tie candidates (e.g. the
+        forward vs reverse edge of a two-way road) and breaks byte-exact
+        backend parity (tests/test_fuzz_differential.py):
+
+        - cell selection in float32 (the device's fx/fy/sx/sy arithmetic on
+          the f32 grid origin), with out-of-range neighbours clamped;
+        - the four cell rows visited in the device's (y-outer, x-inner)
+          stacking order, first occurrence kept per shape row;
+        - projection distances in float32 with jnp.hypot's exact expansion
+          (geo.point_segment_distance_f32);
+        - the pool truncation to the min(4K, 4*cap) nearest shape segments
+          BEFORE per-edge dedup (lax.top_k order: distance, then pool
+          position), which at dense geometry can drop or worsen an edge the
+          full scan would keep -- the oracle must drop it identically.
+        """
         a = self.arrays
-        cx, cy = a.cell_of(x, y)
-        fx = (x - a.grid_x0) / a.cell_size
-        fy = (y - a.grid_y0) / a.cell_size
+        f32 = np.float32
+        fx = (f32(x) - f32(a.grid_x0)) / f32(a.cell_size)
+        fy = (f32(y) - f32(a.grid_y0)) / f32(a.cell_size)
+        cx = int(np.clip(np.floor(fx), 0, a.grid_nx - 1))
+        cy = int(np.clip(np.floor(fy), 0, a.grid_ny - 1))
         sx = 1 if fx - np.floor(fx) >= 0.5 else -1
         sy = 1 if fy - np.floor(fy) >= 0.5 else -1
+        # duplicates from border-clamped cells are KEPT (the device gathers
+        # the clamped cell twice, and its copies occupy pool slots before
+        # the per-edge dedup); only the empty (-1) slots drop out, whose
+        # device distance is BIG and so sort behind every real entry anyway
         items: List[int] = []
-        for gy in (cy, cy + sy):
-            for gx in (cx, cx + sx):
-                if 0 <= gx < a.grid_nx and 0 <= gy < a.grid_ny:
-                    row = a.grid_items[gy * a.grid_nx + gx]
-                    items.extend(int(s) for s in row[row >= 0])
+        for gy in (cy, min(max(cy + sy, 0), a.grid_ny - 1)):
+            for gx in (cx, min(max(cx + sx, 0), a.grid_nx - 1)):
+                for s in a.grid_items[gy * a.grid_nx + gx]:
+                    if s >= 0:
+                        items.append(int(s))
         if not items:
             return []
-        items = sorted(set(items))
         si = np.array(items, np.int64)
-        d, t = geo.point_segment_distance_np(x, y, a.shp_ax[si], a.shp_ay[si], a.shp_bx[si], a.shp_by[si])
-        best = {}
-        for k in range(len(si)):
-            if d[k] <= self.cfg.search_radius:
-                e = int(a.shp_edge[si[k]])
-                off = float(a.shp_off[si[k]] + t[k] * a.shp_len[si[k]])
-                if e not in best or d[k] < best[e][1]:
-                    best[e] = (off, float(d[k]))
-        cands = [(e, off, dist) for e, (off, dist) in best.items()]
-        cands.sort(key=lambda c: c[2])
-        return cands[: self.cfg.beam_k]
+        d, t = geo.point_segment_distance_f32(x, y, a.shp_ax[si], a.shp_ay[si], a.shp_bx[si], a.shp_by[si])
+        d = np.where(d <= f32(self.cfg.search_radius), d, np.inf)
+        # pool narrowing + dedup in (distance, block-position) order; stable
+        # argsort == lax.top_k's lower-index-first tie rule
+        m = min(4 * self.cfg.beam_k, 4 * a.grid_items.shape[1])
+        pool = np.argsort(d, kind="stable")[:m]
+        cands: List[Tuple[int, float, float]] = []
+        seen_edges = set()
+        for k in pool:
+            if not np.isfinite(d[k]):
+                break  # pool is distance-sorted: the rest are misses
+            e = int(a.shp_edge[si[k]])
+            if e in seen_edges:
+                continue
+            seen_edges.add(e)
+            off = float(a.shp_off[si[k]] + t[k] * f32(a.shp_len[si[k]]))
+            cands.append((e, off, float(d[k])))
+            if len(cands) == self.cfg.beam_k:
+                break
+        return cands
 
     # -- transition ---------------------------------------------------------
 

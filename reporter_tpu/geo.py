@@ -124,3 +124,39 @@ def point_segment_distance_np(px, py, ax, ay, bx, by):
     cx = ax + t * dx
     cy = ay + t * dy
     return np.hypot(px - cx, py - cy), t
+
+
+def point_segment_distance_f32(px, py, ax, ay, bx, by):
+    """float32 twin of the device candidate sweep's projection
+    (ops/candidates.py find_candidates): same dtype and operation order,
+    so NEAR-TIES resolve the same way on both backends.  In float64 the
+    forward and reverse shape segments of a two-way road are exactly
+    equidistant from any point; in the device's float32 the two
+    projections round differently and one direction genuinely wins — an
+    oracle ranking candidates in float64 then flips fwd/rev on isolated
+    points (caught by tests/test_fuzz_differential.py)."""
+    f32 = np.float32
+    px, py, ax, ay, bx, by = (np.asarray(v, dtype=f32) for v in (px, py, ax, ay, bx, by))
+    dx = bx - ax
+    dy = by - ay
+    seg_len2 = dx * dx + dy * dy
+    pos = seg_len2 > 0
+    t = np.where(pos, ((px - ax) * dx + (py - ay) * dy) / np.where(pos, seg_len2, f32(1.0)), f32(0.0))
+    t = np.clip(t, f32(0.0), f32(1.0)).astype(f32)
+    cx = ax + t * dx
+    cy = ay + t * dy
+    return _hypot_f32_like_jax(px - cx, py - cy), t
+
+
+def _hypot_f32_like_jax(u, v):
+    """jnp.hypot's exact float32 expansion (m * sqrt(1 + (n/m)^2)), NOT
+    libm hypotf: the two round differently in the last ulps, which is
+    enough to flip near-tie candidate rankings against the device."""
+    f32 = np.float32
+    a = np.abs(u)
+    b = np.abs(v)
+    m = np.maximum(a, b)
+    n = np.minimum(a, b)
+    safe = np.where(m == 0, f32(1.0), m)
+    r = n / safe
+    return np.where(m == 0, m, m * np.sqrt(f32(1.0) + r * r))
