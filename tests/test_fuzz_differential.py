@@ -117,6 +117,38 @@ def _canon(result: dict) -> dict:
     return out
 
 
+def test_degenerate_inputs_backend_parity():
+    """Stationary vehicles, duplicate timestamps, and a point cloud jittering
+    around one position -- inputs real fleets produce at every red light --
+    must round-trip both backends identically."""
+    rng = np.random.default_rng(5)
+    net = random_network(rng)
+    arrays = build_graph_arrays(net)
+    ubodt = build_ubodt(arrays, delta=2000.0)
+    cfg = MatcherConfig()
+    dev = SegmentMatcher(arrays=arrays, ubodt=ubodt, config=cfg)
+    ora = SegmentMatcher(arrays=arrays, ubodt=ubodt, config=cfg, backend="cpu")
+
+    e = net.edges[0]
+    mid = np.asarray(e.shape, float).mean(axis=0)
+    MO = {"mode": "auto", "report_levels": [0, 1, 2],
+          "transition_levels": [0, 1, 2]}
+
+    def mk(pts, times):
+        return {"uuid": "degen", "match_options": MO, "trace": [
+            {"lat": float(a), "lon": float(o), "time": int(t), "accuracy": 5}
+            for (a, o), t in zip(pts, times)]}
+
+    stationary = mk([(mid[0], mid[1])] * 16, range(0, 80, 5))
+    dup_times = mk([(mid[0] + 1e-5 * i, mid[1]) for i in range(16)], [100] * 16)
+    jitter = mk([(mid[0] + rng.normal(0, 2e-5), mid[1] + rng.normal(0, 2e-5))
+                 for _ in range(16)], range(0, 160, 10))
+    traces = [stationary, dup_times, jitter]
+    for d, o in zip(dev.match_many(traces), ora.match_many(traces)):
+        assert _canon(d) == _canon(o), (json.dumps(_canon(d))[:300],
+                                        json.dumps(_canon(o))[:300])
+
+
 @pytest.mark.parametrize("seed", [11, 23, 37, 59, 71, 83, 97, 109])
 def test_random_topology_backend_parity(seed):
     rng = np.random.default_rng(seed)
