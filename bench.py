@@ -287,12 +287,15 @@ def run_device() -> int:
     # 2026-07-31: inflight 4 read 2639 tr/s at 5 reps, 3116 at 10)
     reps = int(os.environ.get("BENCH_REPS", "10"))
     # in-flight fleet reps: N+1 (and N+2, ...) dispatched before rep N's
-    # association finishes.  2 = the service MicroBatcher's minimum
-    # operating mode; 4 (measured best on v5e, 2026-07-31: 3116 vs 2321
-    # tr/s e2e, device_util 1.0 vs 0.87) hides every sync quantum and the
-    # whole of host association under device compute, pinning one extra
-    # fleet's packed arrays per slot.
-    inflight = max(1, int(os.environ.get("BENCH_INFLIGHT", "4")))
+    # association finishes.  4 (measured best on v5e, 2026-07-31: 3116 vs
+    # 2321 tr/s e2e, device_util 1.0 vs 0.87) hides every sync quantum and
+    # the whole of host association under device compute, pinning one
+    # extra fleet's packed arrays per slot.  On the cpu backend the
+    # "device" and the association share host cores, so deep pipelining
+    # only adds contention (measured same-machine: 16.0 tr/s at depth 2
+    # vs 14.7 at depth 4) -- the fallback default stays at 2.
+    inflight_default = "4" if platform != "cpu" else "2"
+    inflight = max(1, int(os.environ.get("BENCH_INFLIGHT", inflight_default)))
     from collections import deque as _deque
 
     finishes: "_deque" = _deque()
