@@ -277,10 +277,10 @@ def run_device() -> int:
     # end-to-end throughput, steady-state pipelined: fleet rep N+1 (and up
     # to BENCH_INFLIGHT-1 more) dispatched before rep N's association
     # finishes -- the service MicroBatcher's operating mode (its
-    # max_inflight shares the measured default of 4).  Round 4 measured
-    # the reps serially, so the device idled through every rep's
-    # association + fetch quanta -- device_util 0.45 with a kernel twice
-    # as fast as e2e (VERDICT r04 next #2b).
+    # max_inflight resolves by platform exactly like the default below).
+    # Round 4 measured the reps serially, so the device idled through
+    # every rep's association + fetch quanta -- device_util 0.45 with a
+    # kernel twice as fast as e2e (VERDICT r04 next #2b).
     _write_status(phase="benching", step="e2e", platform=platform)
     # 10 reps: at 5 the ~70 ms tunnel sync quanta on the pipeline's fill
     # and drain edges are a measurable bias on a ~1 s window (measured

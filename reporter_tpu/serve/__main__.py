@@ -74,7 +74,8 @@ def main(argv):
         None,
         max_batch=int(batch.get("max_batch", 64)),
         max_wait_ms=float(batch.get("max_wait_ms", 10.0)),
-        max_inflight=int(batch.get("max_inflight", 4)),
+        max_inflight=(int(batch["max_inflight"])
+                      if "max_inflight" in batch else None),
     )
     httpd = service.make_server(host, int(port))
     logging.info("reporter_tpu service on %s:%s (engine deferred)", host, port)

@@ -75,7 +75,21 @@ class MicroBatcher:
     """
 
     def __init__(self, matcher: SegmentMatcher, max_batch: int = 64, max_wait_ms: float = 10.0,
-                 max_inflight: int = 4):
+                 max_inflight: Optional[int] = None):
+        if max_inflight is None:
+            # 4 = measured v5e optimum (hides every dispatch sync quantum
+            # and all host association under device compute); when the
+            # compute actually runs on host cores (the numpy cpu backend,
+            # or the jax backend on cpu devices) it shares them with
+            # association and deep pipelining only adds contention --
+            # same platform split, same measurements as bench.py's
+            # BENCH_INFLIGHT default
+            plat = "cpu"
+            if getattr(matcher, "backend", "cpu") != "cpu":
+                import jax
+
+                plat = jax.devices()[0].platform
+            max_inflight = 4 if plat != "cpu" else 2
         self.matcher = matcher
         self.max_batch = max_batch
         self.max_wait = max_wait_ms / 1000.0
@@ -152,7 +166,7 @@ class ReporterService:
         threshold_sec: Optional[int] = None,
         max_batch: int = 64,
         max_wait_ms: float = 10.0,
-        max_inflight: int = 4,
+        max_inflight: Optional[int] = None,
     ):
         """``matcher=None`` defers the engine: the HTTP socket can bind and
         /health can answer before the accelerator backend is even

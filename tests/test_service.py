@@ -275,8 +275,11 @@ def test_max_inflight_plumbs_to_batcher(service_matcher):
 
     svc = ReporterService(service_matcher, max_inflight=3)
     assert svc.batcher._finish_q.maxsize == 3
+    # default resolves by physical platform: tests run on cpu devices,
+    # where host compute and association share cores -> 2 (4 on real
+    # accelerators; see MicroBatcher.__init__)
     svc_default = ReporterService(service_matcher)
-    assert svc_default.batcher._finish_q.maxsize == 4
+    assert svc_default.batcher._finish_q.maxsize == 2
 
 
 def test_concurrent_requests_micro_batch(service_url):
