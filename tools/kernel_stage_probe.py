@@ -39,7 +39,6 @@ def main() -> int:
         return 5
     print("device:", jax.devices()[0].device_kind, file=sys.stderr)
 
-    from reporter_tpu import ops
     from reporter_tpu.matching import MatcherConfig, SegmentMatcher
     from reporter_tpu.ops import hashtable as ht
     from reporter_tpu.ops import viterbi as vt
