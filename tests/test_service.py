@@ -313,6 +313,58 @@ def test_concurrent_requests_micro_batch(service_url):
     assert all(r == results[0] for r in results[1:])
 
 
+def test_wire_garbage_does_not_kill_the_listener(service_url):
+    """Protocol-level fuzz: raw-socket garbage, truncated frames, lying
+    Content-Lengths, oversized header lines, abrupt resets, and pipelined
+    request bytes must never take the listener down or wedge a handler --
+    after every abuse the server still answers a clean /report."""
+    import socket
+
+    url, arrays = service_url
+    host, port = url.split("//")[1].rsplit(":", 1)
+    port = int(port)
+
+    def raw(payload: bytes, read: bool = True, wait_s: float = 5.0):
+        s = socket.create_connection((host, port), timeout=10)
+        try:
+            # the provoked closes can RST mid-send/mid-recv; any OSError
+            # here IS the abuse landing, not a test failure
+            try:
+                s.sendall(payload)
+                if read:
+                    s.settimeout(wait_s)
+                    s.recv(4096)
+            except OSError:
+                pass
+        finally:
+            s.close()
+
+    abuses = [
+        (b"\x00\xff\x17garbage that is not http at all\r\n\r\n", True, 5.0),
+        (b"GET /health HTTP/1.1\r\nHost: x\r\n" + b"X-Pad: " + b"a" * 70000
+         + b"\r\n\r\n", True, 5.0),
+        # lying Content-Length: the server blocks reading a body that never
+        # comes -- don't wait for a response it cannot send
+        (b"POST /report HTTP/1.1\r\nHost: x\r\nContent-Length: 99999\r\n\r\n"
+         b"{\"uuid\"", True, 0.5),
+        (b"POST /report HTTP/1.1\r\nHost: x\r\nContent-Length: -5\r\n\r\nxxxxx",
+         True, 5.0),
+        (b"POST /report HTTP/1.1\r\nHost: x\r\nContent-Length: notanumber\r\n\r\n{}",
+         True, 5.0),
+        (b"GET /health HTTP/1.1\r\nHost: x\r\n\r\nGET /health HTTP/1.1\r\n"
+         b"Host: x\r\n\r\n", True, 5.0),
+        (b"POST /report HTTP/1.0\r\n\r\n", True, 5.0),
+    ]
+    for i, (payload, read, wait_s) in enumerate(abuses):
+        raw(payload, read=read, wait_s=wait_s)
+        # an abrupt reset mid-request too
+        raw(payload[: max(4, len(payload) // 3)], read=False)
+        # the listener still serves a full valid request after each abuse
+        code, out = post_json(url + "/report", street_trace(arrays))
+        assert code == 200, (i, code, out)
+        assert out["datastore"]["reports"], (i, "no reports")
+
+
 class TestHealthEndpoint:
     def test_health_snapshot(self, service_url):
         url, arrays = service_url
