@@ -80,6 +80,37 @@ class TestPrivacyCull:
         rows = [self.obs(SID, SID2)]
         assert privacy_cull(rows, 2) == []
 
+    def test_privacy_property_randomized(self):
+        """The privacy promise, checked as a property over random inputs:
+        for every (segment_id, next_segment_id) pair, the output carries
+        either ALL of its observations (count >= privacy) or NONE
+        (count < privacy) -- never a partial group -- and the output is
+        sorted by the contract key."""
+        import collections
+        import numpy as np
+
+        rng = np.random.default_rng(17)
+        for trial in range(25):
+            privacy = int(rng.integers(1, 5))
+            ids = [int(v) for v in rng.integers(1, 9, 2)]
+            rows = []
+            for _ in range(int(rng.integers(0, 40))):
+                a, b = int(rng.choice(ids + [3, 4, 5])), int(rng.choice(ids + [3, 4, 5]))
+                t = int(rng.integers(0, 3600))
+                rows.append(SegmentObservation(
+                    a, b, 10, 1, float(rng.integers(20, 400)), 0.0,
+                    t, t + 10, "s", "AUTO"))
+            counts = collections.Counter(
+                (r.segment_id, r.next_segment_id) for r in rows)
+            out = privacy_cull(list(rows), privacy)
+            out_counts = collections.Counter(
+                (r.segment_id, r.next_segment_id) for r in out)
+            for pair, n in counts.items():
+                want = n if n >= privacy else 0
+                assert out_counts.get(pair, 0) == want, (trial, pair, n, privacy)
+            assert [r.sort_key() for r in out] == sorted(
+                r.sort_key() for r in out), (trial, "output not sorted")
+
     def test_csv_roundtrip(self):
         rows = [self.obs(SID, SID2), self.obs(SID, SID2)]
         text = tile_csv(rows)
