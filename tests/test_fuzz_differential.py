@@ -117,6 +117,22 @@ def _canon(result: dict) -> dict:
     return out
 
 
+@pytest.mark.parametrize("seed", [7, 19, 43])
+def test_ubodt_builders_bit_identical_random_topology(seed):
+    """The C++ and Python UBODT builders must stay byte-identical on
+    arbitrary topologies, not just the structured fixtures -- one-way
+    streets and the disconnected component change the Dijkstra frontier
+    shapes and the insertion order the packers must reproduce."""
+    rng = np.random.default_rng(seed)
+    net = random_network(rng)
+    arrays = build_graph_arrays(net)
+    u_py = build_ubodt(arrays, delta=1500.0, use_native=False)
+    u_nat = build_ubodt(arrays, delta=1500.0, use_native=True)
+    assert u_py.bmask == u_nat.bmask
+    assert np.array_equal(u_py.packed, u_nat.packed)
+    assert u_py.num_rows == u_nat.num_rows
+
+
 def test_degenerate_inputs_backend_parity():
     """Stationary vehicles, duplicate timestamps, and a point cloud jittering
     around one position -- inputs real fleets produce at every red light --
