@@ -137,6 +137,39 @@ def test_batch_serde_roundtrip():
     assert c.max_separation == pytest.approx(b.max_separation)
 
 
+def test_serde_corruption_fails_cleanly():
+    """Truncated / bit-flipped wire buffers (what a half-written Kafka
+    message or a bad checkpoint produces) must raise ordinary exceptions
+    or decode to garbage values -- never hang, exit, or blow memory.
+    Mirrors the binary formats' role at the reference's processor
+    boundaries (Point 20 B, Segment 40 B, Batch list serde)."""
+    import numpy as np
+
+    from reporter_tpu.stream.point import Point
+    from reporter_tpu.stream.segment import Segment
+
+    b = Batch(_pt(1.0, 2.0, 3))
+    for i in range(2, 8):
+        b.update(_pt(1.0 + 0.01 * i, 2.0, i * 15))
+    seg = Segment(id=123456, next_id=789, min=100.0, max=160.0,
+                  length=250, queue=0)
+    blobs = [b.pack(), seg.pack(), _pt(3.3, 4.4, 5).pack()]
+    rng = np.random.default_rng(1)
+    unpackers = [Batch.unpack, Segment.unpack, Point.unpack]
+    for blob, unpack in zip(blobs, unpackers):
+        cases = [blob[:k] for k in (0, 1, len(blob) // 2, len(blob) - 1)]
+        for _ in range(12):
+            bb = bytearray(blob)
+            bb[int(rng.integers(0, len(blob)))] ^= 0xFF
+            cases.append(bytes(bb))
+        for payload in cases:
+            try:
+                unpack(payload)
+            except Exception as e:  # noqa: BLE001 - clean failure is a pass
+                assert not isinstance(
+                    e, (SystemExit, KeyboardInterrupt, MemoryError))
+
+
 def test_batch_trim_on_shape_used():
     b = Batch(_pt(0.0, 0.0, 0))
     for i in range(1, 5):
