@@ -78,6 +78,42 @@ def test_formatter_bogus():
             Formatter.from_config(bogus)
 
 
+def test_formatter_line_garbage_fails_cleanly():
+    """Line-level garbage (the constant diet of a production feed: wrong
+    column counts, non-numeric fields, NULs, huge lines, truncated
+    multibyte text) must either parse to a (uuid, Point) or raise an
+    ordinary exception for the pipeline's swallow-and-log seam -- never
+    hang or take the process down."""
+    import numpy as np
+
+    f = Formatter.from_config(",sv,\\|,0,2,3,1,4")
+    rng = np.random.default_rng(8)
+    lines = [
+        "", "|", "||||", "a|b|c|d|e", "veh|notatime|1.0|2.0|5",
+        "veh|100|91.0|181.0|5", "veh|100|nan|inf|5",
+        "veh|100|1.0|2.0|" + "9" * 400, "\x00\x00|\x00|\x00|\x00|\x00",
+        "veh|100|1.0|2.0|5|extra|columns|everywhere",
+        "x" * 100000,
+    ]
+    for _ in range(30):
+        n = int(rng.integers(0, 12))
+        lines.append("|".join(
+            "".join(chr(int(c)) for c in rng.integers(32, 127, rng.integers(0, 9)))
+            for _ in range(n)))
+    ok = 0
+    for line in lines:
+        try:
+            out = f.format(line)
+            if out is not None:
+                ok += 1
+        except Exception as e:  # noqa: BLE001 - clean failure is the contract
+            assert not isinstance(
+                e, (SystemExit, KeyboardInterrupt, MemoryError))
+    # sanity: a well-formed line still parses
+    uuid, p = f.format("veh|100|37.75|-122.45|5")
+    assert uuid == "veh" and p.time == 100
+
+
 def test_joda_conversion():
     assert joda_to_strptime("yyyy-MM-dd HH:mm:ss") == "%Y-%m-%d %H:%M:%S"
     with pytest.raises(ValueError):
