@@ -92,14 +92,47 @@ def save_file(pipeline, path: str) -> None:
     log.debug("checkpointed stream state to %s", path)
 
 
+def _set_aside(path: str) -> str:
+    """Move a bad checkpoint out of the boot path, preserving it for
+    post-mortem.  Returns where it went (or a marker when even the rename
+    fails)."""
+    aside = path + ".corrupt"
+    try:
+        os.replace(path, aside)
+    except OSError:
+        aside = "<unmovable>"
+    return aside
+
+
 def load_file(pipeline, path: str) -> bool:
     """Restore from ``path`` if it exists.  Returns True when state was
-    loaded."""
+    loaded.
+
+    A corrupt or incompatible file must NOT crash-loop the boot (a
+    container restart policy can never escape it, while live traffic
+    keeps flowing past): any parse/restore failure rolls the pipeline
+    back to its pre-load state, sets the bad file aside as
+    ``<path>.corrupt`` for post-mortem, and boots clean -- the same
+    loss profile as having no checkpoint.  ``restore`` itself stays
+    strict for programmatic callers."""
     if not os.path.exists(path):
         return False
-    with open(path) as f:
-        state = json.load(f)
-    restore(pipeline, state)
+    # drift-proof rollback: capture the pre-load state with the same serde
+    # restore() consumes, so a mid-restore failure can never leave behind a
+    # field this code forgot to save (snapshot/restore own the field list)
+    prior = snapshot(pipeline)
+    try:
+        with open(path) as f:
+            state = json.load(f)
+        restore(pipeline, state)
+    except Exception:  # noqa: BLE001 - boot seam: log, preserve, continue
+        restore(pipeline, prior)
+        aside = _set_aside(path)
+        log.exception(
+            "stream checkpoint %s is unreadable; set aside as %s, booting "
+            "clean (in-flight windows from the previous run are lost)",
+            path, aside)
+        return False
     log.info(
         "restored stream state from %s: %d in-flight vehicles, %d tile slices",
         path, len(pipeline.batcher.store), len(pipeline.anonymiser.slices),
@@ -226,13 +259,27 @@ class PartitionCheckpointer:
 
     def load(self, partition: int) -> int:
         """Adopt the partition's snapshot if one exists.  Returns vehicles
-        restored."""
+        restored.
+
+        Same corrupt-file seam as load_file: a bad snapshot must not
+        crash-loop the rebalance (every reassignment of the partition
+        would re-raise, fleet-wide); it is set aside as .corrupt and the
+        partition boots clean.  restore_partition parses the whole
+        snapshot before its single put_partition mutation, so there is no
+        partial state to roll back."""
         path = self._path(partition)
         if not os.path.exists(path):
             return 0
-        with open(path) as f:
-            state = json.load(f)
-        n = restore_partition(self.pipeline, state)
+        try:
+            with open(path) as f:
+                state = json.load(f)
+            n = restore_partition(self.pipeline, state)
+        except Exception:  # noqa: BLE001 - rebalance seam: log + continue
+            aside = _set_aside(path)
+            log.exception(
+                "partition %d checkpoint %s is unreadable; set aside as %s, "
+                "booting the partition clean", partition, path, aside)
+            return 0
         log.info("restored partition %d (%d vehicles) from %s", partition, n, path)
         return n
 

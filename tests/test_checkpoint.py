@@ -84,3 +84,57 @@ def test_version_mismatch_rejected(tmp_path):
     p = _pipeline(tmp_path, "out4")
     with pytest.raises(ValueError):
         restore(p, {"version": 99})
+
+
+def test_corrupt_checkpoint_boots_clean_and_sets_file_aside(tmp_path):
+    """A corrupt checkpoint must not crash-loop the CLI boot: load_file
+    rolls back to clean state, renames the file to .corrupt, returns
+    False -- and the next boot doesn't see it again."""
+    import json
+
+    p1 = _pipeline(tmp_path, "out5")
+    _feed(p1, 6)
+    ck = str(tmp_path / "state.ckpt")
+    save_file(p1, ck)
+
+    # mid-restore failure: valid version + counters + batcher block, then
+    # an unparseable anonymiser slice -- restore() mutates dropped/_ready/
+    # reported_pairs before it fails, so the rollback must cover them all
+    partial = json.loads(open(ck).read())
+    partial["dropped"] = 7
+    partial["batcher"]["reported_pairs"] = 9
+    partial["anonymiser"]["slices"] = {"t": "!!!notbase64"}
+
+    for payload in (b"{truncated", b"\x00\xff\x00garbage",
+                    json.dumps({"version": 99}).encode(),
+                    json.dumps({"version": 1, "batcher": 42}).encode(),
+                    json.dumps(partial).encode()):
+        with open(ck, "wb") as f:
+            f.write(payload)
+        p2 = _pipeline(tmp_path, "out5b")
+        assert load_file(p2, ck) is False
+        assert p2.batcher.store == {}  # rolled back / clean
+        assert p2.dropped == 0 and p2.batcher.reported_pairs == 0
+        assert p2.batcher._ready == []
+        assert os.path.exists(ck + ".corrupt")
+        assert not os.path.exists(ck)
+        # second boot: the bad file is gone, clean boot without noise
+        p3 = _pipeline(tmp_path, "out5c")
+        assert load_file(p3, ck) is False
+        os.remove(ck + ".corrupt")
+
+
+def test_corrupt_partition_checkpoint_boots_partition_clean(tmp_path):
+    """The consumer-group path has the same seam: a bad part-N.ckpt must
+    not crash-loop every rebalance that assigns partition N."""
+    from reporter_tpu.stream.checkpoint import PartitionCheckpointer
+
+    p = _pipeline(tmp_path, "out6")
+    ck = PartitionCheckpointer(p, str(tmp_path / "parts"))
+    bad = ck._path(3)
+    with open(bad, "wb") as f:
+        f.write(b"{nope")
+    assert ck.load(3) == 0
+    assert os.path.exists(bad + ".corrupt")
+    assert not os.path.exists(bad)
+    assert ck.load(3) == 0  # second rebalance: clean, no file
