@@ -751,6 +751,7 @@ def _finish_device(proc, timeout, status_file):
     last_st = None
     dead_since = None
     frozen_since = None
+    armed = False  # a non-cpu platform has been observed in the status file
     while True:
         if proc.poll() is not None:
             return _result(kill=False)
@@ -758,8 +759,16 @@ def _finish_device(proc, timeout, status_file):
             _stderr("device worker exceeded run budget (%.0fs); killing" % timeout)
             return _result(kill=True)
         st = _read_status(status_file)
-        on_accel = (st or {}).get("platform") not in (None, "cpu")
-        progressed = not on_accel or st != last_st
+        if st:
+            on_accel = st.get("platform") not in (None, "cpu")
+            armed = armed or on_accel
+        else:
+            # unreadable/vanished status file: once armed, it must count as
+            # NON-progressing — treating {} as platform-unknown disarmed
+            # both watchdogs and a wedged worker burned the full run budget
+            # (ADVICE r05)
+            on_accel = armed
+        progressed = not on_accel or (bool(st) and st != last_st)
         # ports-open wedge: status frozen long past any legitimate compile
         # wave kills the worker regardless of relay state
         if progressed:

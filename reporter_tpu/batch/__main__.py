@@ -64,6 +64,10 @@ def main(argv=None) -> int:
     ap.add_argument("--trace-dir", default=None)
     ap.add_argument("--match-dir", default=None)
     ap.add_argument("--no-cleanup", action="store_true")
+    ap.add_argument("--metrics", action="store_true",
+                    help="on exit, print ONE merged JSON metrics snapshot "
+                         "covering this process and every fan-out worker "
+                         "(docs/observability.md)")
     args = ap.parse_args(argv)
 
     logging.basicConfig(
@@ -101,6 +105,17 @@ def main(argv=None) -> int:
     )
     if trace_dir or match_dir:
         print("trace_dir=%s match_dir=%s" % (trace_dir, match_dir))
+    if args.metrics:
+        # one snapshot covering all processes: the head's registry (phase 2
+        # runs in-process) merged with every fan-out worker's dump
+        import json
+
+        from ..obs import metrics as obs
+        from .pipeline import WORKER_SNAPSHOTS
+
+        print(json.dumps(
+            obs.merge(obs.REGISTRY.snapshot(), *WORKER_SNAPSHOTS),
+            separators=(",", ":")))
     return 0
 
 

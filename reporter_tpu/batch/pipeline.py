@@ -33,6 +33,7 @@ import calendar
 import functools
 import gzip
 import hashlib
+import json
 import logging
 import math
 import multiprocessing
@@ -44,6 +45,7 @@ import uuid as uuidlib
 from typing import Callable, Iterable, List, Optional, Sequence, Tuple
 
 from ..anonymise.storage import make_store
+from ..obs import metrics as obs
 from ..anonymise.tiles import (
     CSV_HEADER,
     SegmentObservation,
@@ -55,6 +57,62 @@ from ..native import parse_shard_bytes
 from ..report.reporter import report as report_fn
 
 log = logging.getLogger("reporter_tpu.batch")
+
+# per-phase counters.  Phases 1 and 3 fan out over spawn processes, each
+# with its own default registry: workers dump a snapshot file on exit and
+# the parent collects them into WORKER_SNAPSHOTS, which the batch head's
+# --metrics flag merges (obs.metrics.merge) with the parent registry into
+# ONE snapshot covering every process.
+C_SRC_FILES = obs.counter(
+    "reporter_batch_source_files_total",
+    "Archive source files processed in phase 1", ("status",))
+C_GATHERED = obs.counter(
+    "reporter_batch_points_gathered_total",
+    "Probe points written to uuid-hash shards (post bbox filter)")
+C_ROWS_SKIPPED = obs.counter(
+    "reporter_batch_rows_skipped_total",
+    "Malformed shard rows skipped by the phase-2 parser")
+C_WINDOWS = obs.counter(
+    "reporter_batch_windows_matched_total",
+    "Trace windows matched and reported in phase 2")
+C_REPORT_FAIL = obs.counter(
+    "reporter_batch_report_failures_total",
+    "Trace windows whose match or report failed in phase 2")
+C_TILES_UP = obs.counter(
+    "reporter_batch_tiles_uploaded_total",
+    "Phase-3 tile uploads", ("status",))
+C_CULLED = obs.counter(
+    "reporter_batch_segments_culled_total",
+    "Tile rows dropped by the phase-3 privacy cull (incl. malformed rows)")
+
+# snapshots collected from fan-out workers this process spawned (appended
+# by get_traces/report_tiles; merged by the batch head's --metrics dump)
+WORKER_SNAPSHOTS: List[dict] = []
+
+
+def _dump_registry(snap_path: Optional[str]) -> None:
+    """Worker-side: persist this process's registry for the parent."""
+    if not snap_path:
+        return
+    try:
+        with open(snap_path, "w") as f:
+            json.dump(obs.REGISTRY.snapshot(), f, separators=(",", ":"))
+    except Exception:  # noqa: BLE001 - metrics must never fail the phase
+        log.exception("could not write metrics snapshot %s", snap_path)
+
+
+def _collect_worker_snaps(snap_dir: str) -> None:
+    """Parent-side: read every worker snapshot written under snap_dir."""
+    import shutil
+
+    for name in sorted(os.listdir(snap_dir)):
+        try:
+            with open(os.path.join(snap_dir, name)) as f:
+                WORKER_SNAPSHOTS.append(json.load(f))
+        except Exception:  # noqa: BLE001 - a dead worker may have written none
+            log.warning("unreadable worker metrics snapshot %s", name)
+    shutil.rmtree(snap_dir, ignore_errors=True)
+
 
 DEFAULT_VALUER = (
     'lambda l: (lambda c: (c[1], c[0], c[9], c[10], c[5]))(l.split("|"))'
@@ -158,37 +216,44 @@ def make_archive(spec: str):
 # -- phase 1: gather -------------------------------------------------------
 
 
-def _gather(archive_spec, keys, valuer_src, time_pattern, bbox, dest_dir):
+def _gather(archive_spec, keys, valuer_src, time_pattern, bbox, dest_dir,
+            snap_path=None):
     archive = make_archive(archive_spec)
     valuer = compile_valuer(valuer_src)
-    for key in keys:
-        try:
-            shards = {}
-            with archive.open(key) as f:
-                for line in f:
-                    uuid, tm, lat, lon, acc = valuer(line.rstrip("\n"))
-                    lat = float(lat)
-                    lon = float(lon)
-                    # bbox is [min_lat, min_lon, max_lat, max_lon]
-                    if lat < bbox[0] or lat > bbox[2] or lon < bbox[1] or lon > bbox[3]:
-                        continue
-                    if time_pattern:
-                        tm = calendar.timegm(time.strptime(str(tm), time_pattern))
-                    else:
-                        tm = int(tm)
-                    acc = min(int(math.ceil(float(acc))), 1000)
-                    shard = hashlib.sha1(str(uuid).encode()).hexdigest()[:3]
-                    shards.setdefault(shard, []).append(
-                        "%s,%d,%s,%s,%d\n" % (uuid, tm, lat, lon, acc)
-                    )
-            for shard, rows in shards.items():
-                with open(os.path.join(dest_dir, shard), "a") as sf:
-                    sf.write("".join(rows))
-            log.info("gathered traces from %s", key)
-        except (KeyboardInterrupt, SystemExit):
-            raise
-        except Exception as e:
-            log.error("%s was not processed: %s", key, e)
+    try:
+        for key in keys:
+            try:
+                shards = {}
+                with archive.open(key) as f:
+                    for line in f:
+                        uuid, tm, lat, lon, acc = valuer(line.rstrip("\n"))
+                        lat = float(lat)
+                        lon = float(lon)
+                        # bbox is [min_lat, min_lon, max_lat, max_lon]
+                        if lat < bbox[0] or lat > bbox[2] or lon < bbox[1] or lon > bbox[3]:
+                            continue
+                        if time_pattern:
+                            tm = calendar.timegm(time.strptime(str(tm), time_pattern))
+                        else:
+                            tm = int(tm)
+                        acc = min(int(math.ceil(float(acc))), 1000)
+                        shard = hashlib.sha1(str(uuid).encode()).hexdigest()[:3]
+                        shards.setdefault(shard, []).append(
+                            "%s,%d,%s,%s,%d\n" % (uuid, tm, lat, lon, acc)
+                        )
+                for shard, rows in shards.items():
+                    with open(os.path.join(dest_dir, shard), "a") as sf:
+                        sf.write("".join(rows))
+                    C_GATHERED.inc(len(rows))
+                C_SRC_FILES.labels("ok").inc()
+                log.info("gathered traces from %s", key)
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except Exception as e:
+                C_SRC_FILES.labels("error").inc()
+                log.error("%s was not processed: %s", key, e)
+    finally:
+        _dump_registry(snap_path)
 
 
 def get_traces(
@@ -214,15 +279,19 @@ def get_traces(
         # spawn, not fork: the driver process usually has JAX (and its thread
         # pool) initialised, and forking a multithreaded process can deadlock
         ctx = multiprocessing.get_context("spawn")
+        snap_dir = tempfile.mkdtemp(prefix="obs_gather_")
         procs = []
-        for chunk in split(keys, concurrency):
+        for i, chunk in enumerate(split(keys, concurrency)):
             p = ctx.Process(
                 target=_gather,
-                args=(archive_spec, chunk, valuer, time_pattern, list(bbox), dest_dir),
+                args=(archive_spec, chunk, valuer, time_pattern, list(bbox),
+                      dest_dir, os.path.join(snap_dir, "w%d.json" % i)),
             )
             p.start()
             procs.append(p)
-        if _join_checked(procs):
+        dead = _join_checked(procs)
+        _collect_worker_snaps(snap_dir)
+        if dead:
             raise RuntimeError(
                 "one or more gather workers died; the shard set is incomplete"
             )
@@ -314,6 +383,7 @@ def make_matches(
                     }
                 )
         if skipped:
+            C_ROWS_SKIPPED.inc(skipped)
             log.warning("skipped %d malformed row(s) in %s", skipped, file_name)
 
         # build every match request up front; competing phase-1 appends are
@@ -335,6 +405,7 @@ def make_matches(
             except (KeyboardInterrupt, SystemExit):
                 raise
             except Exception as e:
+                C_REPORT_FAIL.inc(len(chunk))
                 log.error("match micro-batch failed in %s: %s", file_name, e)
                 continue
             for request, match in zip(chunk, matches):
@@ -345,12 +416,14 @@ def make_matches(
                 except (KeyboardInterrupt, SystemExit):
                     raise
                 except Exception:
+                    C_REPORT_FAIL.inc()
                     log.error(
                         "failed to report trace with uuid %s from file %s",
                         request["uuid"], file_name,
                     )
                     continue
                 matched += 1
+                C_WINDOWS.inc()
                 _bucket_reports(
                     rep, request, quantisation, source, mode, tiles, file_name
                 )
@@ -404,31 +477,38 @@ def _cull_lines(lines: List[str], privacy: int) -> List[str]:
     return [o.csv_row() + "\n" for o in kept]
 
 
-def _report_files(match_dir, file_names, store_spec, privacy, fail_counter=None):
+def _report_files(match_dir, file_names, store_spec, privacy, fail_counter=None,
+                  snap_path=None):
     """Cull + upload a list of tile files.  Returns the number of failed
     uploads (also added to ``fail_counter`` when given, for fan-out)."""
     store = make_store(store_spec)
     failures = 0
-    for file_name in file_names:
-        with open(file_name) as f:
-            lines = [l for l in f.readlines() if l.strip()]
-        kept = _cull_lines(lines, privacy)
-        if not kept:
-            log.info("no segments for %s after anonymising", file_name)
-            continue
-        rel = os.path.relpath(file_name, match_dir)
-        # a fresh suffix per run so overlapping backfills accumulate instead
-        # of overwriting (the stream anonymiser names tiles the same way)
-        key = rel.replace(os.sep, "/") + "/" + uuidlib.uuid4().hex
-        log.info("writing %d segments to %s", len(kept), key)
-        try:
-            store.put(key, CSV_HEADER + "\n" + "".join(kept))
-        except Exception as e:
-            failures += 1
-            log.error("failed to upload %s: %s", key, e)
-    if fail_counter is not None and failures:
-        with fail_counter.get_lock():
-            fail_counter.value += failures
+    try:
+        for file_name in file_names:
+            with open(file_name) as f:
+                lines = [l for l in f.readlines() if l.strip()]
+            kept = _cull_lines(lines, privacy)
+            C_CULLED.inc(len(lines) - len(kept))
+            if not kept:
+                log.info("no segments for %s after anonymising", file_name)
+                continue
+            rel = os.path.relpath(file_name, match_dir)
+            # a fresh suffix per run so overlapping backfills accumulate instead
+            # of overwriting (the stream anonymiser names tiles the same way)
+            key = rel.replace(os.sep, "/") + "/" + uuidlib.uuid4().hex
+            log.info("writing %d segments to %s", len(kept), key)
+            try:
+                store.put(key, CSV_HEADER + "\n" + "".join(kept))
+                C_TILES_UP.labels("ok").inc()
+            except Exception as e:
+                failures += 1
+                C_TILES_UP.labels("error").inc()
+                log.error("failed to upload %s: %s", key, e)
+        if fail_counter is not None and failures:
+            with fail_counter.get_lock():
+                fail_counter.value += failures
+    finally:
+        _dump_registry(snap_path)
     return failures
 
 
@@ -449,15 +529,18 @@ def report_tiles(
     else:
         ctx = multiprocessing.get_context("spawn")  # see get_traces re fork+JAX
         fail_counter = ctx.Value("i", 0)
+        snap_dir = tempfile.mkdtemp(prefix="obs_report_")
         procs = []
-        for chunk in split(file_names, concurrency):
+        for i, chunk in enumerate(split(file_names, concurrency)):
             p = ctx.Process(
                 target=_report_files,
-                args=(match_dir, chunk, store_spec, privacy, fail_counter),
+                args=(match_dir, chunk, store_spec, privacy, fail_counter,
+                      os.path.join(snap_dir, "w%d.json" % i)),
             )
             p.start()
             procs.append(p)
         dead = _join_checked(procs)
+        _collect_worker_snaps(snap_dir)
         failures = fail_counter.value + dead
     log.info("done reporting tiles (%d upload failures)", failures)
     return failures

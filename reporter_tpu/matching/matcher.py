@@ -17,10 +17,13 @@ from __future__ import annotations
 
 import json
 import logging
+import os
+import time as _time
 from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
+from ..obs import metrics as obs
 from ..tiles.arrays import GraphArrays, build_graph_arrays
 from ..tiles.network import RoadNetwork
 from ..tiles.ubodt import UBODT, build_ubodt
@@ -28,6 +31,33 @@ from .assoc_native import associate_segments_batch
 from .config import MatcherConfig
 
 log = logging.getLogger(__name__)
+
+# compile visibility (docs/observability.md): the jitted kernels compile
+# once per padded (B, T) shape, and a shape-set regression shows up as
+# nothing BUT compile stalls — invisible in throughput aggregates.  A
+# "compile" here is the first dispatch of a shape: that call blocks on XLA
+# tracing+compilation, so its wall time is the stall a request actually saw
+# (with the persistent compilation cache it is the cache-replay cost).
+C_COMPILES = obs.counter(
+    "reporter_compile_total",
+    "First-dispatch (compiling) device calls per padded shape bucket",
+    ("shape",))
+C_COMPILE_S = obs.counter(
+    "reporter_compile_seconds_total",
+    "Wall seconds spent blocked in first-dispatch (compiling) calls",
+    ("shape",))
+C_TRACES = obs.counter(
+    "reporter_traces_matched_total", "Traces run through host association")
+C_POINTS = obs.counter(
+    "reporter_points_matched_total", "Valid trace points run through host association")
+C_BREAKS = obs.counter(
+    "reporter_transition_breaks_total",
+    "Points flagged as HMM discontinuities (includes window starts)")
+C_PROBES = obs.counter(
+    "reporter_ubodt_probe_total",
+    "Sampled UBODT transition-probe outcomes (ops/diagnostics.py; enable "
+    "with REPORTER_OBS_PROBE_EVERY=N)",
+    ("outcome",))
 
 # chunks allowed in flight on the device while the host associates earlier
 # ones.  Each in-flight chunk pins its packed input + result,
@@ -83,6 +113,17 @@ class SegmentMatcher:
         self.arrays = arrays
         self.ubodt = ubodt or build_ubodt(arrays, delta=self.cfg.ubodt_delta)
         self.backend = backend
+        # first-dispatch shape tracking for the compile counters, plus the
+        # sampled device-side probe diagnostic (0 = off, the default: the
+        # probe program doubles device work for its batch, so it is an
+        # every-Nth-dispatch sample, never an always-on cost)
+        self._compiled_shapes: set = set()
+        self._dispatch_count = 0
+        try:
+            self._probe_every = int(os.environ.get("REPORTER_OBS_PROBE_EVERY", "0"))
+        except ValueError:
+            self._probe_every = 0
+        self._jit_probe = None
         if backend == "jax":
             self._init_jax()
         elif backend == "cpu":
@@ -249,14 +290,55 @@ class SegmentMatcher:
                 px, py, times, valid = _pad_rows(
                     self._n_dp - px.shape[0] % self._n_dp, px, py, times, valid
                 )
-            res = fn(
-                self._dg, self._du,
-                self._put_packed(pack_inputs(px, py, times, valid)),
-                self._params, self.cfg.beam_k,
-            )
+            xin = self._put_packed(pack_inputs(px, py, times, valid))
+            t0 = _time.monotonic()
+            res = fn(self._dg, self._du, xin, self._params, self.cfg.beam_k)
+            self._note_dispatch(px.shape, _time.monotonic() - t0)
+            if self._probe_every:
+                self._dispatch_count += 1
+                if self._dispatch_count % self._probe_every == 0:
+                    self._record_probe_stats(xin)
             self._start_host_copy(res)
             return ("jax", B, res)
         return ("cpu", self._cpu.run_batch(px, py, times, valid))
+
+    def _note_dispatch(self, shape, dt: float, kind: str = "") -> None:
+        """Feed the compile counters on a shape's first dispatch (the call
+        that blocked on XLA).  ``shape`` is the padded (B, T) the kernel
+        compiled for; ``kind`` distinguishes the carry-chain program."""
+        key = (kind,) + tuple(shape)
+        if key in self._compiled_shapes:
+            return
+        self._compiled_shapes.add(key)
+        lbl = kind + "%dx%d" % tuple(shape)
+        C_COMPILES.labels(lbl).inc()
+        C_COMPILE_S.labels(lbl).inc(dt)
+
+    def _record_probe_stats(self, xin) -> None:
+        """Sampled ops/diagnostics.ubodt_probe_stats over an already-packed
+        device batch -> probe-outcome counters.  Any failure disables the
+        sampler (diagnostic only; e.g. the gp-sharded table needs the
+        shard_map path the plain probe program does not speak)."""
+        try:
+            if self._jit_probe is None:
+                import functools
+
+                import jax
+
+                from ..ops.diagnostics import ubodt_probe_stats
+
+                self._jit_probe = jax.jit(
+                    functools.partial(
+                        ubodt_probe_stats, delta=float(self.cfg.ubodt_delta)),
+                    static_argnums=(4,))
+            stats = np.asarray(self._jit_probe(
+                self._dg, self._du, xin, self._params, self.cfg.beam_k))
+            for i, outcome in enumerate(
+                    ("pairs", "miss", "costly_miss", "beyond_delta")):
+                C_PROBES.labels(outcome).inc(int(stats[i]))
+        except Exception:  # noqa: BLE001 - never fail a dispatch over a sample
+            log.exception("ubodt probe sampling failed; disabling")
+            self._probe_every = 0
 
     _host_copy_ok = True  # class-wide: disabled after the first failure
 
@@ -518,6 +600,10 @@ class SegmentMatcher:
             queue_thresh_mps=self.cfg.queue_speed_threshold_kph / 3.6,
             back_tol=2.0 * self.cfg.sigma_z + 5.0,
         )
+        in_trace = np.arange(T)[None, :] < n_pts[:, None]
+        C_TRACES.inc(B)
+        C_POINTS.inc(int(n_pts.sum()))
+        C_BREAKS.inc(int(np.count_nonzero((breaks[:B] != 0) & in_trace)))
         for row, i in enumerate(idxs):
             results[i] = {"segments": seg_lists[row]}
 
@@ -575,11 +661,14 @@ class SegmentMatcher:
             # trace cannot OOM the accelerator with pinned results.
             outs, host_parts = [], []
             for c in range(n_chunks):
+                t0 = _time.monotonic()
                 out, carry = self._jit_match_carry(
                     self._dg, self._du,
                     self._put_packed(xin[:, :, c * W : (c + 1) * W]),
                     self._params, self.cfg.beam_k, carry,
                 )
+                self._note_dispatch((B_pad, W), _time.monotonic() - t0,
+                                    kind="carry")
                 outs.append(out)  # device handle; fetch deferred
                 if len(outs) >= MAX_DEFERRED_CHUNKS:
                     host_parts.append(

@@ -1,0 +1,32 @@
+"""reporter_tpu.obs — pipeline-wide metrics and request tracing.
+
+``metrics``   dependency-free Counter/Gauge/Histogram registry with
+              Prometheus text exposition, JSON snapshots, and cross-process
+              snapshot merging (docs/observability.md lists every family)
+``trace``     per-request Span timing breakdowns (?debug=1)
+``profiler``  on-demand jax.profiler captures (GET /debug/profile)
+"""
+
+from .metrics import (  # noqa: F401
+    BATCH_FILL_BUCKETS,
+    LATENCY_BUCKETS_S,
+    REGISTRY,
+    Registry,
+    counter,
+    gauge,
+    histogram,
+    merge,
+)
+from .trace import Span  # noqa: F401
+
+__all__ = [
+    "BATCH_FILL_BUCKETS",
+    "LATENCY_BUCKETS_S",
+    "REGISTRY",
+    "Registry",
+    "Span",
+    "counter",
+    "gauge",
+    "histogram",
+    "merge",
+]

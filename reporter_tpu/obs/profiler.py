@@ -1,0 +1,46 @@
+"""On-demand ``jax.profiler`` capture (backs ``GET /debug/profile``).
+
+The capture is synchronous in the calling (handler) thread: the device
+keeps serving from the other threads while the trace records, which is
+exactly what a production capture wants to see.  One capture at a time —
+``jax.profiler.start_trace`` is process-global, so a second concurrent
+request gets ``ProfilerBusy`` (HTTP 409) instead of corrupting the first.
+jax is imported lazily: the obs package stays importable (and the metrics
+registry usable) in processes that never touch the device.
+"""
+
+from __future__ import annotations
+
+import tempfile
+import threading
+import time
+
+MAX_SECONDS = 60.0
+MIN_SECONDS = 0.05
+
+_capture_lock = threading.Lock()
+
+
+class ProfilerBusy(RuntimeError):
+    """A capture is already in flight."""
+
+
+def capture(seconds: float, out_dir: str = None) -> "tuple[str, float]":
+    """Record a jax profiler trace for ~``seconds`` (clamped to
+    [MIN_SECONDS, MAX_SECONDS]).  Returns (trace_dir, seconds_recorded);
+    the dir holds a TensorBoard-loadable trace."""
+    seconds = min(max(float(seconds), MIN_SECONDS), MAX_SECONDS)
+    import jax
+
+    if not _capture_lock.acquire(blocking=False):
+        raise ProfilerBusy("a profiler capture is already running")
+    try:
+        d = out_dir or tempfile.mkdtemp(prefix="reporter_jax_trace_")
+        jax.profiler.start_trace(d)
+        try:
+            time.sleep(seconds)
+        finally:
+            jax.profiler.stop_trace()
+        return d, seconds
+    finally:
+        _capture_lock.release()

@@ -1,0 +1,40 @@
+"""Per-request spans: a lightweight timing breakdown, not a tracing stack.
+
+A ``Span`` is created at /report ingestion (only when the client opts in
+with ``?debug=1``), threaded through the MicroBatcher's submit queue, and
+stamped at each pipeline stage: queue wait, device step (device wait +
+host association, fused in MicroBatcher's finisher), report rendering.
+The breakdown rides back on the response under a ``"debug"`` key, so a
+slow request can be attributed to a stage from the client side — no
+server-side correlation needed.
+"""
+
+from __future__ import annotations
+
+import time
+import uuid
+
+
+class Span:
+    __slots__ = ("name", "span_id", "t0", "timings", "meta")
+
+    def __init__(self, name: str = ""):
+        self.name = name
+        self.span_id = uuid.uuid4().hex[:16]
+        self.t0 = time.monotonic()
+        self.timings: dict = {}
+        self.meta: dict = {}
+
+    def mark(self, key: str, seconds: float) -> None:
+        self.timings[key] = round(float(seconds), 6)
+
+    def finish(self) -> None:
+        self.timings["total_s"] = round(time.monotonic() - self.t0, 6)
+
+    def breakdown(self) -> dict:
+        out = {"span_id": self.span_id}
+        if self.name:
+            out["name"] = self.name
+        out.update(self.meta)
+        out["timings"] = dict(self.timings)
+        return out

@@ -148,7 +148,6 @@ def main(argv):
         httpd.serve_forever()
         if service.batcher is None:
             # serve loop ended with no engine: the build failed
-            httpd.server_close()
             return 1
     except KeyboardInterrupt:
         logging.info("shutting down (signal)")
@@ -161,6 +160,10 @@ def main(argv):
         # the container's SIGKILL to take)
         stop_warm.set()
         warm_thread.join(timeout=120.0)
+    finally:
+        # EVERY exit path releases the listening socket — an external
+        # httpd.shutdown() used to fall through to `return 0` with the
+        # socket still open (ADVICE r05)
         httpd.server_close()
     return 0
 

@@ -31,18 +31,49 @@ import logging
 import os
 import queue
 import threading
+import time as _time
 from concurrent.futures import Future
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import List, Optional, Tuple
 from urllib.parse import parse_qs, urlsplit
 
 from ..matching import MatcherConfig, SegmentMatcher
+from ..obs import metrics as obs
+from ..obs.trace import Span
 from ..report import report as report_fn
 from ..tiles.network import RoadNetwork, grid_city
 
 log = logging.getLogger(__name__)
 
-ACTIONS = {"report", "trace_attributes_batch", "health"}
+ACTIONS = {"report", "trace_attributes_batch", "health",
+           "metrics", "statusz", "profile"}
+
+# metric families (docs/observability.md): the batch-fill/wait tradeoff and
+# the device-step tail are THE operating signals of a batched-accelerator
+# service — aggregate throughput alone cannot show a queue-wait regression
+M_QUEUE_WAIT = obs.histogram(
+    "reporter_microbatch_queue_wait_seconds",
+    "Per-trace wait from submit to micro-batch formation")
+M_BATCH_FILL = obs.histogram(
+    "reporter_microbatch_batch_fill",
+    "Traces per dispatched device micro-batch",
+    buckets=obs.BATCH_FILL_BUCKETS)
+M_DEVICE_STEP = obs.histogram(
+    "reporter_microbatch_device_step_seconds",
+    "Per-batch finish() wall: device wait + host segment association")
+G_INFLIGHT = obs.gauge(
+    "reporter_microbatch_inflight",
+    "Micro-batches dispatched to the device and not yet finished")
+G_QDEPTH = obs.gauge(
+    "reporter_microbatch_queue_depth",
+    "Submit-queue depth sampled at each batch formation")
+C_BATCHES = obs.counter(
+    "reporter_microbatch_batches_total",
+    "Device micro-batches dispatched")
+C_REQUESTS = obs.counter(
+    "reporter_requests_total",
+    "Requests by endpoint and outcome (ok / invalid / error)",
+    ("endpoint", "outcome"))
 
 
 class MicroBatcher:
@@ -75,7 +106,7 @@ class MicroBatcher:
     """
 
     def __init__(self, matcher: SegmentMatcher, max_batch: int = 64, max_wait_ms: float = 10.0,
-                 max_inflight: Optional[int] = None):
+                 max_inflight: Optional[int] = None, instrument: bool = True):
         if max_inflight is None:
             # 4 = measured v5e optimum (hides every dispatch sync quantum
             # and all host association under device compute); when the
@@ -90,23 +121,32 @@ class MicroBatcher:
 
                 plat = jax.devices()[0].platform
             max_inflight = 4 if plat != "cpu" else 2
+        # maxsize<=0 means UNBOUNDED to queue.Queue — a configured 0 would
+        # silently invert the backpressure bound on device-pinned memory
+        # (ADVICE r05); clamp rather than reject so a sloppy config degrades
+        # to the strictest bound instead of refusing to boot
+        max_inflight = max(1, int(max_inflight))
         self.matcher = matcher
         self.max_batch = max_batch
         self.max_wait = max_wait_ms / 1000.0
-        self._q: "queue.Queue[Tuple[dict, Future]]" = queue.Queue()
+        # metrics off only for A/B overhead measurement (tests); spans
+        # always flow — they exist per-request and only when the client
+        # opted in with ?debug=1
+        self._obs = bool(instrument)
+        self._q: "queue.Queue[tuple]" = queue.Queue()
         self._finish_q: "queue.Queue[tuple]" = queue.Queue(maxsize=max_inflight)
         self._thread = threading.Thread(target=self._worker, daemon=True)
         self._thread.start()
         self._finisher = threading.Thread(target=self._finish_worker, daemon=True)
         self._finisher.start()
 
-    def submit(self, trace: dict) -> Future:
+    def submit(self, trace: dict, span: Optional[Span] = None) -> Future:
         f: Future = Future()
-        self._q.put((trace, f))
+        self._q.put((trace, f, _time.monotonic(), span))
         return f
 
-    def match(self, trace: dict) -> dict:
-        return self.submit(trace).result()
+    def match(self, trace: dict, span: Optional[Span] = None) -> dict:
+        return self.submit(trace, span).result()
 
     def match_many(self, traces: List[dict]) -> List[dict]:
         futures = [self.submit(t) for t in traces]
@@ -114,16 +154,15 @@ class MicroBatcher:
 
     @staticmethod
     def _fail_batch(batch, e: Exception) -> None:
-        for _, f in batch:
+        for entry in batch:
+            f = entry[1]
             if f.set_running_or_notify_cancel():
                 f.set_exception(e)
 
     def _worker(self):
-        import time as _time
-
         while True:
-            trace, fut = self._q.get()
-            batch = [(trace, fut)]
+            entry = self._q.get()
+            batch = [entry]
             # opportunistically fill the batch within one absolute window so
             # the first request's extra latency is bounded by max_wait
             deadline = _time.monotonic() + self.max_wait
@@ -135,26 +174,55 @@ class MicroBatcher:
                     batch.append(self._q.get(timeout=remaining))
                 except queue.Empty:
                     break
+            now = _time.monotonic()
+            if self._obs:
+                G_QDEPTH.set(self._q.qsize())
+                M_BATCH_FILL.observe(len(batch))
+                C_BATCHES.inc()
+                for _t, _f, t_enq, _sp in batch:
+                    M_QUEUE_WAIT.observe(now - t_enq)
+            for _t, _f, t_enq, sp in batch:
+                if sp is not None:
+                    sp.mark("queue_wait_s", now - t_enq)
+                    sp.meta["batch_size"] = len(batch)
             try:
-                finish = self.matcher.match_many_async([t for t, _ in batch])
+                t_d0 = _time.monotonic()
+                finish = self.matcher.match_many_async([e[0] for e in batch])
+                dispatch_s = _time.monotonic() - t_d0
+                for _t, _f, _te, sp in batch:
+                    if sp is not None:
+                        # dispatch is async EXCEPT when a shape compiles:
+                        # this mark is where a cold-start stall shows up
+                        sp.mark("dispatch_s", dispatch_s)
             except Exception as e:
                 log.exception("batch dispatch failed")
                 self._fail_batch(batch, e)
                 continue
+            if self._obs:
+                G_INFLIGHT.inc()
             self._finish_q.put((batch, finish))  # blocks when finisher lags
 
     def _finish_worker(self):
         while True:
             batch, finish = self._finish_q.get()
             try:
+                t0 = _time.monotonic()
                 results = finish()
-                for (t, f), r in zip(batch, results):
+                step_s = _time.monotonic() - t0
+                if self._obs:
+                    M_DEVICE_STEP.observe(step_s)
+                for (t, f, _te, sp), r in zip(batch, results):
+                    if sp is not None:
+                        sp.mark("device_step_s", step_s)
                     if not f.set_running_or_notify_cancel():
                         continue
                     f.set_result(r)
             except Exception as e:  # resolve everything with the error
                 log.exception("batch match failed")
                 self._fail_batch(batch, e)
+            finally:
+                if self._obs:
+                    G_INFLIGHT.dec()
 
 
 class ReporterService:
@@ -183,8 +251,6 @@ class ReporterService:
         self.threshold_sec = None
         if matcher is not None:
             self.attach_matcher(matcher)
-        import time as _time
-
         self._t_boot = _time.time()
         self._counter_lock = threading.Lock()
         self._n_requests = 0
@@ -230,22 +296,31 @@ class ReporterService:
             return "match_options must include transition_levels array", None, None
         return None, rl, tl
 
-    def handle_report(self, trace: dict) -> Tuple[int, dict]:
+    def handle_report(self, trace: dict, debug: bool = False) -> Tuple[int, dict]:
         batcher = self.batcher
         if batcher is None:
             return 503, {"error": "service initialising"}
         err, rl, tl = self.validate(trace)
         if err:
+            C_REQUESTS.labels("report", "invalid").inc()
             return 400, {"error": err}
+        span = Span("report") if debug else None
         try:
-            match = batcher.match(trace)
+            match = batcher.match(trace, span=span)
+            t_rep = _time.monotonic()
             data = report_fn(match, trace, self.threshold_sec, rl, tl,
                              mode=trace.get("match_options", {}).get("mode", "auto"))
+            if span is not None:
+                span.mark("report_fn_s", _time.monotonic() - t_rep)
+                span.finish()
+                data["debug"] = span.breakdown()
             self._count(ok=True)
+            C_REQUESTS.labels("report", "ok").inc()
             return 200, data
         except Exception as e:
             log.exception("match failed")
             self._count(ok=False)
+            C_REQUESTS.labels("report", "error").inc()
             return 500, {"error": str(e)}
 
     def _count(self, ok: bool) -> None:
@@ -257,8 +332,6 @@ class ReporterService:
     def handle_health(self) -> Tuple[int, dict]:
         """Liveness/ops snapshot (additive: the reference exposes no such
         endpoint, so nothing on the wire contract changes)."""
-        import time as _time
-
         m = self.matcher
         return 200, {
             "status": "ok",
@@ -289,6 +362,7 @@ class ReporterService:
         for i, trace in enumerate(traces):
             err, rl, tl = self.validate(trace)
             if err:
+                C_REQUESTS.labels("trace_attributes_batch", "invalid").inc()
                 return 400, {"error": "trace %d: %s" % (i, err)}
             validated.append((trace, rl, tl))
         try:
@@ -299,11 +373,49 @@ class ReporterService:
                 for m, (t, rl, tl) in zip(matches, validated)
             ]
             self._count(ok=True)
+            C_REQUESTS.labels("trace_attributes_batch", "ok").inc()
             return 200, {"results": results}
         except Exception as e:
             log.exception("batch failed")
             self._count(ok=False)
+            C_REQUESTS.labels("trace_attributes_batch", "error").inc()
             return 500, {"error": str(e)}
+
+    def handle_statusz(self) -> Tuple[int, dict]:
+        """JSON ops snapshot: uptime + config + bucket tables + every metric
+        family (the dict form of /metrics, for humans and scripts)."""
+        m = self.matcher
+        return 200, {
+            "uptime_s": round(_time.time() - self._t_boot, 1),
+            "warming": bool(getattr(self, "warming", False)) or m is None,
+            "backend": m.backend if m else None,
+            "threshold_sec": self.threshold_sec,
+            "batch": dict(self._batch_params),
+            "latency_buckets_s": list(obs.LATENCY_BUCKETS_S),
+            "batch_fill_buckets": list(obs.BATCH_FILL_BUCKETS),
+            "metrics": obs.REGISTRY.snapshot(),
+        }
+
+    def handle_profile(self, query: dict) -> Tuple[int, dict]:
+        """GET /debug/profile?seconds=N — record a jax.profiler trace to a
+        temp dir and return its path (TensorBoard-loadable)."""
+        from ..obs import profiler
+
+        try:
+            seconds = float(query.get("seconds", ["2"])[0])
+        except (TypeError, ValueError):
+            return 400, {"error": "seconds must be a number"}
+        m = self.matcher
+        if m is not None and m.backend != "jax":
+            return 501, {"error": "profiling needs the jax backend (got %r)" % m.backend}
+        try:
+            trace_dir, recorded = profiler.capture(seconds)
+        except profiler.ProfilerBusy as e:
+            return 409, {"error": str(e)}
+        except Exception as e:  # noqa: BLE001 - surfaced to the caller
+            log.exception("profiler capture failed")
+            return 500, {"error": str(e)}
+        return 200, {"trace_dir": trace_dir, "seconds": recorded}
 
     # -- server ------------------------------------------------------------
 
@@ -337,6 +449,16 @@ class ReporterService:
                 self.send_response(code)
                 self.send_header("Access-Control-Allow-Origin", "*")
                 self.send_header("Content-Type", "application/json;charset=utf-8")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _answer_text(self, code: int, text: str):
+                """Prometheus exposition is text, not JSON."""
+                body = text.encode("utf-8")
+                self.send_response(code)
+                self.send_header(
+                    "Content-Type", "text/plain; version=0.0.4; charset=utf-8")
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
@@ -375,6 +497,7 @@ class ReporterService:
                 try:
                     split = urlsplit(self.path)
                     action = split.path.split("/")[-1]
+                    query = parse_qs(split.query)
                     if action not in ACTIONS:
                         self._drain_body(post)
                         return self._answer(
@@ -383,6 +506,15 @@ class ReporterService:
                     if action == "health":  # no payload required
                         self._drain_body(post)
                         return self._answer(*service.handle_health())
+                    if action == "metrics":
+                        self._drain_body(post)
+                        return self._answer_text(200, obs.REGISTRY.render())
+                    if action == "statusz":
+                        self._drain_body(post)
+                        return self._answer(*service.handle_statusz())
+                    if action == "profile":  # GET /debug/profile?seconds=N
+                        self._drain_body(post)
+                        return self._answer(*service.handle_profile(query))
                     if post:
                         n = self._content_length()
                         if n is None:  # malformed header: framing unknown
@@ -390,10 +522,9 @@ class ReporterService:
                                 400, {"error": "invalid Content-Length"})
                         payload = json.loads(self.rfile.read(n).decode("utf-8"))
                     else:
-                        params = parse_qs(split.query)
-                        if "json" not in params:
+                        if "json" not in query:
                             return self._answer(400, {"error": "No json provided"})
-                        payload = json.loads(params["json"][0])
+                        payload = json.loads(query["json"][0])
                 except OSError as e:
                     # the BODY read failed (idle/stall timeout, reset): the
                     # stream position is unknown, so a keep-alive follow-up
@@ -414,7 +545,12 @@ class ReporterService:
                     if not isinstance(payload, dict):
                         code, out = 400, {"error": "request body must be a json object"}
                     elif action == "report":
-                        code, out = service.handle_report(payload)
+                        # ?debug=1 opts into the span timing breakdown; the
+                        # kwarg is only passed when set so embedders that
+                        # wrap handle_report(trace) keep working
+                        debug = query.get("debug", ["0"])[0] not in ("", "0", "false")
+                        code, out = (service.handle_report(payload, debug=True)
+                                     if debug else service.handle_report(payload))
                     else:
                         code, out = service.handle_batch(payload)
                 except Exception as e:  # belt-and-braces: never drop the socket

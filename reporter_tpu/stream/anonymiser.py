@@ -26,9 +26,17 @@ import uuid as uuidlib
 from typing import Callable, Dict, List, Optional, Tuple
 
 from ..anonymise.storage import make_store
+from ..obs import metrics as obs
 from .segment import Segment
 
 log = logging.getLogger(__name__)
+
+C_TILES = obs.counter(
+    "reporter_stream_tiles_flushed_total",
+    "Anonymised CSV tiles shipped to the store")
+C_CULLED = obs.counter(
+    "reporter_stream_segments_culled_total",
+    "Segment observations dropped by the privacy cull")
 
 SLICE_SIZE = 20000
 
@@ -129,6 +137,7 @@ class AnonymisingProcessor:
                     log.warning("missing quantised tile slice %s.%d", tile, i)
             segments.sort(key=Segment.sort_key)
             kept = cull(segments, self.privacy)
+            C_CULLED.inc(len(segments) - len(kept))
             log.info(
                 "anonymised quantised tile %s from %d to %d segments",
                 tile, len(segments), len(kept),
@@ -157,5 +166,6 @@ class AnonymisingProcessor:
             log.info("writing tile to %s with %d segments", key, len(segments))
             self.store.put(key, body)
             self.tiles_flushed += 1
+            C_TILES.inc()
         except Exception as e:
             log.error("couldn't flush tile %s: %s", key, e)

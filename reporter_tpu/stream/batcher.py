@@ -23,11 +23,22 @@ from __future__ import annotations
 import logging
 from typing import Callable, Dict, List, Optional
 
+from ..obs import metrics as obs
 from .batch import Batch
 from .point import Point
 from .segment import Segment
 
 log = logging.getLogger(__name__)
+
+C_FLUSHES = obs.counter(
+    "reporter_stream_batches_emitted_total",
+    "Pooled micro-batch flushes sent to the matcher")
+C_FORWARDED = obs.counter(
+    "reporter_stream_segments_forwarded_total",
+    "Valid segment pairs forwarded to the anonymiser")
+C_EVICTED = obs.counter(
+    "reporter_stream_sessions_evicted_total",
+    "Stale vehicle sessions evicted on punctuate")
 
 REPORT_TIME = 60  # seconds
 REPORT_COUNT = 10  # points
@@ -110,6 +121,7 @@ class BatchingProcessor:
                 keys.append(k)
             else:
                 log.debug("evicting %s (too little data)", k)
+        C_EVICTED.inc(len(stale))
         for resp in self.client.report_many(requests):
             self._forward(resp)
 
@@ -130,6 +142,7 @@ class BatchingProcessor:
             self.store[k].request(k, self.mode, self.report_levels, self.transition_levels)
             for k in keys
         ]
+        C_FLUSHES.inc()
         responses = self.client.report_many(requests)
         for k, resp in zip(keys, responses):
             batch = self.store[k]
@@ -171,6 +184,7 @@ class BatchingProcessor:
             else:
                 log.warning("got back invalid segment: %r", seg)
         self.reported_pairs += n
+        C_FORWARDED.inc(n)
         return n
 
     # -- partition state hand-off -----------------------------------------

@@ -20,12 +20,22 @@ import base64
 import json
 import logging
 import os
+import time as _time
 from typing import Optional
 
+from ..obs import metrics as obs
 from .batch import Batch
 from .segment import pack_list, unpack_list
 
 log = logging.getLogger(__name__)
+
+C_CHECKPOINTS = obs.counter(
+    "reporter_stream_checkpoints_total",
+    "Successful stream-state snapshots to disk")
+G_CHECKPOINT_TS = obs.gauge(
+    "reporter_stream_checkpoint_unix_seconds",
+    "Wall clock of the last successful snapshot; checkpoint lag at scrape "
+    "time is time() - this")
 
 VERSION = 1
 
@@ -167,6 +177,8 @@ class Checkpointer:
             return False
         try:
             save_file(self.pipeline, self.path)
+            C_CHECKPOINTS.inc()
+            G_CHECKPOINT_TS.set(_time.time())
             return True
         except Exception:
             # not just OSError: serialisation of corrupt in-flight state

@@ -19,11 +19,19 @@ from __future__ import annotations
 import logging
 from typing import Optional
 
+from ..obs import metrics as obs
 from .anonymiser import AnonymisingProcessor
 from .batcher import BatchingProcessor
 from .formatter import Formatter
 
 log = logging.getLogger(__name__)
+
+C_FORMATTED = obs.counter(
+    "reporter_stream_points_formatted_total",
+    "Raw records successfully formatted into points")
+C_DROPPED = obs.counter(
+    "reporter_stream_points_dropped_total",
+    "Raw records dropped as unparseable")
 
 
 class StreamPipeline:
@@ -51,9 +59,11 @@ class StreamPipeline:
             uuid, point = self.formatter.format(raw)
         except Exception as e:
             self.dropped += 1
+            C_DROPPED.inc()
             log.debug("unparseable record %r: %s", raw, e)
             return
         self.formatted += 1
+        C_FORMATTED.inc()
         if self.formatted % self.log_every == 0:
             log.info("formatted %d messages", self.formatted)
         self.batcher.process(uuid, point, timestamp_ms, partition=partition)

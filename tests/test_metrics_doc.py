@@ -1,0 +1,23 @@
+"""Tier-1 wiring for tools/check_metrics.py: every registered metric
+family is documented in docs/observability.md, and vice versa."""
+
+import importlib.util
+import os
+
+
+def _load_checker():
+    path = os.path.join(os.path.dirname(__file__), "..", "tools", "check_metrics.py")
+    spec = importlib.util.spec_from_file_location("check_metrics", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_metrics_documented():
+    chk = _load_checker()
+    code = chk.registered_names()
+    doc = chk.documented_names()
+    assert code, "no metric registrations found — the AST scan broke"
+    assert code - doc == set(), "undocumented metrics: %r" % sorted(code - doc)
+    assert doc - code == set(), "ghost doc entries: %r" % sorted(doc - code)
+    assert chk.main() == 0

@@ -1,0 +1,81 @@
+#!/usr/bin/env python
+"""Static doc/code sync check for metric families.
+
+Every metric name registered in ``reporter_tpu/`` (a string-literal first
+argument to a ``counter``/``gauge``/``histogram`` call with the
+``reporter_`` prefix) must appear in docs/observability.md's family
+tables, and every name documented there must be registered in code —
+dashboards built from the doc must never dereference a ghost, and code
+must never grow an undocumented family.  Wired as a tier-1 test
+(tests/test_metrics_doc.py); also runnable standalone:
+
+    python tools/check_metrics.py
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG_DIR = os.path.join(REPO, "reporter_tpu")
+DOC = os.path.join(REPO, "docs", "observability.md")
+
+_REGISTER_FNS = {"counter", "gauge", "histogram"}
+# doc table rows only: "| `reporter_...` | type | ..." — prose may mention
+# derived names (_bucket/_sum) without tripping the check
+_DOC_ROW_RE = re.compile(r"^\|\s*`(reporter_[a-z0-9_]+)`", re.M)
+
+
+def registered_names(pkg_dir: str = PKG_DIR) -> "set[str]":
+    names = set()
+    for root, _dirs, files in os.walk(pkg_dir):
+        for fn in files:
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(root, fn)
+            with open(path) as f:
+                tree = ast.parse(f.read(), filename=path)
+            for node in ast.walk(tree):
+                if not isinstance(node, ast.Call) or not node.args:
+                    continue
+                func = node.func
+                called = (
+                    func.attr if isinstance(func, ast.Attribute)
+                    else getattr(func, "id", None)
+                )
+                if called not in _REGISTER_FNS:
+                    continue
+                a0 = node.args[0]
+                if (isinstance(a0, ast.Constant) and isinstance(a0.value, str)
+                        and a0.value.startswith("reporter_")):
+                    names.add(a0.value)
+    return names
+
+
+def documented_names(doc_path: str = DOC) -> "set[str]":
+    with open(doc_path) as f:
+        return set(_DOC_ROW_RE.findall(f.read()))
+
+
+def main() -> int:
+    code = registered_names()
+    doc = documented_names()
+    rc = 0
+    for name in sorted(code - doc):
+        print("UNDOCUMENTED: %s (registered in code, missing from "
+              "docs/observability.md)" % name)
+        rc = 1
+    for name in sorted(doc - code):
+        print("GHOST: %s (documented but registered nowhere under "
+              "reporter_tpu/)" % name)
+        rc = 1
+    if rc == 0:
+        print("ok: %d metric families, code and docs agree" % len(code))
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())
