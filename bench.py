@@ -44,11 +44,14 @@ slightly from the fleet's); "vs_baseline_traces" is the raw traces/s ratio;
 """
 
 import json
+import logging
 import os
 import subprocess
 import sys
 import tempfile
 import time
+
+_log = logging.getLogger("bench")
 
 # Total accelerator budget: the orchestrator polls the relay ports (a
 # connect() costs microseconds) and retries device attempts for this long
@@ -63,6 +66,16 @@ ATTEMPT_WAIT_DEFAULT = 600.0
 def _stderr(msg: str) -> None:
     sys.stderr.write("bench: %s\n" % msg)
     sys.stderr.flush()
+
+
+def _event(name: str, **fields) -> None:
+    """Structured driver event (relay probes, worker heartbeats, kill
+    decisions) on stderr — stdout stays the one-JSON-line contract.  With
+    REPORTER_LOG_FORMAT=json a dead-relay window (BENCH_r05: rc 124, relay
+    down the whole run) is attributable from the log alone."""
+    from reporter_tpu.obs import log as obs_log
+
+    obs_log.event(_log, name, **fields)
 
 
 def _relay_ports_open():
@@ -751,14 +764,18 @@ def _finish_device(proc, timeout, status_file):
     last_st = None
     dead_since = None
     frozen_since = None
+    last_beat = 0.0
     armed = False  # a non-cpu platform has been observed in the status file
     while True:
         if proc.poll() is not None:
             return _result(kill=False)
         if time.time() - t0 > timeout:
             _stderr("device worker exceeded run budget (%.0fs); killing" % timeout)
+            _event("worker_kill", reason="run_budget",
+                   timeout_s=round(timeout, 1))
             return _result(kill=True)
         st = _read_status(status_file)
+        ports = _relay_ports_open()
         if st:
             on_accel = st.get("platform") not in (None, "cpu")
             armed = armed or on_accel
@@ -769,6 +786,18 @@ def _finish_device(proc, timeout, status_file):
             # (ADVICE r05)
             on_accel = armed
         progressed = not on_accel or (bool(st) and st != last_st)
+        # heartbeat: every status change, else once a minute — the log
+        # alone must show what the worker was doing when a window died
+        now = time.time()
+        if (progressed and st != last_st) or now - last_beat > 60.0:
+            _event("worker_heartbeat",
+                   phase=st.get("phase") if st else None,
+                   step=st.get("step") if st else None,
+                   platform=st.get("platform") if st else None,
+                   status_age_s=(round(now - st["t"], 1)
+                                 if st and "t" in st else None),
+                   relay_open=bool(ports), progressed=progressed)
+            last_beat = now
         # ports-open wedge: status frozen long past any legitimate compile
         # wave kills the worker regardless of relay state
         if progressed:
@@ -778,9 +807,12 @@ def _finish_device(proc, timeout, status_file):
         elif time.time() - frozen_since > STATUS_FROZEN_KILL_S:
             _stderr("worker status frozen %.0fs (relay ports %s); killing "
                     "device worker" % (time.time() - frozen_since,
-                                       _relay_ports_open() or "closed"))
+                                       ports or "closed"))
+            _event("worker_kill", reason="status_frozen",
+                   frozen_s=round(time.time() - frozen_since, 1),
+                   relay_open=bool(ports))
             return _result(kill=True)
-        if progressed or _relay_ports_open():
+        if progressed or ports:
             dead_since = None
             last_st = st
         elif dead_since is None:
@@ -788,6 +820,8 @@ def _finish_device(proc, timeout, status_file):
         elif time.time() - dead_since > RELAY_DEAD_KILL_S:
             _stderr("relay down %.0fs with no worker progress; killing device "
                     "worker" % (time.time() - dead_since))
+            _event("worker_kill", reason="relay_dead",
+                   down_s=round(time.time() - dead_since, 1))
             return _result(kill=True)
         time.sleep(10.0)
 
@@ -873,6 +907,11 @@ def _monitor_device(proc, status_file, wait_s, grace_s, attempts_log, gate=None)
 
 
 def main() -> int:
+    # the shared structured-log switch; handlers write to stderr, so the
+    # one-JSON-line stdout contract is untouched in every role
+    from reporter_tpu.obs import log as obs_log
+
+    obs_log.configure()
     role = os.environ.get("BENCH_ROLE", "")
     if role == "device":
         return run_device()
@@ -972,13 +1011,22 @@ def main() -> int:
     attempt_n = 0
     cooldown_until = 0.0
     last_log = 0.0
+    last_ports = None  # sentinel: first probe always logs an event
+    last_probe_ev = 0.0
     while not want_cpu and tpu_json is None and time.time() < deadline:
         gate.poll()
         ports = _relay_ports_open()
+        # relay-probe event on every state flip + a 5-min heartbeat: the
+        # log alone must show when the relay went down and came back
+        if ports != last_ports or time.time() - last_probe_ev > 300:
+            _event("relay_probe", open=bool(ports), ports=ports or [],
+                   budget_left_s=round(deadline - time.time(), 1))
+            last_ports, last_probe_ev = ports, time.time()
         if ports and time.time() >= cooldown_until:
             attempt_n += 1
             _stderr("relay %s listening; accelerator attempt %d (%.0fs of "
                     "budget left)" % (ports, attempt_n, deadline - time.time()))
+            _event("accel_attempt", n=attempt_n, ports=ports)
             dj = _attempt_accel("axon%d" % attempt_n)
             if dj and dj.get("platform") not in (None, "cpu"):
                 tpu_json = dj

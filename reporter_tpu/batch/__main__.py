@@ -11,7 +11,6 @@ Resume: --trace-dir skips gathering, --match-dir skips matching
 """
 
 import argparse
-import logging
 import multiprocessing
 import sys
 
@@ -70,9 +69,13 @@ def main(argv=None) -> int:
                          "(docs/observability.md)")
     args = ap.parse_args(argv)
 
-    logging.basicConfig(
-        level=logging.INFO, format="%(asctime)s %(levelname)s %(message)s"
-    )
+    # the shared log switch (REPORTER_LOG_FORMAT=json|text,
+    # REPORTER_LOG_LEVEL) + flight-recorder dump on SIGTERM/fatal
+    from ..obs import flight as obs_flight
+    from ..obs import log as obs_log
+
+    obs_log.configure()
+    obs_flight.install_shutdown_dump()
 
     from ..utils.jaxenv import ensure_platform
 

@@ -45,7 +45,9 @@ import uuid as uuidlib
 from typing import Callable, Iterable, List, Optional, Sequence, Tuple
 
 from ..anonymise.storage import make_store
+from ..obs import flight as obs_flight
 from ..obs import metrics as obs
+from ..obs import trace as obs_trace
 from ..anonymise.tiles import (
     CSV_HEADER,
     SegmentObservation,
@@ -400,14 +402,28 @@ def make_matches(
         matched = 0
         for lo in range(0, len(requests), microbatch):
             chunk = requests[lo : lo + microbatch]
+            # one trace per device micro-batch: the span binds the context
+            # (so matcher compile events carry its id), lands in the flight
+            # recorder, and failed chunks are always retained for
+            # post-mortem — the batch-path equivalent of a served request
+            span = obs_trace.Span("batch_microbatch")
+            span.meta["file"] = os.path.basename(file_name)
+            span.meta["n_traces"] = len(chunk)
             try:
-                matches = matcher.match_many(chunk)
+                with obs_trace.bind(span):
+                    t0 = time.monotonic()
+                    matches = matcher.match_many(chunk)
+                    span.mark("match_s", time.monotonic() - t0)
             except (KeyboardInterrupt, SystemExit):
                 raise
             except Exception as e:
                 C_REPORT_FAIL.inc(len(chunk))
+                span.fail(e)
+                obs_flight.record(span)
                 log.error("match micro-batch failed in %s: %s", file_name, e)
                 continue
+            t0 = time.monotonic()
+            n_fail = 0
             for request, match in zip(chunk, matches):
                 try:
                     rep = report_fn(
@@ -417,6 +433,7 @@ def make_matches(
                     raise
                 except Exception:
                     C_REPORT_FAIL.inc()
+                    n_fail += 1
                     log.error(
                         "failed to report trace with uuid %s from file %s",
                         request["uuid"], file_name,
@@ -427,6 +444,11 @@ def make_matches(
                 _bucket_reports(
                     rep, request, quantisation, source, mode, tiles, file_name
                 )
+            span.mark("report_fn_s", time.monotonic() - t0)
+            if n_fail:
+                span.fail("%d/%d windows failed report()" % (n_fail, len(chunk)),
+                          status="partial")
+            obs_flight.record(span)
 
         for tile_file, rows in tiles.items():
             path = os.path.join(dest_dir, tile_file)

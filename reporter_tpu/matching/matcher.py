@@ -23,6 +23,7 @@ from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
+from ..obs import log as obs_log
 from ..obs import metrics as obs
 from ..tiles.arrays import GraphArrays, build_graph_arrays
 from ..tiles.network import RoadNetwork
@@ -313,6 +314,10 @@ class SegmentMatcher:
         lbl = kind + "%dx%d" % tuple(shape)
         C_COMPILES.labels(lbl).inc()
         C_COMPILE_S.labels(lbl).inc(dt)
+        # structured compile event: the dispatch thread is bound to the
+        # batch's lead span (serve) or the micro-batch span (batch
+        # pipeline), so this stall is attributable to a real request id
+        obs_log.event(log, "compile_stall", shape=lbl, seconds=round(dt, 3))
 
     def _record_probe_stats(self, xin) -> None:
         """Sampled ops/diagnostics.ubodt_probe_stats over an already-packed

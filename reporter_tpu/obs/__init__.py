@@ -1,9 +1,15 @@
-"""reporter_tpu.obs — pipeline-wide metrics and request tracing.
+"""reporter_tpu.obs — pipeline-wide metrics, tracing, and logging.
 
 ``metrics``   dependency-free Counter/Gauge/Histogram registry with
-              Prometheus text exposition, JSON snapshots, and cross-process
-              snapshot merging (docs/observability.md lists every family)
-``trace``     per-request Span timing breakdowns (?debug=1)
+              Prometheus text exposition, JSON snapshots (incl. per-bucket
+              exemplars), and cross-process snapshot merging
+              (docs/observability.md lists every family)
+``trace``     always-on per-request trace context: trace_id + Span stage
+              timings, carried via contextvars end to end
+``flight``    bounded in-memory flight recorder with tail sampling
+              (GET /debug/traces; dumped on SIGTERM/fatal)
+``log``       structured one-line-JSON/text event logger; one
+              ``configure()`` shared by every entrypoint
 ``profiler``  on-demand jax.profiler captures (GET /debug/profile)
 """
 
@@ -17,7 +23,7 @@ from .metrics import (  # noqa: F401
     histogram,
     merge,
 )
-from .trace import Span  # noqa: F401
+from .trace import Span, bind, current_span, current_trace_id, new_trace_id  # noqa: F401
 
 __all__ = [
     "BATCH_FILL_BUCKETS",
@@ -25,8 +31,12 @@ __all__ = [
     "REGISTRY",
     "Registry",
     "Span",
+    "bind",
     "counter",
+    "current_span",
+    "current_trace_id",
     "gauge",
     "histogram",
     "merge",
+    "new_trace_id",
 ]

@@ -1,0 +1,173 @@
+"""Always-on flight recorder: a bounded in-memory ring of recent traces
+with tail sampling, for post-mortem attribution of individual requests.
+
+Every finished ``Span`` is offered via ``record()``.  Tail sampling
+decides retention AFTER the outcome is known:
+
+  - every errored span is kept (``span.status != "ok"``),
+  - every span slower than the slow threshold is kept,
+  - 1-in-N of the healthy rest is kept,
+  - everything else only increments a counter.
+
+Kept-by-right traces (errors + slow) and sampled traffic live in two
+separate rings so a flood of healthy requests can never evict the error
+you are trying to explain.  Both rings are bounded deques, so memory is
+bounded under any load.
+
+Read paths: ``GET /debug/traces?n=`` (serve/service.py), a summary block
+in ``/statusz``, and ``dump()`` — written to disk on SIGTERM/fatal via
+``utils/shutdown`` hooks (``install_shutdown_dump``) so a killed process
+leaves its last traces behind.  ``dump``/``snapshot`` read the rings
+without taking the writer lock: they may run from a signal handler that
+interrupted a ``record()`` holding it, and CPython deque iteration is
+safe against concurrent appends (worst case: one trace torn off an end).
+
+Env knobs (all read at recorder construction):
+  REPORTER_FLIGHT_CAPACITY      ring size per class (default 256)
+  REPORTER_FLIGHT_SLOW_MS       slow-trace threshold (default 250)
+  REPORTER_FLIGHT_SAMPLE_EVERY  keep 1-in-N healthy traces (default 10)
+  REPORTER_FLIGHT_DUMP          dump path ("" disables; default
+                                <tmpdir>/reporter_flight_<pid>.json)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+import threading
+from collections import deque
+from typing import List, Optional
+
+from . import metrics as obs
+from .trace import Span
+
+C_FLIGHT = obs.counter(
+    "reporter_flight_traces_total",
+    "Flight-recorder tail-sampling decisions "
+    "(error / slow / sampled / dropped)",
+    ("decision",))
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, str(default)))
+    except ValueError:
+        return default
+
+
+class FlightRecorder:
+    def __init__(self, capacity: Optional[int] = None,
+                 slow_ms: Optional[float] = None,
+                 sample_every: Optional[int] = None):
+        self.capacity = max(1, capacity if capacity is not None
+                            else _env_int("REPORTER_FLIGHT_CAPACITY", 256))
+        self.slow_ms = float(slow_ms if slow_ms is not None
+                             else _env_int("REPORTER_FLIGHT_SLOW_MS", 250))
+        self.sample_every = max(1, sample_every if sample_every is not None
+                                else _env_int("REPORTER_FLIGHT_SAMPLE_EVERY", 10))
+        # errors + slow in their own ring: sampled traffic cannot evict them
+        self._keep: "deque[dict]" = deque(maxlen=self.capacity)
+        self._sampled: "deque[dict]" = deque(maxlen=self.capacity)
+        self._lock = threading.Lock()
+        self._seen = 0
+
+    # -- write path --------------------------------------------------------
+
+    def record(self, span: Span) -> str:
+        """Offer a finished span; returns the sampling decision."""
+        if "total_s" not in span.timings:
+            span.finish()
+        if span.status != "ok":
+            decision = "error"
+        elif span.total_s * 1000.0 >= self.slow_ms:
+            decision = "slow"
+        else:
+            with self._lock:
+                self._seen += 1
+                keep = self._seen % self.sample_every == 0
+            decision = "sampled" if keep else "dropped"
+        if decision != "dropped":
+            entry = span.breakdown()
+            entry["status"] = span.status
+            if span.error:
+                entry["error"] = span.error
+            entry["retained"] = decision
+            entry["t_end"] = round(span.t0_unix + span.total_s, 3)
+            ring = self._sampled if decision == "sampled" else self._keep
+            with self._lock:
+                ring.append(entry)
+        C_FLIGHT.labels(decision).inc()
+        return decision
+
+    # -- read paths (lock-free: see module docstring) ----------------------
+
+    def snapshot(self, n: int = 50) -> List[dict]:
+        """Most recent retained traces, newest first, errors/slow included
+        ahead of sampled traffic when ``n`` forces a cut."""
+        keep = list(self._keep)
+        sampled = list(self._sampled)
+        merged = sorted(keep + sampled, key=lambda e: e.get("t_end", 0.0),
+                        reverse=True)
+        if len(merged) > n:
+            # never cut a kept-by-right trace in favour of a sampled one
+            kept_ids = {id(e) for e in keep}
+            merged.sort(key=lambda e: (id(e) not in kept_ids,
+                                       -e.get("t_end", 0.0)))
+            merged = merged[:n]
+            merged.sort(key=lambda e: e.get("t_end", 0.0), reverse=True)
+        return merged
+
+    def summary(self) -> dict:
+        return {
+            "capacity": self.capacity,
+            "slow_ms": self.slow_ms,
+            "sample_every": self.sample_every,
+            "retained_errors_slow": len(self._keep),
+            "retained_sampled": len(self._sampled),
+        }
+
+    def dump(self, path: Optional[str] = None) -> Optional[str]:
+        """Write retained traces to disk; returns the path, or None when
+        disabled (REPORTER_FLIGHT_DUMP="") or nothing was retained."""
+        if path is None:
+            path = os.environ.get(
+                "REPORTER_FLIGHT_DUMP",
+                os.path.join(tempfile.gettempdir(),
+                             "reporter_flight_%d.json" % os.getpid()))
+        if not path:
+            return None
+        traces = self.snapshot(2 * self.capacity)
+        if not traces:
+            return None
+        try:
+            with open(path, "w") as f:
+                json.dump({"summary": self.summary(), "traces": traces}, f,
+                          separators=(",", ":"))
+        except OSError:
+            return None
+        return path
+
+
+# the process-wide recorder: the service, the batch pipeline, and the
+# stream runtime all record into this one
+RECORDER = FlightRecorder()
+
+
+def record(span: Span) -> str:
+    return RECORDER.record(span)
+
+
+_dump_installed = False
+
+
+def install_shutdown_dump() -> None:
+    """Register the SIGTERM/fatal dump with utils.shutdown's hook list
+    (idempotent).  Entrypoints call this once at boot."""
+    global _dump_installed
+    if _dump_installed:
+        return
+    from ..utils.shutdown import on_shutdown
+
+    on_shutdown(lambda: RECORDER.dump())
+    _dump_installed = True

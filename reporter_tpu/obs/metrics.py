@@ -123,7 +123,13 @@ class Gauge:
 
 
 class Histogram:
-    """Fixed-bucket histogram (upper bounds; +Inf implicit)."""
+    """Fixed-bucket histogram (upper bounds; +Inf implicit).
+
+    ``observe`` optionally takes an exemplar (a trace id): per bucket, the
+    SLOWEST observation's id is kept, linking the histogram tail to a
+    flight-recorder trace.  Exemplars ride ``snapshot()`` (→ /statusz,
+    tools/trace_top.py) but not ``render()`` — the 0.0.4 text exposition
+    has no exemplar syntax."""
 
     kind = "histogram"
 
@@ -136,14 +142,19 @@ class Histogram:
         self._counts = [0] * (len(bounds) + 1)  # last slot = +Inf overflow
         self._sum = 0.0
         self._count = 0
+        self._exemplars: Dict[int, Tuple[float, str]] = {}
 
-    def observe(self, v: float) -> None:
+    def observe(self, v: float, exemplar: Optional[str] = None) -> None:
         v = float(v)
         i = bisect_left(self._bounds, v)
         with self._lock:
             self._counts[i] += 1
             self._sum += v
             self._count += 1
+            if exemplar is not None:
+                ex = self._exemplars.get(i)
+                if ex is None or v > ex[0]:
+                    self._exemplars[i] = (v, exemplar)
 
     @property
     def count(self) -> int:
@@ -155,22 +166,21 @@ class Histogram:
 
     def _sample(self):
         with self._lock:
-            return {
+            out = {
                 "buckets": list(self._bounds),
                 "counts": list(self._counts),
                 "sum": self._sum,
                 "count": self._count,
             }
+            if self._exemplars:
+                # [bucket_index, value, trace_id], JSON-safe and mergeable
+                out["exemplars"] = [
+                    [i, v, tid] for i, (v, tid) in sorted(self._exemplars.items())
+                ]
+            return out
 
     def _merge_sample(self, a, b):
-        if a["buckets"] != b["buckets"]:
-            raise ValueError("histogram bucket mismatch in merge")
-        return {
-            "buckets": a["buckets"],
-            "counts": [x + y for x, y in zip(a["counts"], b["counts"])],
-            "sum": a["sum"] + b["sum"],
-            "count": a["count"] + b["count"],
-        }
+        return _merge_hist_samples(a, b)
 
 
 class Family:
@@ -225,8 +235,8 @@ class Family:
     def set(self, v: float):
         self._default().set(v)
 
-    def observe(self, v: float):
-        self._default().observe(v)
+    def observe(self, v: float, exemplar: Optional[str] = None):
+        self._default().observe(v, exemplar)
 
     @property
     def value(self):
@@ -376,15 +386,28 @@ def _copy_sample(s):
 
 def _merge_sample(kind, a, b):
     if kind == "histogram":
-        if a["buckets"] != b["buckets"]:
-            raise ValueError("histogram bucket mismatch in merge")
-        return {
-            "buckets": list(a["buckets"]),
-            "counts": [x + y for x, y in zip(a["counts"], b["counts"])],
-            "sum": a["sum"] + b["sum"],
-            "count": a["count"] + b["count"],
-        }
+        return _merge_hist_samples(a, b)
     return a + b
+
+
+def _merge_hist_samples(a, b):
+    if a["buckets"] != b["buckets"]:
+        raise ValueError("histogram bucket mismatch in merge")
+    out = {
+        "buckets": list(a["buckets"]),
+        "counts": [x + y for x, y in zip(a["counts"], b["counts"])],
+        "sum": a["sum"] + b["sum"],
+        "count": a["count"] + b["count"],
+    }
+    # exemplars: keep the slowest observation per bucket across processes
+    ex: Dict[int, list] = {}
+    for src in (a.get("exemplars"), b.get("exemplars")):
+        for i, v, tid in src or ():
+            if i not in ex or v > ex[i][1]:
+                ex[i] = [i, v, tid]
+    if ex:
+        out["exemplars"] = [ex[i] for i in sorted(ex)]
+    return out
 
 
 # the process-wide default registry: instrumented modules register their

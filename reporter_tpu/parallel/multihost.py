@@ -178,11 +178,21 @@ def main(argv: Sequence[str] = None) -> int:
     ap.add_argument("--graph-devices", type=int, default=1,
                     help="shard the UBODT over a gp mesh axis of this size")
     args = ap.parse_args(argv)
+    import logging
+
+    from ..obs import log as obs_log
+
+    obs_log.configure()  # REPORTER_LOG_FORMAT / REPORTER_LOG_LEVEL
     out = run_dryrun(args.coordinator, args.processes, args.process_id,
                      rows=args.rows, cols=args.cols, T=args.t,
                      graph_devices=args.graph_devices)
     assert out["matched"] > 0, "multi-host dryrun matched nothing"
     assert out["hist_total"] > 0, "multi-host histogram reduction empty"
+    # structured event for the log stream; the bare stdout line below is a
+    # separate contract — every controller must print it BYTE-IDENTICAL
+    # (tests/test_multihost.py diffs it across processes), so it carries no
+    # timestamps or per-process fields
+    obs_log.event(logging.getLogger(__name__), "multihost_dryrun_ok", **out)
     print("multihost dryrun ok: %(devices)d devices (%(local_devices)d local, "
           "gp %(graph_devices)d), batch %(batch)d, %(matched)d matched "
           "points, hist_total %(hist_total).1f" % out)

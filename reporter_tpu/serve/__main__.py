@@ -11,14 +11,17 @@ import logging
 import os
 import sys
 
+from ..obs import flight as obs_flight
+from ..obs import log as obs_log
 from ..utils.jaxenv import ensure_platform
 from .service import ReporterService, build_matcher, parse_service_config
 
 
 def main(argv):
-    logging.basicConfig(
-        level=logging.INFO, format="%(asctime)s %(levelname)s %(message)s"
-    )
+    # the shared log switch (REPORTER_LOG_FORMAT=json|text,
+    # REPORTER_LOG_LEVEL) + the flight recorder's SIGTERM/fatal disk dump
+    obs_log.configure()
+    obs_flight.install_shutdown_dump()
     ensure_platform()
     # conf path: positional arg, else $MATCHER_CONF_FILE — the reference's
     # container default (README.md Env Var Overrides: MATCHER_CONF_FILE).
@@ -147,7 +150,11 @@ def main(argv):
         warm_thread.start()
         httpd.serve_forever()
         if service.batcher is None:
-            # serve loop ended with no engine: the build failed
+            # serve loop ended with no engine: the build failed — dump the
+            # flight recorder like any other fatal exit before bailing
+            from ..utils.shutdown import run_shutdown_hooks
+
+            run_shutdown_hooks()
             return 1
     except KeyboardInterrupt:
         logging.info("shutting down (signal)")

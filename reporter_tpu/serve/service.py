@@ -38,7 +38,10 @@ from typing import List, Optional, Tuple
 from urllib.parse import parse_qs, urlsplit
 
 from ..matching import MatcherConfig, SegmentMatcher
+from ..obs import flight as obs_flight
+from ..obs import log as obs_log
 from ..obs import metrics as obs
+from ..obs import trace as obs_trace
 from ..obs.trace import Span
 from ..report import report as report_fn
 from ..tiles.network import RoadNetwork, grid_city
@@ -46,7 +49,7 @@ from ..tiles.network import RoadNetwork, grid_city
 log = logging.getLogger(__name__)
 
 ACTIONS = {"report", "trace_attributes_batch", "health",
-           "metrics", "statusz", "profile"}
+           "metrics", "statusz", "profile", "traces"}
 
 # metric families (docs/observability.md): the batch-fill/wait tradeoff and
 # the device-step tail are THE operating signals of a batched-accelerator
@@ -130,8 +133,8 @@ class MicroBatcher:
         self.max_batch = max_batch
         self.max_wait = max_wait_ms / 1000.0
         # metrics off only for A/B overhead measurement (tests); spans
-        # always flow — they exist per-request and only when the client
-        # opted in with ?debug=1
+        # always flow — tracing is always on, one span per request, and
+        # ?debug=1 only controls whether the breakdown rides the response
         self._obs = bool(instrument)
         self._q: "queue.Queue[tuple]" = queue.Queue()
         self._finish_q: "queue.Queue[tuple]" = queue.Queue(maxsize=max_inflight)
@@ -175,19 +178,29 @@ class MicroBatcher:
                 except queue.Empty:
                     break
             now = _time.monotonic()
+            # the batch's lead span: its trace_id becomes the histogram
+            # exemplar for batch-level observations, and the dispatch
+            # thread binds it so a compile stall logged inside the matcher
+            # carries a real request's id
+            lead = next((e[3] for e in batch if e[3] is not None), None)
             if self._obs:
                 G_QDEPTH.set(self._q.qsize())
-                M_BATCH_FILL.observe(len(batch))
+                M_BATCH_FILL.observe(
+                    len(batch), exemplar=lead.trace_id if lead else None)
                 C_BATCHES.inc()
-                for _t, _f, t_enq, _sp in batch:
-                    M_QUEUE_WAIT.observe(now - t_enq)
             for _t, _f, t_enq, sp in batch:
+                wait = now - t_enq
+                if self._obs:
+                    M_QUEUE_WAIT.observe(
+                        wait, exemplar=sp.trace_id if sp else None)
                 if sp is not None:
-                    sp.mark("queue_wait_s", now - t_enq)
+                    sp.mark("queue_wait_s", wait)
                     sp.meta["batch_size"] = len(batch)
             try:
                 t_d0 = _time.monotonic()
-                finish = self.matcher.match_many_async([e[0] for e in batch])
+                with obs_trace.bind(lead):
+                    finish = self.matcher.match_many_async(
+                        [e[0] for e in batch])
                 dispatch_s = _time.monotonic() - t_d0
                 for _t, _f, _te, sp in batch:
                     if sp is not None:
@@ -210,7 +223,10 @@ class MicroBatcher:
                 results = finish()
                 step_s = _time.monotonic() - t0
                 if self._obs:
-                    M_DEVICE_STEP.observe(step_s)
+                    lead = next(
+                        (e[3] for e in batch if e[3] is not None), None)
+                    M_DEVICE_STEP.observe(
+                        step_s, exemplar=lead.trace_id if lead else None)
                 for (t, f, _te, sp), r in zip(batch, results):
                     if sp is not None:
                         sp.mark("device_step_s", step_s)
@@ -297,28 +313,44 @@ class ReporterService:
         return None, rl, tl
 
     def handle_report(self, trace: dict, debug: bool = False) -> Tuple[int, dict]:
+        # always-on tracing: the HTTP handler binds a Span carrying the
+        # (accepted or generated) trace_id before calling in; embedders
+        # that call handle_report(trace) directly get a self-made trace.
+        # ?debug=1 only opts the breakdown onto the response — every
+        # outcome is offered to the flight recorder regardless.
+        span = obs_trace.current_span() or Span("report")
+        span.meta.setdefault("endpoint", "report")
+        if isinstance(trace, dict) and trace.get("uuid") is not None:
+            span.meta.setdefault("uuid", str(trace["uuid"])[:64])
         batcher = self.batcher
         if batcher is None:
+            span.fail("service initialising", status="unavailable")
+            obs_flight.record(span)
             return 503, {"error": "service initialising"}
         err, rl, tl = self.validate(trace)
         if err:
             C_REQUESTS.labels("report", "invalid").inc()
+            span.fail(err, status="invalid")
+            obs_flight.record(span)
             return 400, {"error": err}
-        span = Span("report") if debug else None
         try:
-            match = batcher.match(trace, span=span)
-            t_rep = _time.monotonic()
-            data = report_fn(match, trace, self.threshold_sec, rl, tl,
-                             mode=trace.get("match_options", {}).get("mode", "auto"))
-            if span is not None:
-                span.mark("report_fn_s", _time.monotonic() - t_rep)
-                span.finish()
+            with obs_trace.bind(span):
+                match = batcher.match(trace, span=span)
+                t_rep = _time.monotonic()
+                data = report_fn(match, trace, self.threshold_sec, rl, tl,
+                                 mode=trace.get("match_options", {}).get("mode", "auto"))
+            span.mark("report_fn_s", _time.monotonic() - t_rep)
+            span.finish()
+            if debug:
                 data["debug"] = span.breakdown()
+            obs_flight.record(span)
             self._count(ok=True)
             C_REQUESTS.labels("report", "ok").inc()
             return 200, data
         except Exception as e:
             log.exception("match failed")
+            span.fail(e)
+            obs_flight.record(span)
             self._count(ok=False)
             C_REQUESTS.labels("report", "error").inc()
             return 500, {"error": str(e)}
@@ -352,31 +384,51 @@ class ReporterService:
         }
 
     def handle_batch(self, body: dict) -> Tuple[int, dict]:
+        # one span for the whole batch request (per-trace fan-out would
+        # multiply flight entries); stage marks cover the pooled match and
+        # the report loop
+        span = obs_trace.current_span() or Span("trace_attributes_batch")
+        span.meta.setdefault("endpoint", "trace_attributes_batch")
         batcher = self.batcher
         if batcher is None:
+            span.fail("service initialising", status="unavailable")
+            obs_flight.record(span)
             return 503, {"error": "service initialising"}
         traces = body.get("traces")
         if not isinstance(traces, list) or not traces:
+            span.fail("traces must be a non-empty array", status="invalid")
+            obs_flight.record(span)
             return 400, {"error": "traces must be a non-empty array"}
+        span.meta["n_traces"] = len(traces)
         validated = []
         for i, trace in enumerate(traces):
             err, rl, tl = self.validate(trace)
             if err:
                 C_REQUESTS.labels("trace_attributes_batch", "invalid").inc()
+                span.fail("trace %d: %s" % (i, err), status="invalid")
+                obs_flight.record(span)
                 return 400, {"error": "trace %d: %s" % (i, err)}
             validated.append((trace, rl, tl))
         try:
-            matches = batcher.match_many([t for t, _, _ in validated])
-            results = [
-                report_fn(m, t, self.threshold_sec, rl, tl,
-                          mode=t.get("match_options", {}).get("mode", "auto"))
-                for m, (t, rl, tl) in zip(matches, validated)
-            ]
+            with obs_trace.bind(span):
+                t0 = _time.monotonic()
+                matches = batcher.match_many([t for t, _, _ in validated])
+                span.mark("match_s", _time.monotonic() - t0)
+                t0 = _time.monotonic()
+                results = [
+                    report_fn(m, t, self.threshold_sec, rl, tl,
+                              mode=t.get("match_options", {}).get("mode", "auto"))
+                    for m, (t, rl, tl) in zip(matches, validated)
+                ]
+                span.mark("report_fn_s", _time.monotonic() - t0)
+            obs_flight.record(span)
             self._count(ok=True)
             C_REQUESTS.labels("trace_attributes_batch", "ok").inc()
             return 200, {"results": results}
         except Exception as e:
             log.exception("batch failed")
+            span.fail(e)
+            obs_flight.record(span)
             self._count(ok=False)
             C_REQUESTS.labels("trace_attributes_batch", "error").inc()
             return 500, {"error": str(e)}
@@ -393,8 +445,21 @@ class ReporterService:
             "batch": dict(self._batch_params),
             "latency_buckets_s": list(obs.LATENCY_BUCKETS_S),
             "batch_fill_buckets": list(obs.BATCH_FILL_BUCKETS),
+            "flight": obs_flight.RECORDER.summary(),
             "metrics": obs.REGISTRY.snapshot(),
         }
+
+    def handle_traces(self, query: dict) -> Tuple[int, dict]:
+        """GET /debug/traces?n=K — the flight recorder's most recent
+        retained traces (errors and over-threshold always present, plus
+        the 1-in-N sample), newest first, with per-stage breakdowns."""
+        try:
+            n = int(query.get("n", ["50"])[0])
+        except (TypeError, ValueError):
+            return 400, {"error": "n must be an integer"}
+        rec = obs_flight.RECORDER
+        n = max(1, min(n, 2 * rec.capacity))
+        return 200, {"summary": rec.summary(), "traces": rec.snapshot(n)}
 
     def handle_profile(self, query: dict) -> Tuple[int, dict]:
         """GET /debug/profile?seconds=N — record a jax.profiler trace to a
@@ -450,6 +515,7 @@ class ReporterService:
                 self.send_header("Access-Control-Allow-Origin", "*")
                 self.send_header("Content-Type", "application/json;charset=utf-8")
                 self.send_header("Content-Length", str(len(body)))
+                self._echo_trace_header()
                 self.end_headers()
                 self.wfile.write(body)
 
@@ -460,8 +526,17 @@ class ReporterService:
                 self.send_header(
                     "Content-Type", "text/plain; version=0.0.4; charset=utf-8")
                 self.send_header("Content-Length", str(len(body)))
+                self._echo_trace_header()
                 self.end_headers()
                 self.wfile.write(body)
+
+            def _echo_trace_header(self):
+                """Every response echoes the request's trace id (accepted
+                from X-Reporter-Trace, or generated at ingestion), so the
+                client can pull the trace from GET /debug/traces."""
+                tid = getattr(self, "_trace_id", None)
+                if tid:
+                    self.send_header("X-Reporter-Trace", tid)
 
             def _content_length(self):
                 """Parsed Content-Length, or None for a malformed header.
@@ -494,6 +569,12 @@ class ReporterService:
             def _route(self, post: bool):
                 if service.draining:
                     self.close_connection = True  # answer, then drain out
+                # trace ingestion: accept the client's id or mint one; the
+                # id is echoed on EVERY response (_echo_trace_header)
+                self._trace_id = (
+                    obs_trace.accept_trace_id(
+                        self.headers.get("X-Reporter-Trace"))
+                    or obs_trace.new_trace_id())
                 try:
                     split = urlsplit(self.path)
                     action = split.path.split("/")[-1]
@@ -515,6 +596,9 @@ class ReporterService:
                     if action == "profile":  # GET /debug/profile?seconds=N
                         self._drain_body(post)
                         return self._answer(*service.handle_profile(query))
+                    if action == "traces":  # GET /debug/traces?n=K
+                        self._drain_body(post)
+                        return self._answer(*service.handle_traces(query))
                     if post:
                         n = self._content_length()
                         if n is None:  # malformed header: framing unknown
@@ -544,15 +628,22 @@ class ReporterService:
                 try:
                     if not isinstance(payload, dict):
                         code, out = 400, {"error": "request body must be a json object"}
-                    elif action == "report":
-                        # ?debug=1 opts into the span timing breakdown; the
-                        # kwarg is only passed when set so embedders that
-                        # wrap handle_report(trace) keep working
-                        debug = query.get("debug", ["0"])[0] not in ("", "0", "false")
-                        code, out = (service.handle_report(payload, debug=True)
-                                     if debug else service.handle_report(payload))
                     else:
-                        code, out = service.handle_batch(payload)
+                        # the request's span: handle_report/handle_batch pick
+                        # it up from the context (their own signatures stay
+                        # embedder-compatible)
+                        span = Span(action, trace_id=self._trace_id)
+                        with obs_trace.bind(span):
+                            if action == "report":
+                                # ?debug=1 opts the breakdown onto the
+                                # response; the kwarg is only passed when set
+                                # so embedders wrapping handle_report(trace)
+                                # keep working
+                                debug = query.get("debug", ["0"])[0] not in ("", "0", "false")
+                                code, out = (service.handle_report(payload, debug=True)
+                                             if debug else service.handle_report(payload))
+                            else:
+                                code, out = service.handle_batch(payload)
                 except Exception as e:  # belt-and-braces: never drop the socket
                     log.exception("unhandled request error")
                     code, out = 500, {"error": str(e)}
@@ -569,6 +660,17 @@ class ReporterService:
                     return self._route(post=True)
                 with gate:
                     self._route(post=True)
+
+            def log_request(self, code="-", size="-"):
+                # structured per-request line at DEBUG (method / path /
+                # status / trace_id) instead of the silenced stdlib format:
+                # request logs are recoverable with REPORTER_LOG_LEVEL=DEBUG
+                # without flooding the default INFO stream
+                obs_log.event(
+                    log, "http_request", level=logging.DEBUG,
+                    method=self.command, path=self.path,
+                    status=int(code) if isinstance(code, int) else str(code),
+                    trace_id=getattr(self, "_trace_id", None))
 
             def log_message(self, fmt, *args):
                 log.debug("http: " + fmt, *args)

@@ -54,9 +54,13 @@ def main(argv=None) -> int:
                          "mutually exclusive with --checkpoint")
     args = ap.parse_args(argv)
 
-    logging.basicConfig(
-        level=logging.INFO, format="%(asctime)s %(name)s %(levelname)s %(message)s"
-    )
+    # the shared log switch (REPORTER_LOG_FORMAT=json|text,
+    # REPORTER_LOG_LEVEL) + flight-recorder dump on SIGTERM/fatal
+    from ..obs import flight as obs_flight
+    from ..obs import log as obs_log
+
+    obs_log.configure()
+    obs_flight.install_shutdown_dump()
 
     pipeline = build_pipeline(
         format_config=args.format,

@@ -21,6 +21,8 @@ import urllib.error
 import urllib.request
 from typing import List, Optional, Sequence
 
+from ..obs import trace as obs_trace
+
 log = logging.getLogger(__name__)
 
 RETRIES = 3
@@ -29,8 +31,15 @@ TIMEOUT_SEC = 10.0
 
 def _post_json(url: str, payload: dict, timeout: float = TIMEOUT_SEC) -> Optional[dict]:
     body = json.dumps(payload, separators=(",", ":")).encode("utf-8")
+    # end-to-end trace propagation: reuse the caller's bound trace id (the
+    # stream runtime binds one per flush) or mint one per call; the service
+    # echoes it, so a failed or slow request is findable in the server's
+    # flight recorder (GET /debug/traces) from the client log alone
+    trace_id = obs_trace.current_trace_id() or obs_trace.new_trace_id()
     req = urllib.request.Request(
-        url, data=body, headers={"Content-Type": "application/json"}, method="POST"
+        url, data=body, method="POST",
+        headers={"Content-Type": "application/json",
+                 "X-Reporter-Trace": trace_id},
     )
     last: Optional[Exception] = None
     for attempt in range(RETRIES):
@@ -38,15 +47,21 @@ def _post_json(url: str, payload: dict, timeout: float = TIMEOUT_SEC) -> Optiona
             time.sleep(0.2 * attempt)
         try:
             with urllib.request.urlopen(req, timeout=timeout) as resp:
+                echoed = resp.headers.get("X-Reporter-Trace")
+                if echoed and echoed != trace_id:
+                    log.debug("matcher echoed foreign trace id %s (sent %s)",
+                              echoed, trace_id)
                 return json.loads(resp.read().decode("utf-8"))
         except urllib.error.HTTPError as e:
             if 400 <= e.code < 500:
-                log.error("matcher rejected request: %s", e)
+                log.error("matcher rejected request (trace %s): %s",
+                          trace_id, e)
                 return None
             last = e
         except Exception as e:
             last = e
-    log.error("matcher unreachable after %d attempts: %s", RETRIES, last)
+    log.error("matcher unreachable after %d attempts (trace %s): %s",
+              RETRIES, trace_id, last)
     return None
 
 

@@ -111,7 +111,9 @@ def main(argv=None) -> int:
     ap.add_argument("--concurrency", type=int, default=8)
     args = ap.parse_args(argv)
 
-    logging.basicConfig(level=logging.INFO, format="%(asctime)s %(levelname)s %(message)s")
+    from ..obs import log as obs_log
+
+    obs_log.configure()  # REPORTER_LOG_FORMAT / REPORTER_LOG_LEVEL
     levels = (
         {int(x) for x in args.levels.split(",")} if args.levels is not None else None
     )

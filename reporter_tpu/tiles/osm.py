@@ -587,8 +587,9 @@ def main(argv=None) -> int:
                     help="min_lat,min_lon,max_lat,max_lon filter")
     args = ap.parse_args(argv)
 
-    logging.basicConfig(level=logging.INFO,
-                        format="%(asctime)s %(name)s %(levelname)s %(message)s")
+    from ..obs import log as obs_log
+
+    obs_log.configure()  # REPORTER_LOG_FORMAT / REPORTER_LOG_LEVEL
     bbox = None
     if args.bbox:
         parts = [float(x) for x in args.bbox.split(",")]

@@ -23,7 +23,32 @@ unwinding the cleanup itself.
 from __future__ import annotations
 
 import signal
-from typing import Iterable
+from typing import Callable, Iterable, List
+
+# best-effort hooks run on the FIRST delivery of a stop signal, before the
+# flag flips / KeyboardInterrupt raises: the flight recorder registers its
+# disk dump here (obs.flight.install_shutdown_dump) so a SIGTERM'd process
+# leaves its last traces behind.  Hooks must be fast, lock-free on the
+# paths a handler can interrupt, and never raise (they run inside a signal
+# handler); failures are swallowed — shutdown must proceed regardless.
+_HOOKS: List[Callable[[], None]] = []
+
+
+def on_shutdown(fn: Callable[[], None]) -> None:
+    """Register a hook to run once on the first SIGTERM/SIGINT delivery
+    (and on explicit ``run_shutdown_hooks()`` calls from fatal paths)."""
+    if fn not in _HOOKS:
+        _HOOKS.append(fn)
+
+
+def run_shutdown_hooks() -> None:
+    """Run every registered hook, best-effort.  Safe to call repeatedly
+    (fatal exit paths call it explicitly; signal handlers call it too)."""
+    for fn in list(_HOOKS):
+        try:
+            fn()
+        except Exception:  # noqa: BLE001 - shutdown must proceed
+            pass
 
 
 class StopFlag:
@@ -49,6 +74,7 @@ class StopFlag:
                     signal.signal(signum, signal.SIG_DFL)
                 except (ValueError, OSError):  # pragma: no cover
                     pass
+            run_shutdown_hooks()
 
         for sig in signals:
             try:
@@ -77,6 +103,7 @@ def term_to_keyboard_interrupt() -> None:
             signal.signal(signum, signal.SIG_DFL)
         except (ValueError, OSError):  # pragma: no cover
             pass
+        run_shutdown_hooks()
         raise KeyboardInterrupt
 
     try:

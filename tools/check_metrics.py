@@ -1,13 +1,18 @@
 #!/usr/bin/env python
-"""Static doc/code sync check for metric families.
+"""Static doc/code sync check for metric families AND HTTP endpoints.
 
 Every metric name registered in ``reporter_tpu/`` (a string-literal first
 argument to a ``counter``/``gauge``/``histogram`` call with the
 ``reporter_`` prefix) must appear in docs/observability.md's family
 tables, and every name documented there must be registered in code —
 dashboards built from the doc must never dereference a ghost, and code
-must never grow an undocumented family.  Wired as a tier-1 test
-(tests/test_metrics_doc.py); also runnable standalone:
+must never grow an undocumented family.
+
+Likewise every action in serve/service.py's ``ACTIONS`` set (the routing
+whitelist) must appear as a ``/<action>`` path in docs/http-api.md: an
+endpoint added in code (e.g. ``/debug/traces``) must be documented before
+it ships.  Wired as a tier-1 test (tests/test_metrics_doc.py); also
+runnable standalone:
 
     python tools/check_metrics.py
 """
@@ -60,6 +65,32 @@ def documented_names(doc_path: str = DOC) -> "set[str]":
         return set(_DOC_ROW_RE.findall(f.read()))
 
 
+SERVICE_PY = os.path.join(PKG_DIR, "serve", "service.py")
+API_DOC = os.path.join(REPO, "docs", "http-api.md")
+
+
+def served_actions(path: str = SERVICE_PY) -> "set[str]":
+    """The string members of the module-level ``ACTIONS`` set literal."""
+    with open(path) as f:
+        tree = ast.parse(f.read(), filename=path)
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Assign)
+                and any(getattr(t, "id", None) == "ACTIONS" for t in node.targets)
+                and isinstance(node.value, ast.Set)):
+            return {
+                el.value for el in node.value.elts
+                if isinstance(el, ast.Constant) and isinstance(el.value, str)
+            }
+    return set()
+
+
+def documented_actions(doc_path: str = API_DOC) -> "set[str]":
+    """Action names that appear as a /<action> path anywhere in the doc."""
+    with open(doc_path) as f:
+        text = f.read()
+    return set(re.findall(r"/([a-z_]+)\b", text))
+
+
 def main() -> int:
     code = registered_names()
     doc = documented_names()
@@ -72,8 +103,17 @@ def main() -> int:
         print("GHOST: %s (documented but registered nowhere under "
               "reporter_tpu/)" % name)
         rc = 1
+    actions = served_actions()
+    if not actions:
+        print("BROKEN: could not parse ACTIONS from serve/service.py")
+        rc = 1
+    for action in sorted(actions - documented_actions()):
+        print("UNDOCUMENTED ENDPOINT: %s (in serve/service.py ACTIONS, "
+              "no /%s path in docs/http-api.md)" % (action, action))
+        rc = 1
     if rc == 0:
-        print("ok: %d metric families, code and docs agree" % len(code))
+        print("ok: %d metric families + %d endpoints, code and docs agree"
+              % (len(code), len(actions)))
     return rc
 
 

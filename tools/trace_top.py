@@ -1,0 +1,206 @@
+#!/usr/bin/env python
+"""trace_top: a live terminal view of the serving pipeline.
+
+Polls ``GET /metrics`` and ``GET /debug/traces`` and renders, per refresh:
+
+  - per-stage p50/p95/p99 (queue wait, device step) computed from the
+    histogram bucket deltas over the poll interval (cumulative-since-boot
+    on the first frame),
+  - batch fill, queue depth, in-flight batches, request ok/error rates,
+  - the slowest recent traces from the flight recorder with their
+    per-stage breakdowns, so a tail-latency spike on the quantile row is
+    one glance away from the trace ids that caused it.
+
+Usage:
+    python tools/trace_top.py --url http://localhost:8002 [--interval 2]
+    python tools/trace_top.py --url http://localhost:8002 --once
+
+Pure stdlib (the container bakes in the jax_graft toolchain only); the
+parsing/quantile helpers are unit-tested in tests/test_trace.py.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import re
+import sys
+import time
+import urllib.request
+from typing import Dict, List, Optional, Tuple
+
+_SAMPLE_RE = re.compile(
+    r'^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{(.*)\})?\s+(-?[0-9.eE+-]+|NaN)$')
+_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:\\.|[^"\\])*)"')
+
+
+def parse_metrics(text: str) -> Dict[str, Dict[Tuple[Tuple[str, str], ...], float]]:
+    """Prometheus text exposition -> {name: {labels: value}} with labels a
+    sorted tuple of (k, v) pairs (histogram _bucket/_sum/_count stay
+    separate names, exactly as exposed)."""
+    out: Dict[str, Dict[Tuple[Tuple[str, str], ...], float]] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        m = _SAMPLE_RE.match(line)
+        if not m:
+            continue
+        name, _g, labels_raw, value = m.groups()
+        labels = tuple(sorted(_LABEL_RE.findall(labels_raw or "")))
+        try:
+            out.setdefault(name, {})[labels] = float(value)
+        except ValueError:
+            continue
+    return out
+
+
+def hist_buckets(metrics: dict, family: str) -> List[Tuple[float, float]]:
+    """Sorted (upper_bound, cumulative_count) pairs for an unlabeled
+    histogram family, +Inf included."""
+    rows = []
+    for labels, v in metrics.get(family + "_bucket", {}).items():
+        le = dict(labels).get("le")
+        if le is None:
+            continue
+        rows.append((float("inf") if le == "+Inf" else float(le), v))
+    rows.sort()
+    return rows
+
+
+def delta_buckets(cur: List[Tuple[float, float]],
+                  prev: Optional[List[Tuple[float, float]]]) -> List[Tuple[float, float]]:
+    """Bucket-wise difference (interval histogram); falls back to ``cur``
+    when there is no previous frame or the server restarted (negative
+    deltas)."""
+    if not prev or len(prev) != len(cur):
+        return cur
+    out = []
+    for (le, c), (_ple, p) in zip(cur, prev):
+        d = c - p
+        if d < 0:
+            return cur
+        out.append((le, d))
+    return out
+
+
+def hist_quantile(buckets: List[Tuple[float, float]], q: float) -> Optional[float]:
+    """Quantile from cumulative buckets with linear interpolation inside
+    the landing bucket (Prometheus histogram_quantile semantics); None on
+    an empty histogram.  The +Inf bucket clamps to the last finite bound."""
+    if not buckets:
+        return None
+    total = buckets[-1][1]
+    if total <= 0:
+        return None
+    rank = q * total
+    prev_le, prev_cum = 0.0, 0.0
+    for le, cum in buckets:
+        if cum >= rank:
+            if le == float("inf"):
+                return prev_le
+            if cum == prev_cum:
+                return le
+            return prev_le + (le - prev_le) * (rank - prev_cum) / (cum - prev_cum)
+        prev_le, prev_cum = le, cum
+    return prev_le
+
+
+def scalar(metrics: dict, name: str, labels: Tuple[Tuple[str, str], ...] = ()) -> float:
+    return metrics.get(name, {}).get(labels, 0.0)
+
+
+def _fmt_ms(v: Optional[float]) -> str:
+    return "-" if v is None else "%.1f" % (v * 1000.0)
+
+
+def render_frame(metrics: dict, prev: Optional[dict], traces: List[dict],
+                 interval_s: float) -> str:
+    lines = ["reporter_tpu trace_top — %s" % time.strftime("%H:%M:%S")]
+    lines.append("")
+    lines.append("stage                      p50ms   p95ms   p99ms")
+    for label, fam in (("queue wait", "reporter_microbatch_queue_wait_seconds"),
+                       ("device step", "reporter_microbatch_device_step_seconds")):
+        cur = hist_buckets(metrics, fam)
+        prev_b = hist_buckets(prev, fam) if prev else None
+        d = delta_buckets(cur, prev_b)
+        lines.append("%-24s %7s %7s %7s" % (
+            label, _fmt_ms(hist_quantile(d, 0.50)),
+            _fmt_ms(hist_quantile(d, 0.95)), _fmt_ms(hist_quantile(d, 0.99))))
+    fill = delta_buckets(
+        hist_buckets(metrics, "reporter_microbatch_batch_fill"),
+        hist_buckets(prev, "reporter_microbatch_batch_fill") if prev else None)
+    n_batches = fill[-1][1] if fill else 0
+    fill_sum = scalar(metrics, "reporter_microbatch_batch_fill_sum") - (
+        scalar(prev, "reporter_microbatch_batch_fill_sum") if prev else 0.0)
+    lines.append("")
+    lines.append("queue depth %d   inflight %d   mean batch fill %.1f" % (
+        scalar(metrics, "reporter_microbatch_queue_depth"),
+        scalar(metrics, "reporter_microbatch_inflight"),
+        (fill_sum / n_batches) if n_batches else 0.0))
+    ok = err = 0.0
+    for labels, v in metrics.get("reporter_requests_total", {}).items():
+        pv = (prev or {}).get("reporter_requests_total", {}).get(labels, 0.0)
+        d = max(v - pv, 0.0) if prev else v
+        if dict(labels).get("outcome") == "ok":
+            ok += d
+        else:
+            err += d
+    per = "/%.0fs" % interval_s if prev else " total"
+    lines.append("requests%s: %d ok, %d invalid/error" % (per, ok, err))
+    lines.append("")
+    lines.append("slowest recent traces (flight recorder):")
+    lines.append("  trace_id                          name      status  total_ms  stages")
+    slow = sorted(traces, key=lambda t: -t.get("timings", {}).get("total_s", 0.0))
+    for t in slow[:10]:
+        tm = t.get("timings", {})
+        stages = " ".join(
+            "%s=%.0f" % (k[:-2], v * 1000.0)
+            for k, v in sorted(tm.items()) if k != "total_s")
+        lines.append("  %-33s %-9s %-7s %8.1f  %s" % (
+            t.get("trace_id", "?")[:33], t.get("name", "?"),
+            t.get("status", "?"), tm.get("total_s", 0.0) * 1000.0, stages))
+    if not traces:
+        lines.append("  (none retained yet)")
+    return "\n".join(lines)
+
+
+def _fetch(url: str, timeout: float = 5.0) -> bytes:
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.read()
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--url", required=True, help="service base url, e.g. "
+                    "http://localhost:8002")
+    ap.add_argument("--interval", type=float, default=2.0)
+    ap.add_argument("--n", type=int, default=50, help="traces to fetch")
+    ap.add_argument("--once", action="store_true", help="one frame, no clear")
+    args = ap.parse_args(argv)
+
+    base = args.url.rstrip("/")
+    prev = None
+    while True:
+        try:
+            metrics = parse_metrics(_fetch(base + "/metrics").decode())
+            traces = json.loads(_fetch(
+                base + "/debug/traces?n=%d" % args.n).decode()).get("traces", [])
+        except Exception as e:  # noqa: BLE001 - keep polling through restarts
+            sys.stderr.write("trace_top: poll failed: %s\n" % (e,))
+            if args.once:
+                return 1
+            time.sleep(args.interval)
+            continue
+        frame = render_frame(metrics, prev, traces, args.interval)
+        if args.once:
+            print(frame)
+            return 0
+        sys.stdout.write("\x1b[2J\x1b[H" + frame + "\n")
+        sys.stdout.flush()
+        prev = metrics
+        time.sleep(args.interval)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
