@@ -265,7 +265,18 @@ def run_device() -> int:
     from reporter_tpu.matching import MatcherConfig, SegmentMatcher
     from reporter_tpu.synth.generator import segment_agreement
 
-    cfg = MatcherConfig()
+    # --kernel scan|assoc (env BENCH_KERNEL; the orchestrator re-execs this
+    # file with no argv, so the flag rides the environment): the named
+    # kernel drives the e2e/latency sections, and the kernel-only section
+    # additionally times BOTH viterbi forwards so one run yields the
+    # crossover (docs/performance.md; recorded by BENCH_r06)
+    bench_kernel = os.environ.get("BENCH_KERNEL", "").strip().lower()
+    if bench_kernel and bench_kernel not in ("scan", "assoc"):
+        _stderr("BENCH_KERNEL must be scan|assoc, got %r" % bench_kernel)
+        return 2
+    primary_kernel = bench_kernel or "scan"
+
+    cfg = MatcherConfig(viterbi_kernel=primary_kernel)
     matcher = SegmentMatcher(arrays=arrays, ubodt=ubodt, config=cfg)
     traces = [s.trace for _, _, ss in cohorts for s in ss]
     n_traces = len(traces)
@@ -363,15 +374,16 @@ def run_device() -> int:
 
     from reporter_tpu.ops.viterbi import pack_inputs, unpack_compact
 
-    def _compact_args(px, py, tm, valid, cohort=None):
+    def _compact_args(px, py, tm, valid, cohort=None, kernel=None):
         # mirror SegmentMatcher._dispatch_batch's batch padding so the
         # kernel-only timing measures exactly the shapes/program e2e
         # dispatches even when env overrides pick off-rung cohort sizes.
         # The forward speaks the packed transport ([4,B,T] in, [3,B,T] out).
         px, py, tm, valid = SegmentMatcher._pad_batch(px, py, tm, valid)
-        fn = matcher._jit_match_scan
+        kernel = kernel or primary_kernel
+        fn = matcher._get_jit("compact", kernel)
         if cohort:
-            forward_by_cohort[cohort] = "scan"
+            forward_by_cohort[cohort] = kernel
         return fn, (dg, du, jnp.asarray(pack_inputs(px, py, tm, valid)), params)
 
     # HBM-traffic model for the roofline (VERDICT r03 weak #5): the two
@@ -431,16 +443,17 @@ def run_device() -> int:
     # even when BENCH_TRACES_LONG picks an off-rung count
     xin_long = pack_inputs(*SegmentMatcher._pad_batch(px, py, tm, valid))
 
-    def _long_pass(collect: bool = False):
+    def _long_pass(collect: bool = False, kernel=None):
         # dispatch every chunk before fetching anything: the carry chains
         # them on device, so only the final fetch pays the host sync cost
         # (mirrors SegmentMatcher._match_long).  Sizes come from xin_long,
         # not the enclosing px — later sections rebind px to other cohorts
         # (the profiler section used to crash on exactly that shadowing).
         carry = initial_carry_batch(xin_long.shape[1], cfg.beam_k)
+        fn_carry = matcher._get_jit("carry", kernel or primary_kernel)
         outs = []
         for c in range(n_chunks):
-            out, carry = matcher._jit_match_carry(
+            out, carry = fn_carry(
                 dg, du, jnp.asarray(xin_long[:, :, c * W : (c + 1) * W]),
                 params, cfg.beam_k, carry)
             outs.append(out)
@@ -485,10 +498,47 @@ def run_device() -> int:
             _stderr("profiler trace failed: %s" % (e,))
             profile_dir = None
 
+    # --kernel comparison: time BOTH viterbi forwards over the same cohorts
+    # (same padded shapes, same fetch discipline) so one bench line carries
+    # the scan/assoc crossover.  Runs inside this worker's budget and the
+    # ordinary status/banking path — a SIGTERM mid-compare banks whatever
+    # the orchestrator already holds, like any other mid-run kill.
+    kernel_compare = None
+    if bench_kernel:
+        kernel_compare = {}
+        for kern in ("scan", "assoc"):
+            _write_status(phase="benching", step="kernel_compare_" + kern,
+                          platform=platform)
+            secs = 0.0
+            by_cohort = {}
+            for cname, T, ss in cohorts:
+                px, py, tm, valid = cohort_xy[cname]
+                if cname == "long":
+                    np.asarray(_long_pass(kernel=kern))
+                    t0 = time.time()
+                    for _ in range(reps):
+                        r = _long_pass(kernel=kern)
+                else:
+                    fn, args = _compact_args(px, py, tm, valid, kernel=kern)
+                    np.asarray(fn(*args, cfg.beam_k))
+                    t0 = time.time()
+                    for _ in range(reps):
+                        r = fn(*args, cfg.beam_k)
+                np.asarray(r)  # in-order queue: the last fetch bounds all reps
+                dt = (time.time() - t0) / reps
+                secs += dt
+                by_cohort[cname] = round(len(ss) / dt, 1)
+            kernel_compare[kern] = {
+                "traces_per_sec": round(n_traces / secs, 1),
+                "points_per_sec": round(n_points_total / secs, 1),
+                "by_cohort": by_cohort,
+            }
+        _stderr("kernel compare: %s" % (kernel_compare,))
+
     kernel_tps = n_traces / kernel_secs
     kernel_pps = n_points_total / kernel_secs
     device_util = min(1.0, kernel_secs / (e2e_wall / reps))
-    forward_by_cohort["long"] = "carry-scan"
+    forward_by_cohort["long"] = "carry-" + primary_kernel
     _stderr("kernel-only %.1f traces/s / %.0f pts/s; e2e %.1f "
             "traces/s (%.0f pts/s); device util %.2f"
             % (kernel_tps, kernel_pps, tps, pps, device_util))
@@ -612,6 +662,8 @@ def run_device() -> int:
         "dispatch_floor_ms": round(floor_ms, 2),
         "latency_cohort": "short64",
         "e2e_mode": "pipelined_overlap%d" % inflight,
+        "viterbi_kernel": primary_kernel,
+        "kernel_compare": kernel_compare,
         "forward_by_cohort": forward_by_cohort,
         "kernel_traces_per_sec": round(kernel_tps, 1),
         "kernel_points_per_sec": round(kernel_pps, 1),
@@ -912,6 +964,17 @@ def main() -> int:
     from reporter_tpu.obs import log as obs_log
 
     obs_log.configure()
+    # --kernel scan|assoc: primary viterbi kernel for the e2e sections, and
+    # the device worker additionally times both kernels (kernel_compare in
+    # the JSON line).  Rides the environment because role workers re-exec
+    # this file with no argv.
+    argv = sys.argv[1:]
+    if "--kernel" in argv:
+        i = argv.index("--kernel")
+        if i + 1 >= len(argv) or argv[i + 1] not in ("scan", "assoc"):
+            sys.stderr.write("usage: bench.py [--kernel scan|assoc]\n")
+            return 2
+        os.environ["BENCH_KERNEL"] = argv[i + 1]
     role = os.environ.get("BENCH_ROLE", "")
     if role == "device":
         return run_device()
@@ -1097,7 +1160,7 @@ def main() -> int:
             device_json.get("kernel_points_per_sec", 0) / cpu_pps, 2) if cpu_pps else None,
     }
     for k in ("platform", "acquire_s", "points_per_sec", "p50_latency_ms", "p95_latency_ms",
-              "dispatch_floor_ms",
+              "dispatch_floor_ms", "viterbi_kernel", "kernel_compare",
               "latency_cohort", "e2e_mode", "forward_by_cohort", "kernel_traces_per_sec",
               "kernel_points_per_sec", "kernel_by_cohort",
               "kernel_secs_by_cohort", "roofline", "profile_dir",

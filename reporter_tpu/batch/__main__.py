@@ -67,6 +67,14 @@ def main(argv=None) -> int:
                     help="on exit, print ONE merged JSON metrics snapshot "
                          "covering this process and every fan-out worker "
                          "(docs/observability.md)")
+    ap.add_argument("--warmup", action="store_true",
+                    help="pre-dispatch every configured (batch rung, length "
+                         "bucket, viterbi kernel) shape plus the carry-chain "
+                         "program before phase 2 starts matching, so compile "
+                         "stalls land in a visible warmup pass instead of "
+                         "the first micro-batches (docs/performance.md; "
+                         "pair with $REPORTER_XLA_CACHE_DIR for warm "
+                         "restarts)")
     args = ap.parse_args(argv)
 
     # the shared log switch (REPORTER_LOG_FORMAT=json|text,
@@ -84,6 +92,8 @@ def main(argv=None) -> int:
     from .pipeline import run_pipeline
 
     matcher, _conf = load_service_config(args.match_config, backend=args.backend)
+    if args.warmup:
+        matcher.warmup(carry_chain=True)
     trace_dir, match_dir = run_pipeline(
         matcher,
         archive_spec=args.src,

@@ -29,6 +29,17 @@ class MatcherConfig:
     # TPU kernel shape knobs
     beam_k: int = 8
     ubodt_delta: float = 3000.0
+    # viterbi forward selection (docs/performance.md): "scan" = sequential
+    # lax.scan (O(T) depth, least work), "assoc" = log-depth associative
+    # max-plus scan, "auto" = assoc for padded window lengths >=
+    # viterbi_assoc_threshold (the measured crossover; provisional until a
+    # BENCH_r06 --kernel run pins it per deployment).  $REPORTER_VITERBI
+    # overrides at runtime.
+    viterbi_kernel: str = "scan"
+    viterbi_assoc_threshold: int = 256
+    # batch rungs pre-dispatched per length bucket by warmup passes
+    # (serve --warmup / batch --warmup); each snaps up to a ladder rung
+    warmup_batch_sizes: List[int] = field(default_factory=lambda: [1])
     # padded trace-length buckets for batched matching
     length_buckets: List[int] = field(default_factory=lambda: [16, 32, 64, 128, 256])
     # device-batch caps: the kernel materialises [B, T, K, K] transition

@@ -18,6 +18,7 @@ from __future__ import annotations
 import json
 import logging
 import os
+import threading
 import time as _time
 from typing import Dict, List, Optional, Sequence
 
@@ -42,11 +43,22 @@ log = logging.getLogger(__name__)
 C_COMPILES = obs.counter(
     "reporter_compile_total",
     "First-dispatch (compiling) device calls per padded shape bucket",
-    ("shape",))
+    ("shape", "kernel"))
 C_COMPILE_S = obs.counter(
     "reporter_compile_seconds_total",
     "Wall seconds spent blocked in first-dispatch (compiling) calls",
-    ("shape",))
+    ("shape", "kernel"))
+C_DISPATCHES = obs.counter(
+    "reporter_dispatch_total",
+    "Device batch dispatches by viterbi kernel (scan / assoc)",
+    ("kernel",))
+C_WARM_SHAPES = obs.counter(
+    "reporter_warmup_shapes_total",
+    "Shapes pre-dispatched by warmup, by viterbi kernel",
+    ("kernel",))
+C_WARM_S = obs.counter(
+    "reporter_warmup_seconds_total",
+    "Wall seconds spent in warmup pre-dispatch passes")
 C_TRACES = obs.counter(
     "reporter_traces_matched_total", "Traces run through host association")
 C_POINTS = obs.counter(
@@ -114,6 +126,27 @@ class SegmentMatcher:
         self.arrays = arrays
         self.ubodt = ubodt or build_ubodt(arrays, delta=self.cfg.ubodt_delta)
         self.backend = backend
+        # viterbi forward selection (docs/performance.md): scan = sequential
+        # lax.scan (O(T) depth), assoc = log-depth associative max-plus scan,
+        # auto = pick per padded bucket length against the measured
+        # crossover.  $REPORTER_VITERBI overrides the config.
+        env_kernel = os.environ.get("REPORTER_VITERBI", "").strip().lower()
+        self._kernel_mode = env_kernel or getattr(
+            self.cfg, "viterbi_kernel", "scan") or "scan"
+        if self._kernel_mode not in ("scan", "assoc", "auto"):
+            raise ValueError(
+                "REPORTER_VITERBI/viterbi_kernel must be scan|assoc|auto, "
+                "got %r" % (self._kernel_mode,))
+        self._assoc_threshold = int(
+            getattr(self.cfg, "viterbi_assoc_threshold", 256))
+        # per-(B_pad,...) pinned staging buffers for batch-dimension padding:
+        # the dp-remainder and ladder pads run on every dispatch, and a fresh
+        # np.concatenate per call reallocated (and re-faulted) the same
+        # megabytes each time.  Dispatches are single-threaded per matcher
+        # (the MicroBatcher's one worker / the batch driver), and every
+        # consumer copies out synchronously (pack_inputs / the cpu oracle),
+        # so reuse is safe.
+        self._staging: Dict[tuple, np.ndarray] = {}
         # first-dispatch shape tracking for the compile counters, plus the
         # sampled device-side probe diagnostic (0 = off, the default: the
         # probe program doubles device work for its batch, so it is an
@@ -125,6 +158,11 @@ class SegmentMatcher:
         except ValueError:
             self._probe_every = 0
         self._jit_probe = None
+        # probe results dispatched but not yet fetched: the sampler enqueues
+        # on the dispatch thread and the sync (np.asarray) happens on the
+        # collect side, so a probe tick never lengthens a dispatch
+        self._probe_pending: list = []
+        self._probe_lock = threading.Lock()
         if backend == "jax":
             self._init_jax()
         elif backend == "cpu":
@@ -137,9 +175,7 @@ class SegmentMatcher:
     def _init_jax(self):
         import jax
 
-        from ..ops.viterbi import (
-            MatchParams, match_batch_carry_packed, match_batch_compact_packed,
-        )
+        from ..ops.viterbi import MatchParams
 
         self._dg = self.arrays.to_device()
         self._du = self.ubodt.to_device()
@@ -169,7 +205,6 @@ class SegmentMatcher:
             raise ValueError("cfg.graph_devices=%d must divide devices=%d"
                              % (self._n_gp, n_total))
         self._n_dp = n_total // self._n_gp
-        gp_jits = None
         if n_total > 1 or self._n_gp > 1:
             from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -193,32 +228,71 @@ class SegmentMatcher:
             self._dg = jax.device_put(self._dg, repl)
             self._du = jax.device_put(self._du, du_sharding)
             self._params = jax.device_put(self._params, repl)
-            if self._n_gp > 1:
-                gp_jits = self._make_gp_jits()
         # all forwards speak the packed transport: one [4, B, T] f32 array in,
         # one [3, B, T] i32 array out (ops/viterbi.pack_inputs/pack_compact).
         # Each host<->device crossing pays a fixed dispatch/sync cost (~73 ms
         # on the tunneled bench chip), so the 4-put + 3-fetch unpacked calling
         # convention tripled single-trace latency.
-        if gp_jits is not None:
-            self._jit_match_carry = gp_jits["carry"]
-        else:
-            self._jit_match_carry = jax.jit(
-                match_batch_carry_packed, static_argnums=(4,))
+        #
+        # Two selectable Viterbi forwards per program kind ("compact" /
+        # "carry"), built lazily per kernel so a scan-only deployment never
+        # traces the assoc program (and vice versa).  A hand-written pallas
+        # Viterbi forward was carried (and measured) for three rounds and
+        # never beat the scan on chip -- XLA already fuses this program's
+        # hot loops, and the kernel's 128-row block constraint hurt
+        # single-trace latency; it was deleted per VERDICT r04 next #5
+        # (measurements and design notes: docs/pallas-decision.md).  The
+        # assoc kernel is the log-depth associative-scan formulation
+        # (ops/viterbi._forward_assoc, docs/performance.md).
+        self._jits: Dict[tuple, object] = {}
 
-        # one forward for every batch shape: the lax.scan program.  A
-        # hand-written pallas Viterbi forward was carried (and measured)
-        # for three rounds and never beat the scan on chip -- XLA already
-        # fuses this program's hot loops, and the kernel's 128-row block
-        # constraint hurt single-trace latency; it was deleted per VERDICT
-        # r04 next #5 (measurements and design notes: docs/pallas-decision.md)
-        if gp_jits is not None:
-            self._jit_match_scan = gp_jits["compact"]
-        else:
-            self._jit_match_scan = jax.jit(
-                match_batch_compact_packed, static_argnums=(4,))
+    def _get_jit(self, kind: str, kernel: str):
+        """Lazily-built jitted forward for (kind in compact|carry, kernel in
+        scan|assoc).  The gp-sharded variants are built through
+        _make_gp_jits; both expose the same packed calling convention."""
+        key = (kind, kernel)
+        fn = self._jits.get(key)
+        if fn is None:
+            if self._n_gp > 1:
+                built = self._make_gp_jits(kernel)
+                self._jits[("compact", kernel)] = built["compact"]
+                self._jits[("carry", kernel)] = built["carry"]
+            else:
+                import functools
 
-    def _make_gp_jits(self):
+                import jax
+
+                from ..ops.viterbi import (
+                    match_batch_carry_packed, match_batch_compact_packed,
+                )
+
+                base = (match_batch_compact_packed if kind == "compact"
+                        else match_batch_carry_packed)
+                self._jits[key] = jax.jit(
+                    functools.partial(base, kernel=kernel), static_argnums=(4,))
+            fn = self._jits[key]
+        return fn
+
+    # back-compat accessors (bench.py / tools use these to time the exact
+    # dispatched programs): the scan-kernel jits
+    @property
+    def _jit_match_scan(self):
+        return self._get_jit("compact", "scan")
+
+    @property
+    def _jit_match_carry(self):
+        return self._get_jit("carry", "scan")
+
+    def _kernel_for(self, T: int) -> str:
+        """Resolve the viterbi kernel for a padded window length.  "auto"
+        picks assoc at/above the measured crossover bucket length (the
+        log-depth kernel does O(K) more work per step, so it only wins once
+        the sequential chain is long enough; docs/performance.md)."""
+        if self._kernel_mode != "auto":
+            return self._kernel_mode
+        return "assoc" if T >= self._assoc_threshold else "scan"
+
+    def _make_gp_jits(self, kernel: str = "scan"):
         """shard_map'd compact/carry jits for the dp×gp mesh: batch arrays
         split over dp, the UBODT's bucket ranges over gp, probes resolved
         with collectives inside (the plain sharded-jit path cannot express
@@ -237,11 +311,11 @@ class SegmentMatcher:
 
         def body_compact(dg, du, xin, p):
             return match_batch_compact_packed(
-                dg, du.with_shard_axis(GRAPH_AXIS), xin, p, k)
+                dg, du.with_shard_axis(GRAPH_AXIS), xin, p, k, kernel)
 
         def body_carry(dg, du, xin, p, carry):
             return match_batch_carry_packed(
-                dg, du.with_shard_axis(GRAPH_AXIS), xin, p, k, carry)
+                dg, du.with_shard_axis(GRAPH_AXIS), xin, p, k, carry, kernel)
 
         bat = P(None, BATCH_AXIS)  # packed arrays: [field, B, T]
         sm_compact = jax.jit(jax.shard_map(
@@ -285,16 +359,19 @@ class SegmentMatcher:
             from ..ops.viterbi import pack_inputs
 
             B = px.shape[0]
-            fn = self._jit_match_scan
+            kernel = self._kernel_for(px.shape[1])
+            fn = self._get_jit("compact", kernel)
             if self._mesh is not None and px.shape[0] % self._n_dp:
                 # dp sharding splits the batch axis evenly across chips
-                px, py, times, valid = _pad_rows(
-                    self._n_dp - px.shape[0] % self._n_dp, px, py, times, valid
+                px, py, times, valid = self._stage_rows(
+                    px.shape[0] + self._n_dp - px.shape[0] % self._n_dp,
+                    px, py, times, valid
                 )
             xin = self._put_packed(pack_inputs(px, py, times, valid))
             t0 = _time.monotonic()
             res = fn(self._dg, self._du, xin, self._params, self.cfg.beam_k)
-            self._note_dispatch(px.shape, _time.monotonic() - t0)
+            C_DISPATCHES.labels(kernel).inc()
+            self._note_dispatch(px.shape, _time.monotonic() - t0, kernel=kernel)
             if self._probe_every:
                 self._dispatch_count += 1
                 if self._dispatch_count % self._probe_every == 0:
@@ -303,27 +380,47 @@ class SegmentMatcher:
             return ("jax", B, res)
         return ("cpu", self._cpu.run_batch(px, py, times, valid))
 
-    def _note_dispatch(self, shape, dt: float, kind: str = "") -> None:
+    def _note_dispatch(self, shape, dt: float, kind: str = "",
+                       kernel: str = "scan") -> None:
         """Feed the compile counters on a shape's first dispatch (the call
         that blocked on XLA).  ``shape`` is the padded (B, T) the kernel
-        compiled for; ``kind`` distinguishes the carry-chain program."""
-        key = (kind,) + tuple(shape)
+        compiled for; ``kind`` distinguishes the carry-chain program and
+        ``kernel`` the viterbi forward (scan / assoc) that compiled."""
+        key = (kind, kernel) + tuple(shape)
         if key in self._compiled_shapes:
             return
         self._compiled_shapes.add(key)
         lbl = kind + "%dx%d" % tuple(shape)
-        C_COMPILES.labels(lbl).inc()
-        C_COMPILE_S.labels(lbl).inc(dt)
+        C_COMPILES.labels(lbl, kernel).inc()
+        C_COMPILE_S.labels(lbl, kernel).inc(dt)
         # structured compile event: the dispatch thread is bound to the
         # batch's lead span (serve) or the micro-batch span (batch
         # pipeline), so this stall is attributable to a real request id
-        obs_log.event(log, "compile_stall", shape=lbl, seconds=round(dt, 3))
+        obs_log.event(log, "compile_stall", shape=lbl, kernel=kernel,
+                      seconds=round(dt, 3))
+
+    def compiled_shape_count(self, T: int, kind: str = "",
+                             kernel: "str | None" = None) -> int:
+        """How many padded shapes with window length T (any batch rung) have
+        already paid their first dispatch — the warmup acceptance probe: a
+        warmed (T, kernel) bucket answers > 0, so the first real request of
+        that bucket cannot record a compile stall."""
+        if kernel is None:
+            kernel = self._kernel_for(T)
+        return sum(
+            1 for key in self._compiled_shapes
+            if key[0] == kind and key[1] == kernel and key[-1] == T
+        )
 
     def _record_probe_stats(self, xin) -> None:
         """Sampled ops/diagnostics.ubodt_probe_stats over an already-packed
-        device batch -> probe-outcome counters.  Any failure disables the
-        sampler (diagnostic only; e.g. the gp-sharded table needs the
-        shard_map path the plain probe program does not speak)."""
+        device batch.  DISPATCH ONLY on this (hot) thread: the program is
+        enqueued asynchronously and the device handle parked on
+        _probe_pending; the blocking np.asarray happens on the collect side
+        (_harvest_probe_stats, called from _collect_batch, where the caller
+        is already paying a device sync).  Any failure disables the sampler
+        (diagnostic only; e.g. the gp-sharded table needs the shard_map path
+        the plain probe program does not speak)."""
         try:
             if self._jit_probe is None:
                 import functools
@@ -336,13 +433,39 @@ class SegmentMatcher:
                     functools.partial(
                         ubodt_probe_stats, delta=float(self.cfg.ubodt_delta)),
                     static_argnums=(4,))
-            stats = np.asarray(self._jit_probe(
-                self._dg, self._du, xin, self._params, self.cfg.beam_k))
-            for i, outcome in enumerate(
-                    ("pairs", "miss", "costly_miss", "beyond_delta")):
-                C_PROBES.labels(outcome).inc(int(stats[i]))
+            res = self._jit_probe(
+                self._dg, self._du, xin, self._params, self.cfg.beam_k)
+            with self._probe_lock:
+                self._probe_pending.append(res)
+                # bound pinned probe results: if no collect ran between two
+                # probe ticks, drain the older one here (still off the
+                # common case's hot path)
+                drain = (self._probe_pending[:-1]
+                         if len(self._probe_pending) > 2 else [])
+                if drain:
+                    del self._probe_pending[:-1]
+            for res in drain:
+                self._consume_probe(res)
         except Exception:  # noqa: BLE001 - never fail a dispatch over a sample
             log.exception("ubodt probe sampling failed; disabling")
+            self._probe_every = 0
+
+    def _consume_probe(self, res) -> None:
+        stats = np.asarray(res)
+        for i, outcome in enumerate(
+                ("pairs", "miss", "costly_miss", "beyond_delta")):
+            C_PROBES.labels(outcome).inc(int(stats[i]))
+
+    def _harvest_probe_stats(self) -> None:
+        """Collect-side drain of dispatched probe programs (the np.asarray
+        sync the dispatch thread no longer pays)."""
+        with self._probe_lock:
+            pending, self._probe_pending = self._probe_pending, []
+        try:
+            for res in pending:
+                self._consume_probe(res)
+        except Exception:  # noqa: BLE001 - diagnostic only, never fail a fetch
+            log.exception("ubodt probe harvest failed; disabling")
             self._probe_every = 0
 
     _host_copy_ok = True  # class-wide: disabled after the first failure
@@ -371,6 +494,8 @@ class SegmentMatcher:
             from ..ops.viterbi import unpack_compact
 
             _, B, res = handle
+            if self._probe_pending:
+                self._harvest_probe_stats()
             edge, offset, breaks = unpack_compact(res)
             return edge[:B], offset[:B], breaks[:B]
         return handle[1]
@@ -443,7 +568,7 @@ class SegmentMatcher:
 
         for blen, idxs in chunks:
             px, py, tm, valid, times = self._fill_rows(traces, idxs, blen)
-            handle = self._dispatch_batch(*self._pad_batch(px, py, tm, valid))
+            handle = self._dispatch_batch(*self._pad_batch_staged(px, py, tm, valid))
             pending.append((idxs, handle, times))
             if len(pending) >= PIPELINE_DEPTH:
                 drain_one()
@@ -576,18 +701,61 @@ class SegmentMatcher:
     _BATCH_LADDER = (1, 4, 16, 64, 128, 256, 512, 1024, 2048)
 
     @classmethod
-    def _pad_batch(cls, px, py, tm, valid):
-        """Pad the batch dimension up to the next ladder rung; dummy rows
-        are all-invalid and sliced off by the caller."""
-        B = px.shape[0]
+    def _ladder_rung(cls, B: int) -> int:
+        """Smallest _BATCH_LADDER rung >= B (next power of two beyond)."""
         B_pad = next((r for r in cls._BATCH_LADDER if r >= B), None)
         if B_pad is None:  # beyond the ladder: next power of two
             B_pad = 1
             while B_pad < B:
                 B_pad <<= 1
+        return B_pad
+
+    @classmethod
+    def _pad_batch(cls, px, py, tm, valid):
+        """Pad the batch dimension up to the next ladder rung; dummy rows
+        are all-invalid and sliced off by the caller.  Allocating variant
+        for classmethod callers (bench/tools); the dispatch hot paths use
+        _pad_batch_staged."""
+        B = px.shape[0]
+        B_pad = cls._ladder_rung(B)
         if B_pad == B:
             return px, py, tm, valid
         return _pad_rows(B_pad - B, px, py, tm, valid)
+
+    def _pad_batch_staged(self, px, py, tm, valid):
+        """_pad_batch through the per-shape pinned staging buffers."""
+        B_pad = self._ladder_rung(px.shape[0])
+        if B_pad == px.shape[0]:
+            return px, py, tm, valid
+        return self._stage_rows(B_pad, px, py, tm, valid)
+
+    def _stage_rows(self, b_pad: int, *arrays):
+        """Batch-pad [B, ...] arrays to b_pad rows through reused pinned
+        staging buffers keyed by (slot, shape): the hot dispatch path pads
+        on EVERY call (ladder rung + dp remainder) and fresh np.concatenate
+        copies reallocated the same megabytes each time.  The pad tail is
+        re-zeroed per call (all-zero rows = all-invalid).  Safe because
+        dispatches are single-threaded per matcher and every consumer
+        (pack_inputs' np.stack, the cpu oracle) copies the rows out before
+        the next dispatch can touch the buffer."""
+        out = []
+        for slot, a in enumerate(arrays):
+            if a.shape[0] == b_pad:
+                out.append(a)
+                continue
+            key = (slot, b_pad) + tuple(a.shape[1:])
+            buf = self._staging.get(key)
+            if buf is None or buf.dtype != a.dtype:
+                if len(self._staging) >= 128:
+                    # long-trace groups key by (B_pad, n_chunks*W): bound the
+                    # pool rather than let exotic shape traffic pin memory
+                    self._staging.clear()
+                buf = np.zeros((b_pad,) + a.shape[1:], a.dtype)
+                self._staging[key] = buf
+            buf[: a.shape[0]] = a
+            buf[a.shape[0]:] = 0
+            out.append(buf)
+        return tuple(out)
 
     def _associate_and_store(self, idxs, edge, offset, breaks, times, results):
         """Wire-format association for B rows (edge may carry pow2 pad rows;
@@ -647,10 +815,11 @@ class SegmentMatcher:
             T_max = max(len(traces[i]["trace"]) for i in group)
             n_chunks = -(-T_max // W)
             px, py, tm, valid, times = self._fill_rows(traces, group, n_chunks * W)
-            px, py, tm, valid = self._pad_batch(px, py, tm, valid)
+            px, py, tm, valid = self._pad_batch_staged(px, py, tm, valid)
             if self._mesh is not None and px.shape[0] % self._n_dp:
-                px, py, tm, valid = _pad_rows(
-                    self._n_dp - px.shape[0] % self._n_dp, px, py, tm, valid
+                px, py, tm, valid = self._stage_rows(
+                    px.shape[0] + self._n_dp - px.shape[0] % self._n_dp,
+                    px, py, tm, valid
                 )
             B_pad = px.shape[0]
 
@@ -664,16 +833,19 @@ class SegmentMatcher:
             # of one sync per chunk.  The wave cap bounds deferred output
             # memory (12*B_pad*W bytes per chunk) so an arbitrarily long
             # trace cannot OOM the accelerator with pinned results.
+            kernel = self._kernel_for(W)
+            fn_carry = self._get_jit("carry", kernel)
             outs, host_parts = [], []
             for c in range(n_chunks):
                 t0 = _time.monotonic()
-                out, carry = self._jit_match_carry(
+                out, carry = fn_carry(
                     self._dg, self._du,
                     self._put_packed(xin[:, :, c * W : (c + 1) * W]),
                     self._params, self.cfg.beam_k, carry,
                 )
+                C_DISPATCHES.labels(kernel).inc()
                 self._note_dispatch((B_pad, W), _time.monotonic() - t0,
-                                    kind="carry")
+                                    kind="carry", kernel=kernel)
                 outs.append(out)  # device handle; fetch deferred
                 if len(outs) >= MAX_DEFERRED_CHUNKS:
                     host_parts.append(
@@ -704,14 +876,33 @@ class SegmentMatcher:
             breaks = np.concatenate([p[2] for p in parts], axis=1)
         return group, (edge, offset, breaks), times
 
-    def warmup(self, lengths: "Sequence[int] | None" = None) -> float:
+    def warmup(self, lengths: "Sequence[int] | None" = None,
+               batch_sizes: "Sequence[int] | None" = None,
+               kernels: "Sequence[str] | None" = None,
+               carry_chain: bool = False) -> float:
         """Pre-compile the hot dispatch shapes so the first real request
         doesn't pay XLA compilation (the streaming operating point is a
         single ~64-pt window per call; a cold compile there blows the
         reference client's 10 s socket budget, HttpClient.java:80-88).
-        Warms one B=1 batch per length bucket by matching a dummy trace
-        along the graph's first edge.  With the persistent compilation
-        cache enabled (utils/jaxenv) a warm restart replays from disk.
+
+        Warms one batch per (batch rung, length bucket, viterbi kernel) by
+        matching dummy traces along the graph's first edge — the full
+        dispatch path, so the jit cache, the staging buffers, and the
+        compile counters all see exactly what a real request would.
+
+          lengths      length buckets to warm (default: cfg.length_buckets)
+          batch_sizes  batch rungs to warm per bucket (default:
+                       cfg.warmup_batch_sizes, i.e. [1]); each entry snaps
+                       UP to its _BATCH_LADDER rung like real traffic
+          kernels      viterbi kernels to warm (default: whatever
+                       _kernel_for resolves per bucket — exactly the
+                       programs live traffic will hit)
+          carry_chain  also warm the carried-state streaming program
+                       (one trace of 2x the largest bucket)
+
+        With the persistent compilation cache enabled
+        ($REPORTER_XLA_CACHE_DIR, utils/jaxenv) a warm restart replays the
+        compiles from disk, so this pass costs dispatch time, not XLA time.
         Returns seconds spent."""
         import time as _time
 
@@ -720,21 +911,49 @@ class SegmentMatcher:
         t0 = _time.time()
         if lengths is None:
             lengths = list(self.cfg.length_buckets)
+        if batch_sizes is None:
+            batch_sizes = list(
+                getattr(self.cfg, "warmup_batch_sizes", None) or (1,))
         ax, ay, bx, by = self._probe_edge_coords()
-        for n in lengths:
-            n = max(2, int(n))
+
+        def _dummy_traces(n: int, b: int):
             xs = np.linspace(ax, bx, n)
             ys = np.linspace(ay, by, n)
             lat, lon = self.arrays.proj.to_latlon(xs, ys)
-            self.match_many([{
+            tr = {
                 "uuid": "_warmup",
                 "trace": [
                     {"lat": float(a), "lon": float(o), "time": 1.0 + 5.0 * i}
                     for i, (a, o) in enumerate(zip(lat, lon))
                 ],
-            }])
-        log.info("matcher warmup: %d shapes in %.1fs", len(lengths), _time.time() - t0)
-        return _time.time() - t0
+            }
+            return [tr] * b
+
+        n_shapes = 0
+        for n in lengths:
+            n = max(2, int(n))
+            want = kernels if kernels is not None else [
+                self._kernel_for(self._bucket_len(n))]
+            for kern in want:
+                prev_mode = self._kernel_mode
+                self._kernel_mode = kern
+                try:
+                    for b in batch_sizes:
+                        b = self._ladder_rung(max(1, int(b)))
+                        self.match_many(_dummy_traces(n, b))
+                        n_shapes += 1
+                        C_WARM_SHAPES.labels(kern).inc()
+                finally:
+                    self._kernel_mode = prev_mode
+        if carry_chain and self.cfg.length_buckets:
+            w = int(self.cfg.length_buckets[-1])
+            self.match_many(_dummy_traces(2 * w, 1))
+            n_shapes += 1
+            C_WARM_SHAPES.labels(self._kernel_for(w)).inc()
+        dt = _time.time() - t0
+        C_WARM_S.inc(dt)
+        log.info("matcher warmup: %d shapes in %.1fs", n_shapes, dt)
+        return dt
 
     def _probe_edge_coords(self):
         """Endpoints of the graph's first edge — the dummy-trace span used

@@ -8,8 +8,16 @@ dynamic program runs on device with static shapes:
     emission     [T, K]    Gaussian in point->candidate distance (sigma_z)
     transition   [K, K]    per step, |route - great_circle| / beta, with the
                            route distance a pure UBODT hash-table gather
-    viterbi      lax.scan over T of a max-plus [K] x [K,K] contraction
-    backtrace    reverse lax.scan over stored backpointers
+    viterbi      two selectable forwards (the ``kernel`` static arg):
+                   scan   lax.scan over T of a max-plus [K] x [K,K]
+                          contraction — O(T) depth, minimal work
+                   assoc  segmented jax.lax.associative_scan over per-step
+                          max-plus [K, K] affine maps — O(log T) depth for
+                          the score chain (arXiv:2102.05743's max-plus
+                          matrix-product formulation), O(T K^3 log T) work
+    backtrace    reverse lax.scan over stored backpointers (scan kernel) or
+                 log-depth associative composition of [K+1] index maps
+                 (assoc kernel)
 
 vmap over the batch axis gives [B, T, K]; pjit/shard_map over a device mesh
 shards B (reporter_tpu/parallel).  No data-dependent control flow anywhere.
@@ -178,11 +186,17 @@ class TraceCarry(NamedTuple):
 
 
 def match_trace(dg: DeviceGraph, du: DeviceUBODT, px, py, times, valid, p: MatchParams, k: int,
-                carry: "TraceCarry | None" = None):
+                carry: "TraceCarry | None" = None, kernel: str = "scan"):
     """Match one trace of T (padded) points.  px/py/times/valid: [T].
     vmap over batch.  With ``carry`` (static presence), the first step
     transitions from the carried candidate beam instead of restarting, and
     the updated carry is returned: (MatchResult, TraceCarry).
+
+    ``kernel`` (static) selects the Viterbi forward: "scan" (sequential
+    lax.scan, O(T) depth) or "assoc" (log-depth associative max-plus scan,
+    see _forward_assoc).  Both implement identical break/restart/padding
+    semantics; they may differ only by float-associativity ULPs in the
+    scores, never in the alive/dead or break classification.
 
     ``valid`` must be a contiguous True-prefix (all-False allowed): padding
     lives only at trace tails; traces with interior gaps are split host-side
@@ -249,8 +263,14 @@ def match_trace(dg: DeviceGraph, du: DeviceUBODT, px, py, times, valid, p: Match
             connected0 & ~broke0,
             route0[best_src0, jnp.arange(k)], jnp.inf,
         )
-    xs = (logp_all, route_all, emis[1:], gc, valid[1:])
-    _, (all_scores, all_backptr, all_broke, all_route) = jax.lax.scan(step, init_scores, xs)
+    if kernel == "assoc" and T >= 2:
+        all_scores, all_backptr, all_broke, all_route = _forward_assoc(
+            init_scores, logp_all, route_all, emis, gc, valid, p)
+    elif kernel in ("scan", "assoc"):  # assoc degenerates to scan at T < 2
+        xs = (logp_all, route_all, emis[1:], gc, valid[1:])
+        _, (all_scores, all_backptr, all_broke, all_route) = jax.lax.scan(step, init_scores, xs)
+    else:
+        raise ValueError("unknown viterbi kernel %r" % (kernel,))
 
     # prepend step 0
     scores_mat = jnp.concatenate([init_scores[None], all_scores], axis=0)  # [T, K]
@@ -258,7 +278,10 @@ def match_trace(dg: DeviceGraph, du: DeviceUBODT, px, py, times, valid, p: Match
     breaks = jnp.concatenate([first_break[None], all_broke], axis=0) & valid
     route_in = jnp.concatenate([first_route[None], all_route], axis=0)  # [T, K]
 
-    idx = backtrace(scores_mat, backptr, valid)  # [T]
+    if kernel == "assoc" and T >= 2:
+        idx = backtrace_assoc(scores_mat, backptr, valid)  # [T]
+    else:
+        idx = backtrace(scores_mat, backptr, valid)  # [T]
 
     chosen_score = jnp.take_along_axis(scores_mat, jnp.maximum(idx, 0)[:, None], axis=1)[:, 0]
     chosen_score = jnp.where(idx >= 0, chosen_score, NEG_INF)
@@ -334,9 +357,144 @@ def backtrace(scores_mat: jnp.ndarray, backptr: jnp.ndarray, valid: jnp.ndarray)
     return jnp.concatenate([idx_rev[::-1], last_idx[None]], axis=0)  # [T]
 
 
-def match_batch(dg: DeviceGraph, du: DeviceUBODT, px, py, times, valid, p: MatchParams, k: int) -> MatchResult:
+# -- log-depth (assoc) forward ------------------------------------------------
+#
+# "Temporal Parallelization of Inference in Hidden Markov Models"
+# (arXiv:2102.05743): the Viterbi forward recursion is a max-plus matrix
+# chain, so all T prefixes can be computed in O(log T) depth with
+# jax.lax.associative_scan.  Two extensions are needed for this matcher's
+# semantics:
+#
+#   * break/restart: a step whose sources are all dead (or whose points are
+#     further apart than breakage_distance) RESTARTS the HMM at that step's
+#     emissions.  Restarts with known positions fold into the scan as
+#     segmented max-plus *affine* maps f(s) = flag ? c : s (x) M, which are
+#     closed under composition: (f2 . f1) = (flag1|flag2, M1 (x) M2,
+#     flag2 ? c2 : c1 (x) M2).  Break POSITIONS, however, depend on score
+#     liveness, which no tropical-affine element can express (the restart
+#     fires when scores are all dead — an anti-monotone condition).  They
+#     are recovered exactly by a separate alive-support recursion over
+#     [K] booleans: per-step cost is a [K,K] boolean mask product, ~100x
+#     lighter than the scan kernel's max-plus step, and exact because
+#     aliveness is a pure reachability property (alive == score above
+#     NEG_INF/2; the gap between live and dead scores is ~1e21, so float
+#     rounding can never flip it).
+#
+#   * padding: frozen steps become the identity map (0-diagonal tropical
+#     identity), which composes bit-exactly (s[j] + 0.0 == s[j]).
+#
+# Work/depth tradeoff vs the scan kernel: O(T K^3 log T) flops at O(log T)
+# depth against O(T K^2) at O(T) depth — the assoc kernel trades idle
+# sequential steps for dense [K,K]x[K,K] contractions the MXU can chew.
+# Backpointers need no companion chain: with every prefix score s_{t-1} in
+# hand, backptr_t = argmax_i(s_{t-1}[i] + logp_t[i,j]) is one parallel
+# batched op over t, bit-identical to the scan kernel's per-step argmax
+# whenever the prefix scores agree.
+
+
+def _forward_assoc(init_scores, logp_all, route_all, emis, gc, valid, p: MatchParams):
+    """Log-depth equivalent of the lax.scan forward in match_trace.
+    init_scores [K]; logp_all/route_all [T-1, K, K]; emis [T, K]; gc [T-1];
+    valid [T].  Returns (all_scores, all_backptr, all_broke, all_route),
+    each with leading [T-1], exactly like the sequential scan's stacked
+    outputs."""
+    k = emis.shape[1]
+    valid_t = valid[1:]  # [T-1]
+    feasible = logp_all > NEG_INF / 2  # [T-1, K, K]
+    emis_alive = emis > NEG_INF / 2  # [T, K]
+    hard = gc > p.breakage_distance  # [T-1]
+
+    # (1) alive-support recursion -> exact break flags.  Sequential, but the
+    # carried state is [K] booleans and the per-step op a mask product — the
+    # heavy tropical chain below is what moves to log depth.
+    def sstep(alive, inputs):
+        feas_t, ealive_t, hard_t, valid_step = inputs
+        conn = jnp.any(alive[:, None] & feas_t, axis=0)  # [K]
+        broke = hard_t | ~jnp.any(conn)
+        new_alive = jnp.where(broke, ealive_t, conn & ealive_t)
+        new_alive = jnp.where(valid_step, new_alive, alive)  # padding: freeze
+        return new_alive, broke
+
+    alive0 = init_scores > NEG_INF / 2
+    _, broke_all = jax.lax.scan(
+        sstep, alive0, (feasible, emis_alive[1:], hard, valid_t))  # [T-1]
+
+    # (2) segmented tropical affine maps: element t is f_t(s) =
+    # flag_t ? emis_t : s (x) M_t, with M_t folding the emission into the
+    # transition and padded steps the tropical identity (freeze).
+    eye = jnp.where(jnp.eye(k, dtype=bool), 0.0, NEG_INF)
+    M = logp_all + emis[1:][:, None, :]  # [T-1, K src, K dst]
+    M = jnp.where(valid_t[:, None, None], M, eye[None])
+    flag = broke_all & valid_t
+    c = jnp.where(flag[:, None], emis[1:], NEG_INF)
+
+    def combine(a, b):
+        fa, ma, ca = a
+        fb, mb, cb = b
+        mab = jnp.max(ma[..., :, :, None] + mb[..., None, :, :], axis=-2)
+        ca_b = jnp.max(ca[..., :, None] + mb, axis=-2)
+        return fa | fb, mab, jnp.where(fb[..., None], cb, ca_b)
+
+    flags, ms, cs = jax.lax.associative_scan(combine, (flag, M, c), axis=0)
+    prop = jnp.max(init_scores[None, :, None] + ms, axis=1)  # [T-1, K]
+    all_scores = jnp.where(flags[:, None], cs, prop)
+
+    # (3) backpointers/routes in parallel from the prefix scores — the same
+    # formulas as the sequential step, batched over t.
+    prev_scores = jnp.concatenate([init_scores[None], all_scores[:-1]], axis=0)
+    total = prev_scores[:, :, None] + logp_all  # [T-1, K src, K dst]
+    best_src = jnp.argmax(total, axis=1).astype(jnp.int32)  # [T-1, K]
+    best_val = jnp.max(total, axis=1)
+    connected = best_val > NEG_INF / 2
+    backptr = jnp.where(broke_all[:, None] | ~connected, -1, best_src)
+    backptr = jnp.where(valid_t[:, None], backptr,
+                        jnp.full_like(backptr, -2))  # -2 = padded step
+    all_broke = broke_all & valid_t
+    chosen = jnp.take_along_axis(route_all, best_src[:, None, :], axis=1)[:, 0, :]
+    all_route = jnp.where(connected, chosen, jnp.inf)
+    return all_scores, backptr, all_broke, all_route
+
+
+def backtrace_assoc(scores_mat: jnp.ndarray, backptr: jnp.ndarray, valid: jnp.ndarray) -> jnp.ndarray:
+    """Log-depth backtrace: same contract as ``backtrace``.  Each reverse
+    step is a function idx_{t+1} -> idx_t over the finite domain
+    {-1, 0..K-1}; such maps are [K+1] index vectors (slot K encodes -1) and
+    compose by gather, so the whole chain is one associative_scan."""
+    T, k = scores_mat.shape
+    local = jnp.argmax(scores_mat[: T - 1], axis=1)  # [T-1]
+    local_score = jnp.take_along_axis(
+        scores_mat[: T - 1], local[:, None], axis=1)[:, 0]
+    local = jnp.where(local_score > NEG_INF / 2, local, -1).astype(jnp.int32)
+    bp_next = backptr[1:]  # [T-1, K]
+    # image of n in 0..K-1 (a chosen slot at t+1), then of n = -1 (slot K)
+    maps = jnp.where(valid[1:, None] & (bp_next >= 0),
+                     bp_next.astype(jnp.int32), local[:, None])
+    maps = jnp.concatenate([maps, local[:, None]], axis=1)  # [T-1, K+1]
+    maps = jnp.where(valid[: T - 1, None], maps, -1)
+
+    def compose(a, b):
+        # reverse=True scans the flipped sequence, so ``a`` accumulates the
+        # LATER maps and ``b`` is the next earlier one; the chain walks from
+        # T-1 down (later maps apply first), hence comp[n] = b[enc(a[n])]
+        enc = jnp.where(a >= 0, a, k)
+        return jnp.take_along_axis(b, enc, axis=-1)
+
+    suffix = jax.lax.associative_scan(compose, maps, axis=0, reverse=True)
+    last_local = jnp.argmax(scores_mat[T - 1])
+    last_idx = jnp.where(
+        (scores_mat[T - 1, last_local] > NEG_INF / 2) & valid[T - 1],
+        last_local, -1).astype(jnp.int32)
+    head = suffix[:, jnp.where(last_idx >= 0, last_idx, k)]  # [T-1]
+    return jnp.concatenate([head, last_idx[None]], axis=0)  # [T]
+
+
+def match_batch(dg: DeviceGraph, du: DeviceUBODT, px, py, times, valid, p: MatchParams, k: int,
+                kernel: str = "scan") -> MatchResult:
     """px/py/times/valid: [B, T] -> MatchResult leaves with leading [B]."""
-    return jax.vmap(match_trace, in_axes=(None, None, 0, 0, 0, 0, None, None))(
+    import functools
+
+    fn = functools.partial(match_trace, kernel=kernel)
+    return jax.vmap(fn, in_axes=(None, None, 0, 0, 0, 0, None, None))(
         dg, du, px, py, times, valid, p, k
     )
 
@@ -351,9 +509,10 @@ class CompactMatch(NamedTuple):
     breaks: jnp.ndarray  # [B, T] bool
 
 
-def match_batch_compact(dg: DeviceGraph, du: DeviceUBODT, px, py, times, valid, p: MatchParams, k: int) -> CompactMatch:
+def match_batch_compact(dg: DeviceGraph, du: DeviceUBODT, px, py, times, valid, p: MatchParams, k: int,
+                        kernel: str = "scan") -> CompactMatch:
     """match_batch + on-device gather of the chosen candidate per point."""
-    res = match_batch(dg, du, px, py, times, valid, p, k)
+    res = match_batch(dg, du, px, py, times, valid, p, k, kernel)
     return _compact(res)
 
 
@@ -366,11 +525,15 @@ def _compact(res: MatchResult) -> CompactMatch:
 
 
 def match_batch_carry(dg: DeviceGraph, du: DeviceUBODT, px, py, times, valid,
-                      p: MatchParams, k: int, carry: TraceCarry):
+                      p: MatchParams, k: int, carry: TraceCarry,
+                      kernel: str = "scan"):
     """One chunk of B long traces with carried state.  px/py/times/valid:
     [B, T]; carry leaves have leading [B].  Returns (CompactMatch, carry')."""
+    import functools
+
+    fn = functools.partial(match_trace, kernel=kernel)
     res, carry_out = jax.vmap(
-        match_trace, in_axes=(None, None, 0, 0, 0, 0, None, None, 0)
+        fn, in_axes=(None, None, 0, 0, 0, 0, None, None, 0)
     )(dg, du, px, py, times, valid, p, k, carry)
     return _compact(res), carry_out
 
@@ -422,19 +585,22 @@ def unpack_compact(out):
 
 
 def match_batch_compact_packed(dg: DeviceGraph, du: DeviceUBODT, xin,
-                               p: MatchParams, k: int) -> jnp.ndarray:
+                               p: MatchParams, k: int,
+                               kernel: str = "scan") -> jnp.ndarray:
     """match_batch_compact over a packed [4, B, T] input -> packed [3, B, T]."""
     px, py, times, valid = unpack_inputs(xin)
-    return pack_compact(match_batch_compact(dg, du, px, py, times, valid, p, k))
+    return pack_compact(match_batch_compact(dg, du, px, py, times, valid, p, k, kernel))
 
 
 def match_batch_carry_packed(dg: DeviceGraph, du: DeviceUBODT, xin,
-                             p: MatchParams, k: int, carry: TraceCarry):
+                             p: MatchParams, k: int, carry: TraceCarry,
+                             kernel: str = "scan"):
     """match_batch_carry over a packed [4, B, T] input -> (packed [3, B, T],
     carry').  The carry pytree stays on device between chunks, so it never
     crosses the transport boundary inside a chunk loop."""
     px, py, times, valid = unpack_inputs(xin)
-    cm, carry_out = match_batch_carry(dg, du, px, py, times, valid, p, k, carry)
+    cm, carry_out = match_batch_carry(dg, du, px, py, times, valid, p, k, carry,
+                                      kernel)
     return pack_compact(cm), carry_out
 
 

@@ -1,10 +1,19 @@
-"""CLI: python -m reporter_tpu.serve <config.json> <host:port>
+"""CLI: python -m reporter_tpu.serve [--warmup] <config.json> <host:port>
 
 Mirrors the reference service invocation
 (py/reporter_service.py:278-299: ``reporter_service.py conf address``).
 Env: MATCHER_BIND_ADDR / MATCHER_LISTEN_PORT override the address like the
 reference's container env (README.md Env Var Overrides); THRESHOLD_SEC as in
 reporter_service.py:55-57.
+
+``--warmup``: pre-dispatch EVERY configured (batch rung, length bucket,
+viterbi kernel) shape plus the carried-state streaming program before the
+engine attaches — /report answers retryable 503s until the warm set is
+compiled, and the first accepted request can no longer hit a compile
+stall (docs/performance.md).  Paired with $REPORTER_XLA_CACHE_DIR the
+restart cost is a disk replay, not an XLA compile.  Without the flag the
+background per-bucket warm of the deferred boot runs as before (config
+key "warmup": false disables that entirely).
 """
 
 import logging
@@ -27,6 +36,9 @@ def main(argv):
     # container default (README.md Env Var Overrides: MATCHER_CONF_FILE).
     # With the env set, the single positional may be the bind address.
     args = list(argv[1:])
+    full_warm = "--warmup" in args
+    if full_warm:
+        args = [a for a in args if a != "--warmup"]
     env_conf = os.environ.get("MATCHER_CONF_FILE")
 
     def _parses_as_addr(a):
@@ -46,7 +58,7 @@ def main(argv):
         logging.info("config: %s (from %s)", conf_path, chosen)
     if not conf_path:
         sys.stderr.write(
-            "usage: python -m reporter_tpu.serve <config.json> [host:port]\n"
+            "usage: python -m reporter_tpu.serve [--warmup] <config.json> [host:port]\n"
             "       (or set MATCHER_CONF_FILE)\n")
         return 1
     try:
@@ -118,7 +130,6 @@ def main(argv):
             try:
                 try:
                     matcher = build_matcher(cfg, conf)
-                    service.attach_matcher(matcher)
                 except Exception:
                     # a failed engine build must not leave a zombie
                     # listener returning 503s forever: log and stop the
@@ -127,13 +138,34 @@ def main(argv):
                     threading.Thread(target=httpd.shutdown,
                                      daemon=True).start()
                     return
+                if full_warm:
+                    # --warmup: compile EVERY configured (batch rung,
+                    # length bucket, kernel) shape plus the carry-chain
+                    # program BEFORE the engine attaches, so the first
+                    # accepted request cannot hit a compile stall.  Shape
+                    # by shape so a shutdown can stop between compiles; a
+                    # failure degrades to serving with inline compiles.
+                    try:
+                        for n in matcher.cfg.length_buckets:
+                            if stop_warm.is_set():
+                                break
+                            matcher.warmup(lengths=[n])
+                        if not stop_warm.is_set():
+                            matcher.warmup(lengths=[], carry_chain=True)
+                    except Exception:
+                        logging.exception(
+                            "--warmup pass failed; serving with inline compiles")
+                service.attach_matcher(matcher)
                 logging.info("engine live (backend=%s, %d edges)",
                              matcher.backend, matcher.arrays.num_edges)
-                if conf.get("warmup", True):
-                    # shape-by-shape so a shutdown can stop between
-                    # compiles (an in-flight XLA compile itself is not
-                    # interruptible).  A warmup failure past this point is
-                    # non-fatal: the engine serves, shapes compile inline.
+                if conf.get("warmup", True) and not full_warm:
+                    # background warm of the deferred boot: requests racing
+                    # it just compile their shape inline, exactly as with
+                    # warmup disabled.  Shape-by-shape so a shutdown can
+                    # stop between compiles (an in-flight XLA compile
+                    # itself is not interruptible).  A warmup failure past
+                    # this point is non-fatal: the engine serves, shapes
+                    # compile inline.
                     try:
                         for n in matcher.cfg.length_buckets:
                             if stop_warm.is_set():

@@ -374,6 +374,7 @@ class ReporterService:
             # warming is informational, not a failure state
             "warming": bool(getattr(self, "warming", False)) or m is None,
             "backend": m.backend if m else None,
+            "viterbi_kernel": getattr(m, "_kernel_mode", None) if m else None,
             "devices": int(getattr(m.cfg, "devices", 1)) if m else None,
             "graph_devices": int(getattr(m.cfg, "graph_devices", 1)) if m else None,
             "edges": int(m.arrays.num_edges) if m else None,
@@ -441,6 +442,7 @@ class ReporterService:
             "uptime_s": round(_time.time() - self._t_boot, 1),
             "warming": bool(getattr(self, "warming", False)) or m is None,
             "backend": m.backend if m else None,
+            "viterbi_kernel": getattr(m, "_kernel_mode", None) if m else None,
             "threshold_sec": self.threshold_sec,
             "batch": dict(self._batch_params),
             "latency_buckets_s": list(obs.LATENCY_BUCKETS_S),

@@ -112,6 +112,14 @@ class LocalMatcherClient:
             set(opts.get("transition_levels", [0, 1])),
         )
 
+    def warmup(self, **kw) -> float:
+        """Pre-dispatch the matcher's configured (B, T, kernel) shapes
+        (docs/performance.md): an embedder running the in-process client
+        otherwise pays every compile stall inside its first flush window,
+        which is exactly the streaming path's latency budget."""
+        fn = getattr(self.matcher, "warmup", None)
+        return float(fn(**kw)) if callable(fn) else 0.0
+
     def report_one(self, request: dict) -> Optional[dict]:
         return self.report_many([request])[0]
 

@@ -41,13 +41,19 @@ def enable_compilation_cache(cache_dir: Optional[str] = None) -> str:
     A service restart must not re-pay the full compile set (137 s on TPU in
     round 3 — VERDICT r03 next #3): every product entry point (service,
     batch pipeline, bench, graft entry) calls this via ensure_platform().
-    Set $REPORTER_JAX_CACHE_DIR to relocate, or to "off" / "" (explicitly
-    set empty) to disable.  Returns the effective directory ("" = off)."""
+    Set $REPORTER_XLA_CACHE_DIR (or the legacy spelling
+    $REPORTER_JAX_CACHE_DIR) to relocate, or to "off" / "" (explicitly
+    set empty) to disable.  Paired with a warmup pass (serve --warmup /
+    batch --warmup, docs/performance.md) a restarted process replays every
+    configured shape from disk before taking traffic.  Returns the
+    effective directory ("" = off)."""
     if cache_dir is None:
-        cache_dir = os.environ.get(
-            "REPORTER_JAX_CACHE_DIR",
-            os.path.join(os.path.expanduser("~"), ".cache", "reporter_tpu", "jax"),
-        )
+        cache_dir = os.environ.get("REPORTER_XLA_CACHE_DIR")
+        if cache_dir is None:
+            cache_dir = os.environ.get(
+                "REPORTER_JAX_CACHE_DIR",
+                os.path.join(os.path.expanduser("~"), ".cache", "reporter_tpu", "jax"),
+            )
     if not cache_dir or cache_dir.lower() == "off":
         return ""
     try:

@@ -208,6 +208,96 @@ def test_degenerate_inputs_backend_parity():
                                         json.dumps(_canon(o))[:300])
 
 
+@pytest.mark.parametrize("seed", [3, 17, 31, 53, 67, 89])
+def test_scan_vs_assoc_kernel_wire_identical(seed, monkeypatch):
+    """The log-depth assoc kernel must be wire-identical to the sequential
+    scan kernel: same networks, same fuzz traces (half on-road, half random
+    points with zero-candidate steps and forced breaks) -> byte-identical
+    Match() output, segment-id sequences included.  6 seeds x 18 traces =
+    108 fuzzed traces, satisfying the >=100-trace differential bar."""
+    # this test pins one kernel per matcher; the CI leg that forces
+    # REPORTER_VITERBI=assoc must not collapse both sides to assoc
+    monkeypatch.delenv("REPORTER_VITERBI", raising=False)
+    rng = np.random.default_rng(seed)
+    net = random_network(rng)
+    arrays = build_graph_arrays(net)
+    ubodt = build_ubodt(arrays, delta=2000.0)
+    scan = SegmentMatcher(arrays=arrays, ubodt=ubodt,
+                          config=MatcherConfig(viterbi_kernel="scan"))
+    assoc = SegmentMatcher(arrays=arrays, ubodt=ubodt,
+                           config=MatcherConfig(viterbi_kernel="assoc"))
+    assert scan._kernel_mode == "scan" and assoc._kernel_mode == "assoc"
+
+    traces = random_traces(rng, net, arrays, n_traces=18)
+    # backward jitter on an on-road trace: a stopped vehicle wobbling a few
+    # metres back along the same edge (the small-backward-jitter rule)
+    jig = traces[0]["trace"]
+    if len(jig) > 5:
+        jig[3]["lat"], jig[3]["lon"] = jig[2]["lat"], jig[2]["lon"]
+        jig[4]["lat"] = jig[2]["lat"] - 1e-5
+    out_scan = scan.match_many(traces)
+    out_assoc = assoc.match_many(traces)
+    for i, (a, b) in enumerate(zip(out_scan, out_assoc)):
+        assert a == b, "seed %d trace %d: kernels diverged:\n%s\nvs\n%s" % (
+            seed, i, json.dumps(a)[:400], json.dumps(b)[:400])
+        ids_a = [s.get("segment_id") for s in a["segments"]]
+        ids_b = [s.get("segment_id") for s in b["segments"]]
+        assert ids_a == ids_b
+
+
+def test_scan_vs_assoc_kernel_compact_records():
+    """Kernel-level differential on padded batches: identical CompactMatch
+    records (edge, offset bits, break flags) including all-pad rows and
+    contiguous-padding prefixes of every length."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    from reporter_tpu.ops.viterbi import (
+        MatchParams, match_batch_compact, pack_inputs, unpack_inputs,
+    )
+
+    rng = np.random.default_rng(41)
+    net = random_network(rng)
+    arrays = build_graph_arrays(net)
+    ubodt = build_ubodt(arrays, delta=2000.0)
+    dg, du = arrays.to_device(), ubodt.to_device()
+    cfg = MatcherConfig()
+    p = MatchParams.from_config(cfg)
+    k = cfg.beam_k
+
+    B, T = 8, 24
+    lat0, lon0 = LAT0, LON0
+    lat = lat0 + rng.uniform(-0.002, 0.014, (B, T))
+    lon = lon0 + rng.uniform(-0.002, 0.017, (B, T))
+    px, py = arrays.proj.to_xy(lat.ravel(), lon.ravel())
+    px = np.asarray(px, np.float32).reshape(B, T)
+    py = np.asarray(py, np.float32).reshape(B, T)
+    tm = np.tile(np.arange(T, dtype=np.float32) * 5.0, (B, 1))
+    # contiguous valid prefixes of every flavour: full, tails of assorted
+    # lengths, a single-point row, and an all-pad row
+    valid = np.zeros((B, T), bool)
+    prefix = [T, T - 1, T // 2, 3, 2, 1, 5, 0]
+    for b in range(B):
+        valid[b, : prefix[b]] = True
+
+    fns = {
+        kern: jax.jit(functools.partial(match_batch_compact, kernel=kern),
+                      static_argnums=(7,))
+        for kern in ("scan", "assoc")
+    }
+    xin = pack_inputs(px, py, tm, valid)
+    args = unpack_inputs(jnp.asarray(xin))
+    out = {kern: fn(dg, du, *args, p, k) for kern, fn in fns.items()}
+    for field in ("edge", "offset", "breaks"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(out["scan"], field)),
+            np.asarray(getattr(out["assoc"], field)), err_msg=field)
+    # the all-pad row stays fully unmatched in both
+    np.testing.assert_array_equal(np.asarray(out["assoc"].edge)[7], -1)
+
+
 @pytest.mark.parametrize("seed", [11, 23, 37, 59, 71, 83, 97, 109])
 def test_random_topology_backend_parity(seed):
     rng = np.random.default_rng(seed)

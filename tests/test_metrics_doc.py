@@ -1,5 +1,6 @@
 """Tier-1 wiring for tools/check_metrics.py: every registered metric
-family is documented in docs/observability.md, and vice versa."""
+family — and its label set — is documented in docs/observability.md, and
+vice versa."""
 
 import importlib.util
 import os
@@ -21,3 +22,18 @@ def test_metrics_documented():
     assert code - doc == set(), "undocumented metrics: %r" % sorted(code - doc)
     assert doc - code == set(), "ghost doc entries: %r" % sorted(doc - code)
     assert chk.main() == 0
+
+
+def test_metric_labels_documented():
+    chk = _load_checker()
+    code = chk.registered_labels()
+    doc = chk.documented_labels()
+    drift = {
+        n: (code[n], doc[n]) for n in set(code) & set(doc)
+        if code[n] != doc[n]
+    }
+    assert drift == {}, "label drift (code vs doc): %r" % drift
+    # the kernel-labelled dispatch/compile families must carry their labels
+    # through the AST scan — an empty tuple here means the scan regressed
+    assert code["reporter_compile_total"] == ("shape", "kernel")
+    assert code["reporter_dispatch_total"] == ("kernel",)

@@ -40,7 +40,9 @@ def params():
     return MatchParams.from_config(MatcherConfig())
 
 
-def run_match(device, params, xs, ys, valid=None, times=None):
+def run_match(device, params, xs, ys, valid=None, times=None, kernel="scan"):
+    import functools
+
     import jax
     import jax.numpy as jnp
 
@@ -57,7 +59,8 @@ def run_match(device, params, xs, ys, valid=None, times=None):
         times = jnp.arange(xs.shape[0], dtype=jnp.float32) * 15.0
     else:
         times = jnp.asarray(times, jnp.float32)
-    fn = jax.jit(match_trace, static_argnums=(7,))
+    fn = jax.jit(functools.partial(match_trace, kernel=kernel),
+                 static_argnums=(7,))
     return fn(dg, du, xs, ys, times, valid, params, K)
 
 
@@ -190,6 +193,117 @@ def test_no_candidate_gap(arrays, device, params):
     idx = np.asarray(res.idx)
     assert idx[4] == -1, "point outside search radius must be unmatched"
     assert (idx[:4] >= 0).all() and (idx[5:] >= 0).all()
+
+
+def _assert_kernels_agree(device, params, px, py, valid=None, times=None):
+    """scan and assoc forwards must produce identical idx/breaks and
+    equal finite route distances on the same trace."""
+    a = run_match(device, params, px, py, valid, times, kernel="scan")
+    b = run_match(device, params, px, py, valid, times, kernel="assoc")
+    np.testing.assert_array_equal(np.asarray(a.idx), np.asarray(b.idx))
+    np.testing.assert_array_equal(np.asarray(a.breaks), np.asarray(b.breaks))
+    ra, rb = np.asarray(a.route_dist), np.asarray(b.route_dist)
+    np.testing.assert_array_equal(np.isfinite(ra), np.isfinite(rb))
+    fin = np.isfinite(ra)
+    np.testing.assert_allclose(ra[fin], rb[fin], rtol=1e-5, atol=1e-3)
+
+
+def test_assoc_matches_scan_straight_drive(arrays, device, params):
+    rng = np.random.default_rng(7)
+    row = [2 * 5 + c for c in range(5)]
+    px, py = street_points(arrays, row, 12, jitter=3.0, rng=rng)
+    _assert_kernels_agree(device, params, px, py)
+
+
+def test_assoc_matches_scan_with_breaks(arrays, device, params):
+    """Teleport between distant rows under a tight breakage distance: the
+    assoc kernel's support recursion must place the restart at exactly the
+    same step as the sequential scan."""
+    from reporter_tpu.ops.viterbi import MatchParams
+
+    rng = np.random.default_rng(11)
+    px1, py1 = street_points(arrays, [0 + c for c in range(5)], 6, jitter=2.0, rng=rng)
+    px2, py2 = street_points(arrays, [4 * 5 + c for c in range(5)], 6, jitter=2.0, rng=rng)
+    p = MatchParams.from_config(MatcherConfig(breakage_distance=300.0))
+    px = np.concatenate([px1, px2])
+    py = np.concatenate([py1, py2])
+    _assert_kernels_agree(device, p, px, py)
+    res = run_match(device, p, px, py, kernel="assoc")
+    assert np.asarray(res.breaks)[6], "assoc kernel must flag the teleport"
+
+
+def test_assoc_matches_scan_padding_and_all_pad(arrays, device, params):
+    rng = np.random.default_rng(5)
+    row = [3 * 5 + c for c in range(5)]
+    px, py = street_points(arrays, row, 10, jitter=3.0, rng=rng)
+    T_pad = 16
+    px_p = np.concatenate([px, np.zeros(T_pad - len(px))])
+    py_p = np.concatenate([py, np.zeros(T_pad - len(py))])
+    # contiguous valid prefix with a padded tail
+    valid = np.concatenate([np.ones(len(px), bool), np.zeros(T_pad - len(px), bool)])
+    _assert_kernels_agree(device, params, px_p, py_p, valid)
+    # an all-pad row: every step frozen, every point unmatched
+    none = np.zeros(T_pad, bool)
+    _assert_kernels_agree(device, params, px_p, py_p, none)
+    res = run_match(device, params, px_p, py_p, none, kernel="assoc")
+    assert (np.asarray(res.idx) == -1).all()
+    assert not np.asarray(res.breaks).any()
+
+
+def test_assoc_matches_scan_backward_jitter(arrays, device, params):
+    """Small backward movement within one edge (GPS jitter on a stopped
+    vehicle) takes the lightly-penalised jitter transition, not a break —
+    in both kernels, with the same chosen slots."""
+    rng = np.random.default_rng(23)
+    row = [1 * 5 + c for c in range(5)]
+    px, py = street_points(arrays, row, 10, jitter=1.0, rng=rng)
+    px[4] = px[3] - 3.0  # a few metres backward: jitter, not a loop route
+    px[7] = px[6] - 2.0
+    _assert_kernels_agree(device, params, px, py)
+    res = run_match(device, params, px, py, kernel="assoc")
+    assert not np.asarray(res.breaks)[1:].any()
+
+
+def test_assoc_carry_chain_matches_scan(arrays, device, params):
+    """Chunked long-trace streaming: both kernels must agree on every chunk
+    AND carry identical seam state (same committed slots, same breaks)."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    from reporter_tpu.ops.viterbi import initial_carry_batch, match_batch_carry
+
+    rng = np.random.default_rng(31)
+    dg, du = device
+    B, W, n_chunks = 2, 12, 4
+    fns = {
+        kern: jax.jit(functools.partial(match_batch_carry, kernel=kern),
+                      static_argnums=(7,))
+        for kern in ("scan", "assoc")
+    }
+    carries = {kern: initial_carry_batch(B, K) for kern in fns}
+    row = [2 * 5 + c for c in range(5)]
+    px_all, py_all = street_points(arrays, row, W * n_chunks, jitter=2.0, rng=rng)
+    for c in range(n_chunks):
+        px = np.tile(px_all[c * W: (c + 1) * W], (B, 1)).astype(np.float32)
+        py = np.tile(py_all[c * W: (c + 1) * W], (B, 1)).astype(np.float32)
+        tm = (np.arange(W) + c * W)[None, :].repeat(B, 0).astype(np.float32) * 15.0
+        valid = np.ones((B, W), bool)
+        valid[1, W // 2:] = False  # one row with a padded tail per chunk
+        outs = {}
+        for kern, fn in fns.items():
+            cm, carries[kern] = fn(
+                dg, du, jnp.asarray(px), jnp.asarray(py), jnp.asarray(tm),
+                jnp.asarray(valid), params, K, carries[kern])
+            outs[kern] = cm
+        for field in ("edge", "offset", "breaks"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(outs["scan"], field)),
+                np.asarray(getattr(outs["assoc"], field)), err_msg=field)
+        np.testing.assert_array_equal(
+            np.asarray(carries["scan"].committed),
+            np.asarray(carries["assoc"].committed))
 
 
 def test_batch_vmap_matches_single(arrays, device, params):

@@ -1,0 +1,184 @@
+"""Warmup / compile-stall elimination (docs/performance.md).
+
+The acceptance contract: with a full warmup pass (serve --warmup semantics)
+and a persistent XLA cache dir configured, a (re)started server's first
+/report request records ZERO compile_stall events for configured buckets —
+every first-dispatch compile is paid in the warmup phase, visible in the
+warmup counters, and the request-path compile counters stay flat across
+real traffic on warmed shapes.  Asserted via the obs registry
+(reporter_compile_total / reporter_warmup_shapes_total).
+"""
+
+import numpy as np
+import pytest
+
+from reporter_tpu.matching import MatcherConfig, SegmentMatcher
+from reporter_tpu.obs import metrics as obs
+from reporter_tpu.tiles.arrays import build_graph_arrays
+from reporter_tpu.tiles.network import grid_city
+from reporter_tpu.tiles.ubodt import build_ubodt
+
+
+@pytest.fixture(scope="module")
+def engine():
+    city = grid_city(rows=5, cols=5, spacing_m=150.0)
+    arrays = build_graph_arrays(city, cell_size=100.0)
+    ubodt = build_ubodt(arrays, delta=2000.0)
+    return arrays, ubodt
+
+
+CFG = dict(length_buckets=[16, 32], warmup_batch_sizes=[1])
+
+
+def _compile_total() -> float:
+    snap = obs.REGISTRY.snapshot().get(
+        "reporter_compile_total", {"samples": []})
+    return sum(v for _lv, v in snap["samples"])
+
+
+def _warm_shapes_total() -> float:
+    snap = obs.REGISTRY.snapshot().get(
+        "reporter_warmup_shapes_total", {"samples": []})
+    return sum(v for _lv, v in snap["samples"])
+
+
+def _trace(arrays, n=10, uuid="wm"):
+    xs = np.linspace(float(arrays.node_x.min()) + 5.0,
+                     float(arrays.node_x.max()) - 5.0, n)
+    ys = np.full(n, float(arrays.node_y.min()) + 1.0)
+    lat, lon = arrays.proj.to_latlon(xs, ys)
+    return {
+        "uuid": uuid,
+        "match_options": {"mode": "auto", "report_levels": [0, 1],
+                          "transition_levels": [0, 1]},
+        "trace": [{"lat": float(a), "lon": float(o), "time": 1000 + 5 * i,
+                   "accuracy": 5} for i, (a, o) in enumerate(zip(lat, lon))],
+    }
+
+
+def test_warmed_server_first_request_sees_no_compile_stall(engine, tmp_path, monkeypatch):
+    """serve --warmup + REPORTER_XLA_CACHE_DIR: after the warm pass, the
+    first real request of every configured bucket records zero new
+    compile_stall events — across a simulated restart too."""
+    monkeypatch.setenv("REPORTER_XLA_CACHE_DIR", str(tmp_path / "xla"))
+    from reporter_tpu.utils.jaxenv import enable_compilation_cache
+
+    assert enable_compilation_cache() == str(tmp_path / "xla")
+
+    arrays, ubodt = engine
+    for restart in range(2):  # second round = the restarted server
+        matcher = SegmentMatcher(
+            arrays=arrays, ubodt=ubodt, config=MatcherConfig(**CFG))
+        warmed_before = _warm_shapes_total()
+        matcher.warmup()
+        assert _warm_shapes_total() > warmed_before
+        for bucket in matcher.cfg.length_buckets:
+            assert matcher.compiled_shape_count(bucket) > 0, (restart, bucket)
+
+        from reporter_tpu.serve.service import ReporterService
+
+        service = ReporterService(matcher, max_wait_ms=1.0)
+        before = _compile_total()
+        for n in (10, 16, 30):  # both configured buckets, first requests
+            code, data = service.handle_report(_trace(arrays, n))
+            assert code == 200, data
+        assert _compile_total() == before, (
+            "restart %d: a warmed bucket paid a request-path compile stall"
+            % restart)
+
+
+def test_unwarmed_request_does_record_compile(engine):
+    """Control: without warmup the first request of a bucket IS a compile
+    stall — the counter the warmed path must keep flat actually fires."""
+    arrays, ubodt = engine
+    matcher = SegmentMatcher(
+        arrays=arrays, ubodt=ubodt, config=MatcherConfig(**CFG))
+    before = _compile_total()
+    matcher.match_many([_trace(arrays, 10)])
+    assert _compile_total() == before + 1
+
+
+def test_warmup_covers_kernels_and_batch_rungs(engine, monkeypatch):
+    """warmup(kernels=..., batch_sizes=...) pre-dispatches the full
+    (B, T, kernel) grid, and auto mode warms exactly the kernels live
+    traffic will pick per bucket."""
+    # auto-mode behaviour under test: the assoc-forcing CI leg must not
+    # override the config this test pins
+    monkeypatch.delenv("REPORTER_VITERBI", raising=False)
+    arrays, ubodt = engine
+    matcher = SegmentMatcher(
+        arrays=arrays, ubodt=ubodt,
+        config=MatcherConfig(viterbi_kernel="auto", viterbi_assoc_threshold=32,
+                             length_buckets=[16, 32], warmup_batch_sizes=[1, 4]))
+    matcher.warmup()
+    # auto: bucket 16 -> scan, bucket 32 -> assoc; two rungs each
+    assert matcher.compiled_shape_count(16, kernel="scan") == 2
+    assert matcher.compiled_shape_count(32, kernel="assoc") == 2
+    before = _compile_total()
+    matcher.match_many([_trace(arrays, 12, uuid="a%d" % i) for i in range(3)])
+    matcher.match_many([_trace(arrays, 28, uuid="b%d" % i) for i in range(2)])
+    assert _compile_total() == before
+
+    # explicit kernels warm both forwards for the same shapes
+    m2 = SegmentMatcher(
+        arrays=arrays, ubodt=ubodt, config=MatcherConfig(**CFG))
+    m2.warmup(lengths=[16], kernels=("scan", "assoc"))
+    assert m2.compiled_shape_count(16, kernel="scan") == 1
+    assert m2.compiled_shape_count(16, kernel="assoc") == 1
+
+
+def test_warmup_carry_chain_covers_streaming(engine):
+    arrays, ubodt = engine
+    matcher = SegmentMatcher(
+        arrays=arrays, ubodt=ubodt, config=MatcherConfig(**CFG))
+    matcher.warmup(carry_chain=True)
+    before = _compile_total()
+    matcher.match_many([_trace(arrays, 80)])  # > largest bucket: carry chain
+    assert _compile_total() == before, "carry chain paid a request-path compile"
+
+
+def test_stage_rows_reuses_pinned_buffers(engine):
+    """The batch-pad hot path must stop reallocating: same shape in, same
+    staging buffer out, with the pad tail re-zeroed between uses."""
+    arrays, ubodt = engine
+    matcher = SegmentMatcher(
+        arrays=arrays, ubodt=ubodt, config=MatcherConfig(**CFG))
+    a = np.ones((3, 16), np.float32)
+    out1 = matcher._stage_rows(4, a, a * 2.0)
+    buf_ids = [id(o) for o in out1]
+    assert all(o.shape == (4, 16) for o in out1)
+    assert (out1[0][3] == 0).all()
+    # poison the tail, restage: same buffers, tail re-zeroed
+    out1[1][3] = 7.0
+    b = np.full((2, 16), 5.0, np.float32)
+    out2 = matcher._stage_rows(4, b, b)
+    assert [id(o) for o in out2] == buf_ids
+    assert (out2[1][2:] == 0).all() and (out2[1][:2] == 5.0).all()
+    # distinct slots never share a buffer even at identical shape/dtype
+    assert id(out2[0]) != id(out2[1])
+
+
+def test_probe_stats_deferred_off_dispatch(engine, monkeypatch):
+    """The sampled UBODT probe is dispatched on the hot thread but its
+    np.asarray sync happens on the collect side: after a dispatch tick the
+    probe sits in _probe_pending; the collect drains it into the outcome
+    counters."""
+    monkeypatch.setenv("REPORTER_OBS_PROBE_EVERY", "1")
+    arrays, ubodt = engine
+    matcher = SegmentMatcher(
+        arrays=arrays, ubodt=ubodt, config=MatcherConfig(**CFG))
+    assert matcher._probe_every == 1
+
+    def _pairs_total():
+        snap = obs.REGISTRY.snapshot().get(
+            "reporter_ubodt_probe_total", {"samples": []})
+        return sum(v for lv, v in snap["samples"] if lv == ["pairs"])
+
+    before = _pairs_total()
+    t = _trace(arrays, 10)
+    px, py, tm, valid, _times = matcher._fill_rows([t], [0], 16)
+    handle = matcher._dispatch_batch(px, py, tm, valid)
+    assert len(matcher._probe_pending) == 1, "probe sync ran on dispatch"
+    matcher._collect_batch(handle)
+    assert not matcher._probe_pending
+    assert _pairs_total() > before

@@ -6,7 +6,11 @@ argument to a ``counter``/``gauge``/``histogram`` call with the
 ``reporter_`` prefix) must appear in docs/observability.md's family
 tables, and every name documented there must be registered in code —
 dashboards built from the doc must never dereference a ghost, and code
-must never grow an undocumented family.
+must never grow an undocumented family.  The LABEL SET of each family is
+checked too (the third positional argument of the registration vs the doc
+table's Labels column): a label added in code (e.g. the viterbi ``kernel``
+label on the compile counters) must land in the doc, else every
+dashboard grouping by it is flying blind.
 
 Likewise every action in serve/service.py's ``ACTIONS`` set (the routing
 whitelist) must appear as a ``/<action>`` path in docs/http-api.md: an
@@ -29,13 +33,16 @@ PKG_DIR = os.path.join(REPO, "reporter_tpu")
 DOC = os.path.join(REPO, "docs", "observability.md")
 
 _REGISTER_FNS = {"counter", "gauge", "histogram"}
-# doc table rows only: "| `reporter_...` | type | ..." — prose may mention
-# derived names (_bucket/_sum) without tripping the check
-_DOC_ROW_RE = re.compile(r"^\|\s*`(reporter_[a-z0-9_]+)`", re.M)
+# doc table rows only: "| `reporter_...` | type | labels | ..." — prose may
+# mention derived names (_bucket/_sum) without tripping the check
+_DOC_ROW_RE = re.compile(
+    r"^\|\s*`(reporter_[a-z0-9_]+)`\s*\|[^|]*\|([^|]*)\|", re.M)
 
 
-def registered_names(pkg_dir: str = PKG_DIR) -> "set[str]":
-    names = set()
+def registered_labels(pkg_dir: str = PKG_DIR) -> "dict[str, tuple]":
+    """name -> label-name tuple for every registration call in the package
+    (the third positional argument; () when absent or non-literal)."""
+    out: "dict[str, tuple]" = {}
     for root, _dirs, files in os.walk(pkg_dir):
         for fn in files:
             if not fn.endswith(".py"):
@@ -56,13 +63,35 @@ def registered_names(pkg_dir: str = PKG_DIR) -> "set[str]":
                 a0 = node.args[0]
                 if (isinstance(a0, ast.Constant) and isinstance(a0.value, str)
                         and a0.value.startswith("reporter_")):
-                    names.add(a0.value)
-    return names
+                    labels: tuple = ()
+                    if len(node.args) >= 3 and isinstance(node.args[2], (ast.Tuple, ast.List)):
+                        labels = tuple(
+                            el.value for el in node.args[2].elts
+                            if isinstance(el, ast.Constant)
+                            and isinstance(el.value, str)
+                        )
+                    out[a0.value] = labels
+    return out
+
+
+def registered_names(pkg_dir: str = PKG_DIR) -> "set[str]":
+    return set(registered_labels(pkg_dir))
+
+
+def documented_labels(doc_path: str = DOC) -> "dict[str, tuple]":
+    """name -> label tuple parsed from the family tables' Labels column."""
+    with open(doc_path) as f:
+        text = f.read()
+    out = {}
+    for name, labels in _DOC_ROW_RE.findall(text):
+        out[name] = tuple(
+            l.strip().strip("`") for l in labels.split(",") if l.strip()
+        )
+    return out
 
 
 def documented_names(doc_path: str = DOC) -> "set[str]":
-    with open(doc_path) as f:
-        return set(_DOC_ROW_RE.findall(f.read()))
+    return set(documented_labels(doc_path))
 
 
 SERVICE_PY = os.path.join(PKG_DIR, "serve", "service.py")
@@ -92,8 +121,10 @@ def documented_actions(doc_path: str = API_DOC) -> "set[str]":
 
 
 def main() -> int:
-    code = registered_names()
-    doc = documented_names()
+    code_labels = registered_labels()
+    doc_labels = documented_labels()
+    code = set(code_labels)
+    doc = set(doc_labels)
     rc = 0
     for name in sorted(code - doc):
         print("UNDOCUMENTED: %s (registered in code, missing from "
@@ -103,6 +134,11 @@ def main() -> int:
         print("GHOST: %s (documented but registered nowhere under "
               "reporter_tpu/)" % name)
         rc = 1
+    for name in sorted(code & doc):
+        if code_labels[name] != doc_labels[name]:
+            print("LABEL DRIFT: %s registered with labels %r but documented "
+                  "with %r" % (name, code_labels[name], doc_labels[name]))
+            rc = 1
     actions = served_actions()
     if not actions:
         print("BROKEN: could not parse ACTIONS from serve/service.py")
