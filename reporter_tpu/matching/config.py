@@ -37,6 +37,13 @@ class MatcherConfig:
     # overrides at runtime.
     viterbi_kernel: str = "scan"
     viterbi_assoc_threshold: int = 256
+    # long-trace carry chain (docs/performance.md): True = hoist the
+    # carry-independent work (candidate sweep, emissions, [W-1, K, K]
+    # transition build) out of the per-chunk carry loop and dispatch it
+    # batched across all chunks of a trace group, leaving only the score
+    # recursion to chain; False = the legacy fused per-chunk program.
+    # $REPORTER_LONG_PRECOMPUTE=0|1 overrides at runtime.
+    long_precompute: bool = True
     # batch rungs pre-dispatched per length bucket by warmup passes
     # (serve --warmup / batch --warmup); each snaps up to a ladder rung
     warmup_batch_sizes: List[int] = field(default_factory=lambda: [1])

@@ -52,6 +52,12 @@ C_DISPATCHES = obs.counter(
     "reporter_dispatch_total",
     "Device batch dispatches by viterbi kernel (scan / assoc)",
     ("kernel",))
+C_DISPATCH_COHORT = obs.counter(
+    "reporter_dispatch_cohort_total",
+    "Device dispatches by trace cohort (bucketed = length-bucket batches, "
+    "long = carry-chain groups) and program kind (compact / pre / chain / "
+    "carry; docs/performance.md chunk-batched carry chain)",
+    ("cohort", "kind"))
 C_WARM_SHAPES = obs.counter(
     "reporter_warmup_shapes_total",
     "Shapes pre-dispatched by warmup, by viterbi kernel",
@@ -139,6 +145,14 @@ class SegmentMatcher:
                 "got %r" % (self._kernel_mode,))
         self._assoc_threshold = int(
             getattr(self.cfg, "viterbi_assoc_threshold", 256))
+        # long-trace carry chain: hoisted chunk-batched precompute (default)
+        # vs the legacy fused per-chunk program.  $REPORTER_LONG_PRECOMPUTE
+        # overrides the config for differential testing / rollback.
+        env_lp = os.environ.get("REPORTER_LONG_PRECOMPUTE", "").strip().lower()
+        if env_lp:
+            self._long_pre = env_lp not in ("0", "false", "off", "no")
+        else:
+            self._long_pre = bool(getattr(self.cfg, "long_precompute", True))
         # per-(B_pad,...) pinned staging buffers for batch-dimension padding:
         # the dp-remainder and ladder pads run on every dispatch, and a fresh
         # np.concatenate per call reallocated (and re-faulted) the same
@@ -247,29 +261,47 @@ class SegmentMatcher:
         self._jits: Dict[tuple, object] = {}
 
     def _get_jit(self, kind: str, kernel: str):
-        """Lazily-built jitted forward for (kind in compact|carry, kernel in
-        scan|assoc).  The gp-sharded variants are built through
-        _make_gp_jits; both expose the same packed calling convention."""
+        """Lazily-built jitted forward for (kind in compact|carry|pre|chain,
+        kernel in scan|assoc).  "pre" is the carry-independent long-trace
+        precompute — it contains no viterbi forward, so it is
+        kernel-independent and cached under kernel "none"; "chain" is the
+        carry-dependent remainder it feeds.  The gp-sharded variants are
+        built through _make_gp_jits; all expose packed calling
+        conventions."""
+        if kind == "pre":
+            kernel = "none"
         key = (kind, kernel)
         fn = self._jits.get(key)
         if fn is None:
             if self._n_gp > 1:
-                built = self._make_gp_jits(kernel)
-                self._jits[("compact", kernel)] = built["compact"]
-                self._jits[("carry", kernel)] = built["carry"]
+                if kind == "pre":
+                    self._jits[key] = self._make_gp_pre_jit()
+                else:
+                    built = self._make_gp_jits(kernel)
+                    for kd in ("compact", "carry", "chain"):
+                        self._jits[(kd, kernel)] = built[kd]
             else:
                 import functools
 
                 import jax
 
                 from ..ops.viterbi import (
-                    match_batch_carry_packed, match_batch_compact_packed,
+                    chain_batch_carry_packed, match_batch_carry_packed,
+                    match_batch_compact_packed, precompute_batch_packed,
                 )
 
-                base = (match_batch_compact_packed if kind == "compact"
-                        else match_batch_carry_packed)
-                self._jits[key] = jax.jit(
-                    functools.partial(base, kernel=kernel), static_argnums=(4,))
+                if kind == "pre":
+                    self._jits[key] = jax.jit(
+                        precompute_batch_packed, static_argnums=(4,))
+                else:
+                    base, k_argnum = {
+                        "compact": (match_batch_compact_packed, 4),
+                        "carry": (match_batch_carry_packed, 4),
+                        "chain": (chain_batch_carry_packed, 5),
+                    }[kind]
+                    self._jits[key] = jax.jit(
+                        functools.partial(base, kernel=kernel),
+                        static_argnums=(k_argnum,))
             fn = self._jits[key]
         return fn
 
@@ -317,6 +349,13 @@ class SegmentMatcher:
             return match_batch_carry_packed(
                 dg, du.with_shard_axis(GRAPH_AXIS), xin, p, k, carry, kernel)
 
+        def body_chain(dg, du, pre, xin, p, carry):
+            from ..ops.viterbi import chain_batch_carry_packed
+
+            return chain_batch_carry_packed(
+                dg, du.with_shard_axis(GRAPH_AXIS), pre, xin, p, k, carry,
+                kernel)
+
         bat = P(None, BATCH_AXIS)  # packed arrays: [field, B, T]
         sm_compact = jax.jit(jax.shard_map(
             body_compact, mesh=self._mesh,
@@ -328,11 +367,44 @@ class SegmentMatcher:
             in_specs=(P(), P(GRAPH_AXIS), bat, P(), P(BATCH_AXIS)),
             out_specs=(bat, P(BATCH_AXIS)), check_vma=False,
         ))
+        sm_chain = jax.jit(jax.shard_map(
+            body_chain, mesh=self._mesh,
+            in_specs=(P(), P(GRAPH_AXIS), P(BATCH_AXIS), bat, P(),
+                      P(BATCH_AXIS)),
+            out_specs=(bat, P(BATCH_AXIS)), check_vma=False,
+        ))
         return {
             "compact": lambda dg, du, xin, p, _k: sm_compact(dg, du, xin, p),
             "carry": lambda dg, du, xin, p, _k, carry: sm_carry(
                 dg, du, xin, p, carry),
+            "chain": lambda dg, du, pre, xin, p, _k, carry: sm_chain(
+                dg, du, pre, xin, p, carry),
         }
+
+    def _make_gp_pre_jit(self):
+        """shard_map'd long-trace precompute for the dp×gp mesh: same
+        sharding story as _make_gp_jits (batch over dp, UBODT bucket ranges
+        over gp), kernel-independent — the program contains no viterbi
+        forward.  The TracePre output shards over the batch axis and stays
+        on device for the chain programs."""
+        import jax
+        from jax.sharding import PartitionSpec as P
+
+        from ..ops.viterbi import precompute_batch_packed
+        from ..parallel.mesh import BATCH_AXIS, GRAPH_AXIS
+
+        k = self.cfg.beam_k
+
+        def body_pre(dg, du, xin, p):
+            return precompute_batch_packed(
+                dg, du.with_shard_axis(GRAPH_AXIS), xin, p, k)
+
+        sm_pre = jax.jit(jax.shard_map(
+            body_pre, mesh=self._mesh,
+            in_specs=(P(), P(GRAPH_AXIS), P(None, BATCH_AXIS), P()),
+            out_specs=P(BATCH_AXIS), check_vma=False,
+        ))
+        return lambda dg, du, xin, p, _k: sm_pre(dg, du, xin, p)
 
     def _init_cpu(self):
         from ..baseline.cpu_matcher import CPUViterbiMatcher
@@ -371,6 +443,7 @@ class SegmentMatcher:
             t0 = _time.monotonic()
             res = fn(self._dg, self._du, xin, self._params, self.cfg.beam_k)
             C_DISPATCHES.labels(kernel).inc()
+            C_DISPATCH_COHORT.labels("bucketed", "compact").inc()
             self._note_dispatch(px.shape, _time.monotonic() - t0, kernel=kernel)
             if self._probe_every:
                 self._dispatch_count += 1
@@ -783,17 +856,19 @@ class SegmentMatcher:
     def _dispatch_long(self, traces, idxs):
         """Dispatch carry chains for traces longer than the largest bucket:
         fixed [B, W]-windows with carried Viterbi state (ops/viterbi
-        .TraceCarry), one compile regardless of trace length, no HMM restart
-        at window boundaries.  All chunks of a group are DISPATCHED without
-        fetching: the carry dependency chains them on device, so this
-        enqueues asynchronously and returns handles for _fetch_long -- the
-        caller decides when to pay the host<->device sync.  Mid-dispatch
-        wave flushes (the MAX_DEFERRED_CHUNKS device-memory bound) still
-        fetch inline; only the final wave stays deferred."""
+        .TraceCarry), one compile set regardless of trace length, no HMM
+        restart at window boundaries.  All chunks of a group are DISPATCHED
+        without fetching: the carry dependency chains them on device, so
+        this enqueues asynchronously and returns handles for _fetch_long --
+        the caller decides when to pay the host<->device sync.
+        Mid-dispatch wave flushes (the MAX_DEFERRED_CHUNKS device-memory
+        bound) still fetch inline; only the final wave stays deferred.
+        Per-group program dispatch (hoisted chunk-batched precompute vs the
+        legacy fused per-chunk forward) lives in _dispatch_long_group."""
         import jax
         import jax.numpy as jnp
 
-        from ..ops.viterbi import initial_carry_batch, pack_inputs, unpack_compact
+        from ..ops.viterbi import pack_inputs, unpack_compact
 
         W = self.cfg.length_buckets[-1] if self.cfg.length_buckets else 256
         cap = self._device_cap(W)  # rows per device batch for this window
@@ -821,43 +896,124 @@ class SegmentMatcher:
                     px.shape[0] + self._n_dp - px.shape[0] % self._n_dp,
                     px, py, tm, valid
                 )
-            B_pad = px.shape[0]
-
-            carry = initial_carry_batch(B_pad, self.cfg.beam_k)
-            if self._carry_sharding is not None:
-                carry = jax.device_put(carry, self._carry_sharding)
             xin = pack_inputs(px, py, tm, valid)  # [4, B_pad, n_chunks*W]
-
-            # chunk outputs accumulate ON DEVICE and are fetched in bounded
-            # waves: concat-on-device then one host sync per wave, instead
-            # of one sync per chunk.  The wave cap bounds deferred output
-            # memory (12*B_pad*W bytes per chunk) so an arbitrarily long
-            # trace cannot OOM the accelerator with pinned results.
-            kernel = self._kernel_for(W)
-            fn_carry = self._get_jit("carry", kernel)
-            outs, host_parts = [], []
-            for c in range(n_chunks):
-                t0 = _time.monotonic()
-                out, carry = fn_carry(
-                    self._dg, self._du,
-                    self._put_packed(xin[:, :, c * W : (c + 1) * W]),
-                    self._params, self.cfg.beam_k, carry,
-                )
-                C_DISPATCHES.labels(kernel).inc()
-                self._note_dispatch((B_pad, W), _time.monotonic() - t0,
-                                    kind="carry", kernel=kernel)
-                outs.append(out)  # device handle; fetch deferred
-                if len(outs) >= MAX_DEFERRED_CHUNKS:
-                    host_parts.append(
-                        unpack_compact(jnp.concatenate(outs, axis=2))
-                        if len(outs) > 1 else unpack_compact(outs[0]))
-                    outs.clear()
+            host_parts, outs = self._dispatch_long_group(xin, n_chunks, W)
             dev_tail = None
             if outs:
                 dev_tail = outs[0] if len(outs) == 1 else jnp.concatenate(outs, axis=2)
                 self._start_host_copy(dev_tail)
             handles.append((group, host_parts, dev_tail, times))
         return handles
+
+    def _dispatch_long_group(self, xin, n_chunks: int, W: int,
+                             kernel: "str | None" = None):
+        """Dispatch every device program for ONE padded long-trace group.
+        xin: packed [4, B_pad, n_chunks*W] numpy.  Returns (host_parts,
+        outs): already-fetched (edge, offset, breaks) wave tuples and the
+        still-on-device packed chunk outputs, in chunk order.  Everything
+        enqueues asynchronously; bench.py times exactly this entry point so
+        the measured programs are the dispatched ones.
+
+        Hoisted mode (cfg.long_precompute / $REPORTER_LONG_PRECOMPUTE,
+        default on): the carry-independent work — candidate quadrant sweep,
+        emissions, the [W-1, K, K] transition build — runs BATCHED ACROSS
+        CHUNKS.  The chunk axis folds into the batch axis ([B, n_chunks, W]
+        -> chunk-major [n_chunks*B, W] rows, snapped to the same
+        _BATCH_LADDER rungs as bucketed traffic), so a group's whole
+        precompute is a few wide "pre" dispatches sized by the
+        max_device_points budget, and only the lightweight score recursion
+        ("chain" programs, fixed [B_pad, W] shape) chains through the
+        TraceCarry.  Legacy mode dispatches the fused per-chunk "carry"
+        program, which rebuilds all of the above inside every carry step.
+
+        Chunk outputs accumulate ON DEVICE and are fetched in bounded
+        waves: concat-on-device then one host sync per wave, instead of one
+        sync per chunk.  The wave cap bounds deferred output memory
+        (12*B_pad*W bytes per chunk) so an arbitrarily long trace cannot
+        OOM the accelerator with pinned results."""
+        import jax
+        import jax.numpy as jnp
+
+        from ..ops.viterbi import initial_carry_batch, unpack_compact
+
+        B_pad = xin.shape[1]
+        k = self.cfg.beam_k
+        if kernel is None:
+            kernel = self._kernel_for(W)
+        carry = initial_carry_batch(B_pad, k)
+        if self._carry_sharding is not None:
+            carry = jax.device_put(carry, self._carry_sharding)
+
+        outs, host_parts = [], []
+
+        def _bank(out):
+            outs.append(out)  # device handle; fetch deferred
+            if len(outs) >= MAX_DEFERRED_CHUNKS:
+                host_parts.append(
+                    unpack_compact(jnp.concatenate(outs, axis=2))
+                    if len(outs) > 1 else unpack_compact(outs[0]))
+                outs.clear()
+
+        if not self._long_pre:
+            fn_carry = self._get_jit("carry", kernel)
+            for c in range(n_chunks):
+                t0 = _time.monotonic()
+                out, carry = fn_carry(
+                    self._dg, self._du,
+                    self._put_packed(xin[:, :, c * W : (c + 1) * W]),
+                    self._params, k, carry,
+                )
+                C_DISPATCHES.labels(kernel).inc()
+                C_DISPATCH_COHORT.labels("long", "carry").inc()
+                self._note_dispatch((B_pad, W), _time.monotonic() - t0,
+                                    kind="carry", kernel=kernel)
+                _bank(out)
+            return host_parts, outs
+
+        fn_pre = self._get_jit("pre", "none")
+        fn_chain = self._get_jit("chain", kernel)
+        # chunk-major rows for the precompute: row c*B_pad + b is chunk c of
+        # trace b, so one chunk's rows are a contiguous slice of a wave
+        rows_all = np.ascontiguousarray(
+            xin.reshape(4, B_pad, n_chunks, W)
+            .transpose(0, 2, 1, 3).reshape(4, n_chunks * B_pad, W))
+        # wave sizing: as many chunks per pre dispatch as the device-batch
+        # cap allows — the same B*T memory bound the fused program obeyed,
+        # since the pre wave materialises the [rows, W-1, K, K] transition
+        # tensors the fused program held transiently
+        cpw = max(1, self._device_cap(W) // B_pad)
+        for c0 in range(0, n_chunks, cpw):
+            m = min(cpw, n_chunks - c0)
+            rows = m * B_pad
+            rung = self._ladder_rung(rows)
+            seg = rows_all[:, c0 * B_pad : c0 * B_pad + rows]
+            if rung != rows:
+                # all-zero pad rows = all-invalid; their TracePre slots are
+                # never sliced into a chain below
+                seg = np.concatenate(
+                    [seg, np.zeros((4, rung - rows, W), np.float32)], axis=1)
+            t0 = _time.monotonic()
+            pre = fn_pre(self._dg, self._du, self._put_packed(seg),
+                         self._params, k)
+            C_DISPATCH_COHORT.labels("long", "pre").inc()
+            self._note_dispatch((rung, W), _time.monotonic() - t0,
+                                kind="pre", kernel="none")
+            for i in range(m):
+                c = c0 + i
+                pre_c = jax.tree_util.tree_map(
+                    lambda a: a[i * B_pad : (i + 1) * B_pad], pre)
+                t0 = _time.monotonic()
+                out, carry = fn_chain(
+                    self._dg, self._du, pre_c,
+                    self._put_packed(xin[:, :, c * W : (c + 1) * W]),
+                    self._params, k, carry,
+                )
+                C_DISPATCHES.labels(kernel).inc()
+                C_DISPATCH_COHORT.labels("long", "chain").inc()
+                self._note_dispatch((B_pad, W), _time.monotonic() - t0,
+                                    kind="chain", kernel=kernel)
+                _bank(out)
+        return host_parts, outs
 
     def _fetch_long(self, handle):
         """Block on one _dispatch_long group handle -> (group, (edge,
@@ -897,8 +1053,15 @@ class SegmentMatcher:
           kernels      viterbi kernels to warm (default: whatever
                        _kernel_for resolves per bucket — exactly the
                        programs live traffic will hit)
-          carry_chain  also warm the carried-state streaming program
-                       (one trace of 2x the largest bucket)
+          carry_chain  also warm the carried-state streaming programs
+                       (one trace of 2x the largest bucket).  In the
+                       default hoisted mode that pre-dispatches BOTH long
+                       programs: the chunk-batched "pre" precompute (its
+                       chunk rows snap to the same batch ladder, so the
+                       warmed rung covers the streaming operating point of
+                       1-4 chunks per dispatch wave) and the "chain" score
+                       recursion at [1, W]; legacy mode warms the fused
+                       "carry" program as before
 
         With the persistent compilation cache enabled
         ($REPORTER_XLA_CACHE_DIR, utils/jaxenv) a warm restart replays the
@@ -950,6 +1113,11 @@ class SegmentMatcher:
             self.match_many(_dummy_traces(2 * w, 1))
             n_shapes += 1
             C_WARM_SHAPES.labels(self._kernel_for(w)).inc()
+            if self._long_pre:
+                # the hoisted path dispatched two programs: the chain above
+                # plus the kernel-independent chunk-batched precompute
+                n_shapes += 1
+                C_WARM_SHAPES.labels("none").inc()
         dt = _time.time() - t0
         C_WARM_S.inc(dt)
         log.info("matcher warmup: %d shapes in %.1fs", n_shapes, dt)

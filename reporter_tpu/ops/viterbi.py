@@ -22,6 +22,13 @@ dynamic program runs on device with static shapes:
 vmap over the batch axis gives [B, T, K]; pjit/shard_map over a device mesh
 shards B (reporter_tpu/parallel).  No data-dependent control flow anywhere.
 
+Long traces stream through fixed [B, W] windows with a TraceCarry chained
+across chunks.  Only the score recursion actually depends on the carry, so
+the pipeline is split in two: precompute_trace (candidates, emissions, the
+[T-1, K, K] transition build — batched ACROSS chunks by folding the chunk
+axis into B) and chain_trace (seam transition + recursion + backtrace),
+composed back into match_trace for the bucketed path.
+
 Discontinuity semantics follow Meili: if consecutive points are further apart
 than ``breakage_distance``, or no feasible route connects any candidate pair,
 the HMM restarts at that point and the break is recorded (these surface as
@@ -185,6 +192,47 @@ class TraceCarry(NamedTuple):
         )
 
 
+class TracePre(NamedTuple):
+    """Carry-independent precompute for one trace window: everything the
+    Viterbi forward consumes that does NOT depend on carried state.  For
+    long traces these leaves are built batched across ALL chunks of a group
+    (the chunk axis folded into the batch axis of the bucketed machinery)
+    while only the lightweight score recursion chains through the carry —
+    see matcher._dispatch_long_group and docs/performance.md."""
+
+    cand: Candidates  # [T, K] candidate pool per point
+    emis: jnp.ndarray  # [T, K] emission log-probs
+    logp: jnp.ndarray  # [T-1, K, K] transition log-probs per step
+    route: jnp.ndarray  # [T-1, K, K] route distances per step
+    gc: jnp.ndarray  # [T-1] great-circle metres between consecutive points
+
+
+def precompute_trace(dg: DeviceGraph, du: DeviceUBODT, px, py, times, valid,
+                     p: MatchParams, k: int) -> TracePre:
+    """The carry-independent stage of match_trace: candidate quadrant sweep,
+    emission scores, and the [T-1, K, K] max-plus transition-matrix build.
+    px/py/times/valid: [T].  vmap over batch (precompute_batch_packed)."""
+    cand = find_candidates_batch(dg, px, py, k, p.search_radius)  # [T, K]
+
+    emis = -0.5 * jnp.square(cand.dist / p.sigma_z)  # [T, K]
+    emis = jnp.where(jnp.isfinite(cand.dist), emis, NEG_INF)
+    emis = jnp.where(valid[:, None], emis, NEG_INF)
+
+    gc = jnp.hypot(px[1:] - px[:-1], py[1:] - py[:-1])  # [T-1]
+    dts = times[1:] - times[:-1]  # [T-1]
+
+    # All transition matrices at once: the UBODT hash probes and graph gathers
+    # become one [T-1, K, K] op (further batched [B, ...] by the vmap in
+    # match_batch) instead of T-1 sequential small gathers inside the scan —
+    # the scan in chain_trace carries only the tiny max-plus recursion.
+    src_c = jax.tree_util.tree_map(lambda a: a[:-1], cand)
+    dst_c = jax.tree_util.tree_map(lambda a: a[1:], cand)
+    logp_all, route_all = jax.vmap(
+        transition_matrix, in_axes=(None, None, 0, 0, 0, 0, None)
+    )(dg, du, src_c, dst_c, gc, dts, p)  # [T-1, K, K]
+    return TracePre(cand=cand, emis=emis, logp=logp_all, route=route_all, gc=gc)
+
+
 def match_trace(dg: DeviceGraph, du: DeviceUBODT, px, py, times, valid, p: MatchParams, k: int,
                 carry: "TraceCarry | None" = None, kernel: str = "scan"):
     """Match one trace of T (padded) points.  px/py/times/valid: [T].
@@ -200,26 +248,26 @@ def match_trace(dg: DeviceGraph, du: DeviceUBODT, px, py, times, valid, p: Match
 
     ``valid`` must be a contiguous True-prefix (all-False allowed): padding
     lives only at trace tails; traces with interior gaps are split host-side
-    (the reference's inactivity-gap split, simple_reporter.py:149-163)."""
+    (the reference's inactivity-gap split, simple_reporter.py:149-163).
+
+    Composition of precompute_trace (carry-independent) + chain_trace
+    (carry-dependent) — the long-trace path dispatches the two stages as
+    separate programs so the precompute batches across chunks; fused here,
+    XLA sees the exact same ops for the bucketed path."""
+    pre = precompute_trace(dg, du, px, py, times, valid, p, k)
+    return chain_trace(dg, du, pre, px, py, times, valid, p, k, carry, kernel)
+
+
+def chain_trace(dg: DeviceGraph, du: DeviceUBODT, pre: TracePre, px, py, times,
+                valid, p: MatchParams, k: int,
+                carry: "TraceCarry | None" = None, kernel: str = "scan"):
+    """The carry-dependent stage of match_trace: seam transition from the
+    carried beam (one [K, K] transition_matrix call — ~1/T of the hoisted
+    transition work), score recursion, backtrace, and carry-out.  Consumes
+    a TracePre; semantics identical to the fused match_trace by
+    construction (it IS the tail of that function)."""
     T = px.shape[0]
-    cand = find_candidates_batch(dg, px, py, k, p.search_radius)  # [T, K]
-
-    emis = -0.5 * jnp.square(cand.dist / p.sigma_z)  # [T, K]
-    emis = jnp.where(jnp.isfinite(cand.dist), emis, NEG_INF)
-    emis = jnp.where(valid[:, None], emis, NEG_INF)
-
-    gc = jnp.hypot(px[1:] - px[:-1], py[1:] - py[:-1])  # [T-1]
-    dts = times[1:] - times[:-1]  # [T-1]
-
-    # All transition matrices at once: the UBODT hash probes and graph gathers
-    # become one [T-1, K, K] op (further batched [B, ...] by the vmap in
-    # match_batch) instead of T-1 sequential small gathers inside the scan —
-    # the scan below carries only the tiny max-plus recursion.
-    src_c = jax.tree_util.tree_map(lambda a: a[:-1], cand)
-    dst_c = jax.tree_util.tree_map(lambda a: a[1:], cand)
-    logp_all, route_all = jax.vmap(
-        transition_matrix, in_axes=(None, None, 0, 0, 0, 0, None)
-    )(dg, du, src_c, dst_c, gc, dts, p)  # [T-1, K, K]
+    cand, emis, logp_all, route_all, gc = pre
 
     def step(scores, inputs):
         """scores: [K] running viterbi scores.  One timestep t (1..T-1)."""
@@ -602,6 +650,39 @@ def match_batch_carry_packed(dg: DeviceGraph, du: DeviceUBODT, xin,
     cm, carry_out = match_batch_carry(dg, du, px, py, times, valid, p, k, carry,
                                       kernel)
     return pack_compact(cm), carry_out
+
+
+def precompute_batch_packed(dg: DeviceGraph, du: DeviceUBODT, xin,
+                            p: MatchParams, k: int) -> TracePre:
+    """Carry-independent precompute over a packed [4, B, T] input ->
+    TracePre with leading [B] on every leaf.  For long traces B is
+    B_trace x chunks_per_wave: the chunk axis of a trace group folds into
+    the batch axis, so the candidate sweep, emissions, and the
+    [T-1, K, K] transition build for MANY chunks run as ONE dispatch
+    instead of once per carry step.  The result stays on device and feeds
+    chain_batch_carry_packed chunk by chunk."""
+    px, py, times, valid = unpack_inputs(xin)
+    return jax.vmap(
+        precompute_trace, in_axes=(None, None, 0, 0, 0, 0, None, None)
+    )(dg, du, px, py, times, valid, p, k)
+
+
+def chain_batch_carry_packed(dg: DeviceGraph, du: DeviceUBODT, pre: TracePre,
+                             xin, p: MatchParams, k: int, carry: TraceCarry,
+                             kernel: str = "scan"):
+    """The carry-dependent remainder of match_batch_carry_packed: seam
+    transition + score recursion + backtrace + compact gather over an
+    already-precomputed TracePre (leading [B]).  Returns (packed [3, B, T],
+    carry').  precompute_batch_packed + this == match_batch_carry_packed,
+    op for op."""
+    import functools
+
+    px, py, times, valid = unpack_inputs(xin)
+    fn = functools.partial(chain_trace, kernel=kernel)
+    res, carry_out = jax.vmap(
+        fn, in_axes=(None, None, 0, 0, 0, 0, 0, None, None, 0)
+    )(dg, du, pre, px, py, times, valid, p, k, carry)
+    return pack_compact(_compact(res)), carry_out
 
 
 def initial_carry_batch(b: int, k: int) -> TraceCarry:

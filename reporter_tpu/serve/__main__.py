@@ -140,9 +140,12 @@ def main(argv):
                     return
                 if full_warm:
                     # --warmup: compile EVERY configured (batch rung,
-                    # length bucket, kernel) shape plus the carry-chain
-                    # program BEFORE the engine attaches, so the first
-                    # accepted request cannot hit a compile stall.  Shape
+                    # length bucket, kernel) shape plus the long-trace
+                    # streaming programs (the chunk-batched precompute +
+                    # chain pair by default, the legacy fused carry with
+                    # REPORTER_LONG_PRECOMPUTE=0) BEFORE the engine
+                    # attaches, so the first accepted request cannot hit a
+                    # compile stall.  Shape
                     # by shape so a shutdown can stop between compiles; a
                     # failure degrades to serving with inline compiles.
                     try:

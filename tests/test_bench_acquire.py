@@ -209,3 +209,25 @@ def test_finish_device_kills_ports_open_wedge(monkeypatch, tmp_path):
     assert dj is None
     # killed by the frozen-status watchdog, well before the run budget
     assert bench.STATUS_FROZEN_KILL_S <= now[0] < run_budget - 60
+
+
+def test_last_onchip_provenance():
+    """Every emitted bench line carries the newest verified on-chip
+    capture's provenance (VERDICT r05 next #1c): tpu-platform captures
+    only, newest date, best same-day headline, with the fields the doc
+    schema names."""
+    lo = bench._last_onchip()
+    assert lo is not None, "repo ships on-chip captures; provenance missing"
+    assert lo["file"].startswith("docs/measurements/")
+    assert lo["traces_per_sec"] and lo["captured"]
+    # the 2026-07-31 headline capture (3116 tr/s, device_util 1.0) must win
+    # over the same-day kernel-compare capture (2321 tr/s)
+    assert lo["traces_per_sec"] > 3000
+    # cpu-platform measurement files must never masquerade as chip evidence
+    import glob
+    import json as _json
+    import os as _os
+
+    repo = _os.path.dirname(_os.path.abspath(bench.__file__))
+    src = _json.load(open(_os.path.join(repo, lo["file"])))
+    assert src.get("platform") == "tpu"

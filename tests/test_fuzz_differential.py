@@ -67,12 +67,12 @@ def random_network(rng: np.random.Generator) -> RoadNetwork:
     return net
 
 
-def random_traces(rng: np.random.Generator, net: RoadNetwork, arrays, n_traces: int):
+def random_traces(rng: np.random.Generator, net: RoadNetwork, arrays, n_traces: int,
+                  n_pts: int = 24):
     """Half road-following walks with GPS noise, half uniform random points
     (often far off-road: zero-candidate steps and forced breaks)."""
     traces = []
     for t in range(n_traces):
-        n_pts = 24
         if t % 2 == 0:
             ei = int(rng.integers(0, net.num_edges))
             e = net.edges[ei]
@@ -296,6 +296,116 @@ def test_scan_vs_assoc_kernel_compact_records():
             np.asarray(getattr(out["assoc"], field)), err_msg=field)
     # the all-pad row stays fully unmatched in both
     np.testing.assert_array_equal(np.asarray(out["assoc"].edge)[7], -1)
+
+
+LONG_BUCKETS = [16, 32]  # W=32 windows: 72..96-pt traces stream 3 chunks
+
+
+def _long_matchers(arrays, ubodt, kernel):
+    """(hoisted, legacy) long-trace matchers differing ONLY in the
+    long_precompute flag: chunk-batched precompute + chain programs vs the
+    legacy fused per-chunk carry program."""
+    mk = lambda pre: SegmentMatcher(
+        arrays=arrays, ubodt=ubodt,
+        config=MatcherConfig(viterbi_kernel=kernel,
+                             length_buckets=list(LONG_BUCKETS),
+                             long_precompute=pre))
+    hoisted, legacy = mk(True), mk(False)
+    assert hoisted._long_pre and not legacy._long_pre
+    return hoisted, legacy
+
+
+def _seam_break_trace(net, W=32, n_pts=3 * 32):
+    """A road-following trace whose vehicle teleports to the OTHER end of
+    the bbox exactly at point index W — the HMM break must land precisely
+    on a carry-seam boundary, the hardest case for the hoisted path (the
+    seam transition is the one piece of transition work the chain program
+    still computes itself)."""
+    e = net.edges[0]
+    sh = np.asarray(e.shape, float)
+    f = np.linspace(0, 1, n_pts)
+    lat = np.interp(f, np.linspace(0, 1, len(sh)), sh[:, 0])
+    lon = np.interp(f, np.linspace(0, 1, len(sh)), sh[:, 1])
+    lat, lon = lat.copy(), lon.copy()
+    lat[W:] += 0.05  # ~5.5 km: far beyond breakage_distance (2 km)
+    return {
+        "uuid": "seam-break",
+        "match_options": {"mode": "auto", "report_levels": [0, 1, 2],
+                          "transition_levels": [0, 1, 2]},
+        "trace": [{"lat": float(a), "lon": float(o),
+                   "time": 1000 + 5 * i, "accuracy": 5}
+                  for i, (a, o) in enumerate(zip(lat, lon))],
+    }
+
+
+@pytest.mark.parametrize("seed,kernel", [(7, "scan"), (19, "assoc"),
+                                         (43, "scan"), (61, "assoc")])
+def test_long_hoisted_vs_legacy_wire_identical(seed, kernel, monkeypatch):
+    """Long multi-chunk traces through the hoisted chunk-batched precompute
+    path must be wire-identical to the legacy fused per-chunk carry path —
+    on both viterbi kernels, over fuzzed traces (road-following + random
+    off-road with zero-candidate steps), plus a trace whose break lands
+    exactly on a carry-seam boundary.  4 seeds x 10 long traces spanning
+    2-3 chunks each."""
+    # the CI legs that force a kernel/path via env must not collapse the
+    # two sides of this differential
+    monkeypatch.delenv("REPORTER_VITERBI", raising=False)
+    monkeypatch.delenv("REPORTER_LONG_PRECOMPUTE", raising=False)
+    rng = np.random.default_rng(seed)
+    net = random_network(rng)
+    arrays = build_graph_arrays(net)
+    ubodt = build_ubodt(arrays, delta=2000.0)
+    hoisted, legacy = _long_matchers(arrays, ubodt, kernel)
+
+    traces = random_traces(rng, net, arrays, n_traces=9,
+                           n_pts=int(rng.integers(72, 97)))
+    traces.append(_seam_break_trace(net))
+    out_h = hoisted.match_many(traces)
+    out_l = legacy.match_many(traces)
+    for i, (h, l) in enumerate(zip(out_h, out_l)):
+        assert h == l, "seed %d kernel %s trace %d diverged:\n%s\nvs\n%s" % (
+            seed, kernel, i, json.dumps(h)[:400], json.dumps(l)[:400])
+    # the hoisted path really ran its own programs, not the legacy ones
+    assert any(k[0] == "pre" for k in hoisted._compiled_shapes)
+    assert any(k[0] == "chain" for k in hoisted._compiled_shapes)
+    assert all(k[0] != "carry" for k in hoisted._compiled_shapes)
+
+
+@pytest.mark.parametrize("kernel", ["scan", "assoc"])
+def test_long_hoisted_compact_identical_across_seams(kernel, monkeypatch):
+    """CompactMatch-level differential: the raw (edge, offset-bits, breaks)
+    arrays crossing the device boundary must be IDENTICAL between the
+    hoisted and legacy long paths at every point — including the seam
+    columns, where the chain program's carried-beam transition meets the
+    hoisted per-chunk precompute — and the engineered seam-boundary break
+    must appear at exactly the seam index in both."""
+    monkeypatch.delenv("REPORTER_VITERBI", raising=False)
+    monkeypatch.delenv("REPORTER_LONG_PRECOMPUTE", raising=False)
+    rng = np.random.default_rng(23)
+    net = random_network(rng)
+    arrays = build_graph_arrays(net)
+    ubodt = build_ubodt(arrays, delta=2000.0)
+    hoisted, legacy = _long_matchers(arrays, ubodt, kernel)
+
+    W = LONG_BUCKETS[-1]
+    traces = random_traces(rng, net, arrays, n_traces=5, n_pts=80)
+    traces.append(_seam_break_trace(net, W=W, n_pts=96))
+    idxs = list(range(len(traces)))
+    results = {}
+    for name, m in (("hoisted", hoisted), ("legacy", legacy)):
+        handles = m._dispatch_long(traces, idxs)
+        group_rows, (edge, offset, breaks), _times = m._fetch_long(handles[0])
+        assert len(handles) == 1 and sorted(group_rows) == idxs
+        results[name] = (group_rows, edge, offset, breaks)
+    assert results["hoisted"][0] == results["legacy"][0]
+    for field in (1, 2, 3):
+        np.testing.assert_array_equal(
+            results["hoisted"][field], results["legacy"][field])
+    # the seam-break trace (longest -> row 0 after longest-first ordering)
+    # breaks exactly at the seam column W, in both paths
+    group_rows, edge, offset, breaks = results["hoisted"]
+    row = group_rows.index(len(traces) - 1)
+    assert breaks[row, W], "no break at the engineered seam boundary"
 
 
 @pytest.mark.parametrize("seed", [11, 23, 37, 59, 71, 83, 97, 109])

@@ -84,6 +84,45 @@ def test_no_restart_at_window_boundary(setup):
     assert not breaks[:, 1:].any(), np.argwhere(breaks[:, 1:])
 
 
+def test_break_exactly_at_seam_boundary(setup):
+    """A teleport landing precisely on a chunk seam: the break must be
+    flagged at the seam point (the chain program's carried-beam transition,
+    not the hoisted precompute, owns that step) and the chunked decode must
+    still equal a single-window decode of the same trace."""
+    arrays, ubodt = setup
+    W = 32
+
+    # W points along the grid's bottom row road, then 2W along the top row:
+    # the vehicle teleports the full grid height (~1 km) exactly at point
+    # index W — the first seam with length_buckets [16, 32] — while staying
+    # on-road on both sides, so only the seam step exceeds breakage
+    def _row(y, n, t0):
+        xs = np.linspace(float(arrays.node_x.min()) + 5.0,
+                         float(arrays.node_x.max()) - 5.0, n)
+        lat, lon = arrays.proj.to_latlon(xs, np.full(n, y))
+        return [{"lat": float(a), "lon": float(o), "time": t0 + 5.0 * i}
+                for i, (a, o) in enumerate(zip(lat, lon))]
+
+    trace = {"uuid": "seam", "trace":
+             _row(float(arrays.node_y.min()) + 1.0, W, 1000.0)
+             + _row(float(arrays.node_y.max()) - 1.0, 2 * W, 1000.0 + 5.0 * W)}
+
+    cfg_small = MatcherConfig(length_buckets=[16, W], breakage_distance=800.0)
+    m_small = SegmentMatcher(arrays=arrays, ubodt=ubodt, config=cfg_small)
+    cfg_big = MatcherConfig(length_buckets=[128], breakage_distance=800.0)
+    m_big = SegmentMatcher(arrays=arrays, ubodt=ubodt, config=cfg_big)
+    chunked = m_small.match(trace)
+    whole = m_big.match(trace)
+    assert chunked["segments"]
+    assert chunked == whole
+
+    # the compact records agree too, and the break sits at column W
+    handles = m_small._dispatch_long([trace], [0])
+    _grp, (_edge, _off, breaks), _tm = m_small._fetch_long(handles[0])
+    assert breaks[0, W], "teleport at the seam was not flagged as a break"
+    assert not breaks[0, W + 1 : 2 * W].any()
+
+
 def test_mixed_short_and_long(setup):
     arrays, ubodt = setup
     cfg = MatcherConfig(length_buckets=[16, 32])

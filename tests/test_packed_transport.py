@@ -104,6 +104,58 @@ def test_matcher_output_unchanged_by_wave_size(arrays, ubodt, monkeypatch):
     assert m.match(trace) == ref
 
 
+@pytest.mark.parametrize("kernel", ["scan", "assoc"])
+def test_precompute_chain_composition_bit_identical(arrays, ubodt, kernel):
+    """precompute_batch_packed + chain_batch_carry_packed (the hoisted
+    long-trace program pair) must equal match_batch_carry_packed (the fused
+    legacy program) BIT-exactly: packed outputs and every carry leaf, and
+    again on a second chunk fed the first chunk's carry.  This is the
+    ops-level contract the matcher-level differential
+    (tests/test_fuzz_differential.py) rides on."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    from reporter_tpu.ops.viterbi import (
+        MatchParams, chain_batch_carry_packed, initial_carry_batch,
+        match_batch_carry_packed, pack_inputs, precompute_batch_packed,
+    )
+
+    cfg = MatcherConfig()
+    p = MatchParams.from_config(cfg)
+    k = cfg.beam_k
+    dg, du = arrays.to_device(), ubodt.to_device()
+
+    rng = np.random.default_rng(9)
+    B, T = 4, 20
+    px = rng.uniform(arrays.node_x.min(), arrays.node_x.max(),
+                     (B, T)).astype(np.float32)
+    py = rng.uniform(arrays.node_y.min(), arrays.node_y.max(),
+                     (B, T)).astype(np.float32)
+    tm = np.tile(np.arange(T, dtype=np.float32) * 5.0, (B, 1))
+    valid = np.ones((B, T), bool)
+    valid[2, 7:] = False  # padded tail mid-batch
+    valid[3, :] = False  # all-pad row
+    xin = jnp.asarray(pack_inputs(px, py, tm, valid))
+
+    fused = jax.jit(functools.partial(match_batch_carry_packed, kernel=kernel),
+                    static_argnums=(4,))
+    jpre = jax.jit(precompute_batch_packed, static_argnums=(4,))
+    jchain = jax.jit(functools.partial(chain_batch_carry_packed, kernel=kernel),
+                     static_argnums=(5,))
+
+    carry_f = carry_s = initial_carry_batch(B, k)
+    pre = jpre(dg, du, xin, p, k)
+    for _chunk in range(2):  # second round exercises an ACTIVE carry seam
+        out_f, carry_f = fused(dg, du, xin, p, k, carry_f)
+        out_s, carry_s = jchain(dg, du, pre, xin, p, k, carry_s)
+        np.testing.assert_array_equal(np.asarray(out_f), np.asarray(out_s))
+        for a, b in zip(jax.tree_util.tree_leaves(carry_f),
+                        jax.tree_util.tree_leaves(carry_s)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
 def test_matcher_jax_vs_cpu_after_packing(arrays, ubodt):
     """The packed transport must not perturb the device/oracle diffability
     contract (segment-for-segment identical on clean traces)."""

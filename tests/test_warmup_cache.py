@@ -137,6 +137,48 @@ def test_warmup_carry_chain_covers_streaming(engine):
     assert _compile_total() == before, "carry chain paid a request-path compile"
 
 
+def test_warmup_covers_batched_precompute(engine, monkeypatch):
+    """The hoisted long-trace path dispatches TWO programs per group — the
+    chunk-batched precompute ("pre", kernel-independent) and the score
+    recursion ("chain") — and warmup(carry_chain=True) must cover both:
+    zero request-path compiles for a streamed long trace, across every
+    chunk count whose pre rows snap to the warmed ladder rung."""
+    monkeypatch.delenv("REPORTER_LONG_PRECOMPUTE", raising=False)
+    arrays, ubodt = engine
+    matcher = SegmentMatcher(
+        arrays=arrays, ubodt=ubodt, config=MatcherConfig(**CFG))
+    assert matcher._long_pre, "hoisted mode must be the default"
+    matcher.warmup(carry_chain=True)
+    W = matcher.cfg.length_buckets[-1]
+    assert matcher.compiled_shape_count(W, kind="pre", kernel="none") > 0, (
+        "warmup did not pre-dispatch the batched-precompute program")
+    assert matcher.compiled_shape_count(W, kind="chain") > 0, (
+        "warmup did not pre-dispatch the chain program")
+    assert matcher.compiled_shape_count(W, kind="carry") == 0, (
+        "hoisted mode compiled the legacy fused carry program")
+    before = _compile_total()
+    # 2, 3 and 4 chunks all share the warmed pre rung (rows 2..4 -> rung 4)
+    # and the [1, W] chain shape: first requests must be compile-free
+    for n in (2 * W + 9, 3 * W - 1, 4 * W - 2):
+        matcher.match_many([_trace(arrays, n)])
+    assert _compile_total() == before, (
+        "a warmed long trace paid a request-path compile")
+
+
+def test_legacy_long_path_still_selectable(engine, monkeypatch):
+    """REPORTER_LONG_PRECOMPUTE=0 forces the legacy fused per-chunk carry
+    program — the differential reference must stay dispatchable."""
+    monkeypatch.setenv("REPORTER_LONG_PRECOMPUTE", "0")
+    arrays, ubodt = engine
+    matcher = SegmentMatcher(
+        arrays=arrays, ubodt=ubodt, config=MatcherConfig(**CFG))
+    assert not matcher._long_pre
+    out = matcher.match_many([_trace(arrays, 80)])
+    assert out[0]["segments"]
+    assert any(k[0] == "carry" for k in matcher._compiled_shapes)
+    assert all(k[0] not in ("pre", "chain") for k in matcher._compiled_shapes)
+
+
 def test_stage_rows_reuses_pinned_buffers(engine):
     """The batch-pad hot path must stop reallocating: same shape in, same
     staging buffer out, with the pad tail re-zeroed between uses."""

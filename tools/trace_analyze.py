@@ -85,8 +85,11 @@ def main() -> int:
     if not paths:
         import glob
 
-        paths = sorted(glob.glob(
-            "bench_profile/plugins/profile/*/vm.trace.json.gz"))
+        # default profiler output moved under the ignored scratch dir; the
+        # legacy root-level location is still scanned for old captures
+        paths = sorted(
+            glob.glob("scratch/bench_profile/plugins/profile/*/vm.trace.json.gz")
+            or glob.glob("bench_profile/plugins/profile/*/vm.trace.json.gz"))
     for p in paths:
         out = analyze(p)
         print(json.dumps(out, indent=1))
