@@ -157,11 +157,16 @@ def build_scenario():
     from reporter_tpu.synth import TraceSynthesizer
     from reporter_tpu.tiles.arrays import build_graph_arrays
     from reporter_tpu.tiles.network import grid_city
-    from reporter_tpu.tiles.ubodt import BUCKET as _UBODT_BUCKET, build_ubodt
+    from reporter_tpu.tiles.ubodt import build_ubodt
 
     scenario = os.environ.get("BENCH_SCENARIO", "osm")
     rows = cols = int(os.environ.get("BENCH_GRID", "120"))
     delta = float(os.environ.get("BENCH_DELTA", "3000"))
+    # UBODT memory layout (docs/performance.md): built here with the same
+    # env the matcher resolves, so the table is packed once, not repacked
+    # at matcher construction
+    layout = (os.environ.get("REPORTER_UBODT_LAYOUT", "").strip().lower()
+              or "cuckoo")
     t0 = time.time()
     if scenario == "osm":
         from reporter_tpu.synth.osm_city import realistic_city_network
@@ -172,13 +177,15 @@ def build_scenario():
     arrays = build_graph_arrays(city, cell_size=100.0)
     t_graph = time.time() - t0
     t0 = time.time()
-    ubodt = build_ubodt(arrays, delta=delta)
+    ubodt = build_ubodt(arrays, delta=delta, layout=layout)
     _stderr(
         "scenario %s: graph %d nodes / %d edges (%.1fs); ubodt %d rows, "
-        "table %.0f MB, load %.2f, max kick chain %d (%.1fs native build)"
+        "table %.0f MB (%s), load %.2f, max kick chain %d (%.1fs native "
+        "build)"
         % (scenario, arrays.num_nodes, arrays.num_edges, t_graph,
-           ubodt.num_rows, ubodt.packed.nbytes / 1e6,
-           ubodt.num_rows / max(ubodt.packed.shape[0] * _UBODT_BUCKET, 1),
+           ubodt.num_rows, ubodt.packed.nbytes / 1e6, ubodt.layout,
+           ubodt.num_rows / max(
+               ubodt.packed.shape[0] * ubodt.bucket_entries, 1),
            ubodt.max_kicks,
            time.time() - t0)
     )
@@ -444,16 +451,20 @@ def run_device() -> int:
 
     # HBM-traffic model for the roofline (VERDICT r03 weak #5): the two
     # dominant gather streams per trace are the UBODT transition probes
-    # (2 x 512-byte bucket rows per [T-1, K, K] entry) and the candidate
-    # sweep (9 cell rows of cap 32-byte records per point).
-    from reporter_tpu.tiles.ubodt import BUCKET, ROW_W
+    # (max_probes bucket rows per [T-1, K, K] entry: 2 x 512 B cuckoo /
+    # 1 x 1 KB wide32) and the candidate sweep (9 cell rows of cap 32-byte
+    # records per point).  Probe dedup lowers the EXECUTED row count below
+    # this model (per-dispatch, data-dependent), so with dedup on the
+    # roofline is an upper bound on probe traffic.
+    from reporter_tpu.tiles.ubodt import ROW_W
 
     grid_cap = int(arrays.grid_items.shape[1])
     hbm_peak = float(os.environ.get("BENCH_HBM_GBS", "819")) * 1e9  # v5e
 
     def _bytes_per_trace(T: int) -> int:
         k = cfg.beam_k
-        ubodt_b = (T - 1) * k * k * 2 * (BUCKET * ROW_W * 4)
+        row_bytes = ubodt.bucket_entries * ROW_W * 4
+        ubodt_b = (T - 1) * k * k * ubodt.max_probes * row_bytes
         cand_b = T * 9 * grid_cap * 32  # nine cell rows of cap records
         return ubodt_b + cand_b
 
@@ -642,17 +653,22 @@ def run_device() -> int:
     # hold the route at this delta.  docs/ubodt-delta.md carries the
     # delta-sweep evidence behind the default.
     ubodt_miss = None
+    probe_dedup = None
     try:
         from reporter_tpu.ops.diagnostics import ubodt_probe_stats
 
         jstats = jax.jit(ubodt_probe_stats, static_argnums=(4,))
         delta_m = float(os.environ.get("BENCH_DELTA", "3000"))
-        tot = np.zeros(4, np.int64)
+        tot = np.zeros(5, np.int64)
+        by_cohort_distinct = {}
         for cname, T, ss in cohorts:
             px, py, tm, valid = cohort_xy[cname]
             xin = jnp.asarray(pack_inputs(px, py, tm, valid))
-            tot += np.asarray(
+            st = np.asarray(
                 jstats(dg, du, xin, params, cfg.beam_k, delta_m), np.int64)
+            tot += st
+            by_cohort_distinct[cname] = round(
+                int(st[0]) / max(int(st[4]), 1), 2)
         pairs = int(tot[0])
         ubodt_miss = {
             "probe_pairs": pairs,
@@ -661,7 +677,18 @@ def run_device() -> int:
             "provable_delta_trunc_frac": round(int(tot[3]) / max(pairs, 1), 5),
             "delta_m": delta_m,
         }
-        _stderr("ubodt probes: %s" % (ubodt_miss,))
+        # in-batch probe redundancy: pairs / distinct per dispatch — the
+        # factor the dedup path removes (docs/performance.md memory-system
+        # section; the ratio is per-cohort because dedup sorts per
+        # dispatch, and summing distinct counts across dispatches would
+        # overstate the redundancy)
+        probe_dedup = {
+            "enabled": bool(getattr(matcher, "_probe_dedup", False)),
+            "probe_pairs": pairs,
+            "distinct_pairs": int(tot[4]),
+            "dedup_ratio_by_cohort": by_cohort_distinct,
+        }
+        _stderr("ubodt probes: %s  dedup: %s" % (ubodt_miss, probe_dedup))
     except Exception as e:  # noqa: BLE001 - diagnostics must not sink the bench
         _stderr("ubodt probe stats failed: %s" % (e,))
 
@@ -747,6 +774,7 @@ def run_device() -> int:
         "warmup_s": round(warmup_s, 1),
         "agreement": round(agr_mean, 4),
         "ubodt_miss": ubodt_miss,
+        "probe_dedup": probe_dedup,
         "oracle_cmp": oracle_cmp,
         "agreement_by_cohort": agreement,
         "device_mb": round(hbm_mb, 1),
@@ -754,7 +782,9 @@ def run_device() -> int:
         "scenario": scenario,
         "edges": int(arrays.num_edges),
         "ubodt_rows": int(ubodt.num_rows),
-        "ubodt_load": round(ubodt.num_rows / max(ubodt.packed.shape[0] * BUCKET, 1), 3),
+        "ubodt_layout": ubodt.layout,
+        "ubodt_load": round(ubodt.num_rows / max(
+            ubodt.packed.shape[0] * ubodt.bucket_entries, 1), 3),
         "ubodt_max_probes": ubodt.max_probes,
         "ubodt_max_kicks": int(ubodt.max_kicks),
     }))
@@ -1276,8 +1306,10 @@ def main() -> int:
               "latency_cohort", "e2e_mode", "forward_by_cohort", "kernel_traces_per_sec",
               "kernel_points_per_sec", "kernel_by_cohort",
               "kernel_secs_by_cohort", "dispatch_by_cohort", "roofline", "profile_dir",
-              "device_util", "warmup_s", "agreement", "ubodt_miss", "oracle_cmp", "agreement_by_cohort", "device_mb",
-              "fleet", "scenario", "edges", "ubodt_rows", "ubodt_load", "ubodt_max_probes",
+              "device_util", "warmup_s", "agreement", "ubodt_miss", "probe_dedup",
+              "oracle_cmp", "agreement_by_cohort", "device_mb",
+              "fleet", "scenario", "edges", "ubodt_rows", "ubodt_layout",
+              "ubodt_load", "ubodt_max_probes",
               "ubodt_max_kicks"):
         if k in device_json:
             out[k] = device_json[k]

@@ -259,13 +259,18 @@ struct Pin {
 // first_edge, pad, pad, pad) entries; kBucket*kRowW = 128 int32 = one TPU
 // lane row per bucket.  Mirrors tiles/ubodt.py exactly.
 constexpr int64_t kBucket = 16;
+constexpr int64_t kWideBucket = 32;  // single-hash wide32 layout
 constexpr int64_t kRowW = 8;
 constexpr int64_t kMaxKicks = 500;
 enum { F_SRC = 0, F_DST = 1, F_DIST = 2, F_TIME = 3, F_FE = 4 };
 
 struct UbodtView {
-  const int32_t* packed;  // [n_buckets * kBucket * kRowW]
+  const int32_t* packed;  // [n_buckets * entries * kRowW]
   int64_t bmask;          // n_buckets - 1
+  // entries per bucket: kBucket = 2-choice cuckoo (two home buckets),
+  // anything else = single-hash wide layout (one home bucket).  Mirrors
+  // tiles/ubodt.py's layout tag.
+  int64_t entries;
 };
 
 inline uint32_t pair_hash(uint32_t s, uint32_t d, int64_t mask) {
@@ -286,13 +291,15 @@ inline uint32_t pair_hash2(uint32_t s, uint32_t d, int64_t mask) {
 
 // (first_edge) of the shortest src->dst row, or -1 on miss.
 inline int32_t ubodt_first_edge(const UbodtView& u, int32_t src, int32_t dst) {
+  const int64_t be = u.entries;
   uint32_t b1 = pair_hash((uint32_t)src, (uint32_t)dst, u.bmask);
-  const int32_t* e = u.packed + (int64_t)b1 * kBucket * kRowW;
-  for (int64_t s = 0; s < kBucket; ++s, e += kRowW)
+  const int32_t* e = u.packed + (int64_t)b1 * be * kRowW;
+  for (int64_t s = 0; s < be; ++s, e += kRowW)
     if (e[F_SRC] == src && e[F_DST] == dst) return e[F_FE];
+  if (be != kBucket) return -1;  // wide layout: single home bucket
   uint32_t b2 = pair_hash2((uint32_t)src, (uint32_t)dst, u.bmask);
-  e = u.packed + (int64_t)b2 * kBucket * kRowW;
-  for (int64_t s = 0; s < kBucket; ++s, e += kRowW)
+  e = u.packed + (int64_t)b2 * be * kRowW;
+  for (int64_t s = 0; s < be; ++s, e += kRowW)
     if (e[F_SRC] == src && e[F_DST] == dst) return e[F_FE];
   return -1;
 }
@@ -638,8 +645,10 @@ int32_t rn_associate_batch(
     const int32_t* edge_seg, const float* edge_seg_off,
     const uint8_t* edge_internal, const int64_t* edge_way,
     const int64_t* seg_ids, const float* seg_len,
-    // ubodt (packed cuckoo table, [n_buckets * kBucket * kRowW] int32)
-    const int32_t* t_packed, int64_t bmask, int64_t ubodt_rows,
+    // ubodt (packed table, [n_buckets * entries * kRowW] int32; entries =
+    // kBucket cuckoo / kWideBucket wide32)
+    const int32_t* t_packed, int64_t bmask, int64_t ubodt_entries,
+    int64_t ubodt_rows,
     // matches
     int64_t B, int64_t T, const int32_t* m_edge, const float* m_offset,
     const uint8_t* m_break, const double* m_time, const int32_t* n_points,
@@ -653,7 +662,7 @@ int32_t rn_associate_batch(
     int64_t* way_ids_out) {
   AssocInputs in = {edge_from, edge_to,  edge_len, edge_seg, edge_seg_off,
                     edge_internal, edge_way, seg_ids,  seg_len,
-                    {t_packed, bmask},
+                    {t_packed, bmask, ubodt_entries},
                     ubodt_rows, T, m_edge, m_offset, m_break, m_time,
                     n_points, queue_thresh_mps, back_tol};
   CallerSink sink;
@@ -703,8 +712,10 @@ int32_t rn_associate_batch_mt(
     const int32_t* edge_seg, const float* edge_seg_off,
     const uint8_t* edge_internal, const int64_t* edge_way,
     const int64_t* seg_ids, const float* seg_len,
-    // ubodt (packed cuckoo table, [n_buckets * kBucket * kRowW] int32)
-    const int32_t* t_packed, int64_t bmask, int64_t ubodt_rows,
+    // ubodt (packed table, [n_buckets * entries * kRowW] int32; entries =
+    // kBucket cuckoo / kWideBucket wide32)
+    const int32_t* t_packed, int64_t bmask, int64_t ubodt_entries,
+    int64_t ubodt_rows,
     // matches
     int64_t B, int64_t T, const int32_t* m_edge, const float* m_offset,
     const uint8_t* m_break, const double* m_time, const int32_t* n_points,
@@ -718,7 +729,7 @@ int32_t rn_associate_batch_mt(
     int64_t* way_ids_out, int64_t* needed_rec, int64_t* needed_way) {
   AssocInputs in = {edge_from, edge_to,  edge_len, edge_seg, edge_seg_off,
                     edge_internal, edge_way, seg_ids,  seg_len,
-                    {t_packed, bmask},
+                    {t_packed, bmask, ubodt_entries},
                     ubodt_rows, T, m_edge, m_offset, m_break, m_time,
                     n_points, queue_thresh_mps, back_tol};
   if (num_threads <= 0) {
@@ -1027,6 +1038,46 @@ int64_t rn_cuckoo_pack(int64_t n_rows, const int32_t* src, const int32_t* dst,
     if (!placed) return -1;
   }
   return max_chain;
+}
+
+// Single-hash wide-bucket packing (the wide32 layout), identical to
+// tiles/ubodt._pack_wide_python: each row lands in the first free slot of
+// its single home bucket (pair_hash), in input row order — no kick chains.
+// `packed` is the caller's [n_buckets * kWideBucket * kRowW] int32 array.
+// Returns the fullest bucket's occupancy, or -1 when a bucket overflows
+// kWideBucket entries (caller doubles n_buckets and retries; a
+// ~1e-8/bucket event at the wide sizing target).
+int64_t rn_wide_pack(int64_t n_rows, const int32_t* src, const int32_t* dst,
+                     const float* dist, const float* time, const int32_t* fe,
+                     int64_t n_buckets, int32_t* packed) {
+  const int64_t bmask = n_buckets - 1;
+  for (int64_t i = 0; i < n_buckets * kWideBucket * kRowW; ++i) packed[i] = 0;
+  for (int64_t b = 0; b < n_buckets * kWideBucket; ++b)
+    packed[b * kRowW + F_SRC] = -1;
+  auto bits = [](float f) -> int32_t {
+    int32_t v;
+    std::memcpy(&v, &f, sizeof v);
+    return v;
+  };
+  // entries are never removed, so the first free slot is just a per-bucket
+  // fill counter — the same rank-within-bucket placement the vectorised
+  // Python twin computes
+  std::vector<int32_t> fill((size_t)n_buckets, 0);
+  int64_t max_fill = 0;
+  for (int64_t r = 0; r < n_rows; ++r) {
+    int64_t b = pair_hash((uint32_t)src[r], (uint32_t)dst[r], bmask);
+    int32_t s = fill[(size_t)b]++;
+    if (s >= kWideBucket) return -1;
+    int32_t* e = packed + (b * kWideBucket + s) * kRowW;
+    for (int64_t i = 0; i < kRowW; ++i) e[i] = 0;
+    e[F_SRC] = src[r];
+    e[F_DST] = dst[r];
+    e[F_DIST] = bits(dist[r]);
+    e[F_TIME] = bits(time[r]);
+    e[F_FE] = fe[r];
+    if (s + 1 > max_fill) max_fill = s + 1;
+  }
+  return max_fill;
 }
 
 }  // extern "C"

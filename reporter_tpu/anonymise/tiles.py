@@ -128,7 +128,10 @@ def observations_for_report(
     """Expand one datastore report across its time buckets
     (simple_reporter.py:178-196).  max_buckets guards against reports whose
     span exceeds the window that produced them."""
-    duration = int(round(r["t1"] - r["t0"]))
+    # Java Math.round semantics (half-up, floor(x + 0.5)) to stay on the
+    # reference's wire for exact-half durations — Python's banker's round
+    # would write 26 where the reference writes 27 (test_parity_fixtures)
+    duration = int(math.floor((r["t1"] - r["t0"]) + 0.5))
     start = int(math.floor(r["t0"]))
     end = int(math.ceil(r["t1"]))
     min_bucket = start // quantisation

@@ -1,0 +1,228 @@
+"""Brute-force third matcher: the oracle-independence counterweight.
+
+VERDICT r05 weak #4 / next #9: the CPU oracle (cpu_matcher.py) has been
+made deliberately bit-exact with the device kernel — f32 cell math, the
+quadrant sweep's pool truncation, the UBODT's delta bound — which makes
+the backend diff blind to a bug in any rule BOTH sides share.  This
+matcher is the counterweight: the same HMM *semantics*, implemented with
+none of the shared machinery —
+
+  * exhaustive candidates: every edge is scanned, point-to-segment
+    distance in float64 — no spatial grid, no f32 cell arithmetic, no
+    4K-pool truncation, no beam cap (tiny fixtures keep the candidate
+    count within the device's K so the comparison stays meaningful;
+    ``candidate_counts`` lets a test assert that precondition);
+  * exact route distances: a fresh Dijkstra per (node, node) probe in
+    float64 over the raw adjacency — no UBODT, no delta truncation, no
+    hash tables (memoised per source node, which changes nothing
+    semantically);
+  * float64 scoring end to end.
+
+It is deliberately slow (tiny fixtures only) and deliberately structured
+differently from both production matchers.  The triple-agreement test
+(tests/test_brute_oracle.py) requires jax == cpu == brute on several
+topologies; a shared-rule bug now needs to be independently re-invented
+here to stay hidden.
+"""
+
+from __future__ import annotations
+
+import heapq
+import math
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+NEG_INF = -1e30
+
+
+class BruteForceMatcher:
+    """Exhaustive-candidate, exact-Dijkstra, float64 HMM matcher."""
+
+    def __init__(self, arrays, cfg):
+        self.a = arrays
+        self.cfg = cfg
+        self._route_cache: Dict[int, Tuple[Dict[int, float], Dict[int, float]]] = {}
+
+    # -- exhaustive candidates (float64, no grid) ---------------------------
+
+    def candidates(self, x: float, y: float) -> List[Tuple[int, float, float]]:
+        """[(edge, offset_m, dist_m)] for EVERY edge within search_radius,
+        nearest first.  Distances in float64 against every shape segment of
+        every edge — no spatial index at all."""
+        a = self.a
+        best: Dict[int, Tuple[float, float]] = {}  # edge -> (dist, offset)
+        for s in range(len(a.shp_edge)):
+            e = int(a.shp_edge[s])
+            ax, ay = float(a.shp_ax[s]), float(a.shp_ay[s])
+            bx, by = float(a.shp_bx[s]), float(a.shp_by[s])
+            vx, vy = bx - ax, by - ay
+            L2 = vx * vx + vy * vy
+            t = 0.0 if L2 == 0.0 else max(
+                0.0, min(1.0, ((x - ax) * vx + (y - ay) * vy) / L2))
+            dx, dy = x - (ax + t * vx), y - (ay + t * vy)
+            d = math.hypot(dx, dy)
+            if d > float(self.cfg.search_radius):
+                continue
+            off = float(a.shp_off[s]) + t * float(a.shp_len[s])
+            if e not in best or d < best[e][0]:
+                best[e] = (d, off)
+        out = [(e, off, d) for e, (d, off) in best.items()]
+        out.sort(key=lambda c: c[2])
+        return out
+
+    # -- exact route distances (float64 Dijkstra, no UBODT) -----------------
+
+    def _routes_from(self, src: int):
+        """(dist, time) maps from node src over the whole graph — exact,
+        unbounded.  Cached per source (pure memoisation)."""
+        hit = self._route_cache.get(src)
+        if hit is not None:
+            return hit
+        a = self.a
+        dist = {src: 0.0}
+        time = {src: 0.0}
+        done = set()
+        heap = [(0.0, src)]
+        while heap:
+            d, n = heapq.heappop(heap)
+            if n in done:
+                continue
+            done.add(n)
+            for k in range(int(a.out_start[n]), int(a.out_start[n + 1])):
+                e = int(a.out_edges[k])
+                m = int(a.edge_to[e])
+                nd = d + float(a.edge_len[e])
+                if nd < dist.get(m, math.inf):
+                    dist[m] = nd
+                    time[m] = time[n] + float(a.edge_len[e]) / max(
+                        float(a.edge_speed[e]), 0.1)
+                    heapq.heappush(heap, (nd, m))
+        self._route_cache[src] = (dist, time)
+        return dist, time
+
+    def _transition(self, ca, cb, gc: float, dt: float) -> float:
+        """Transition log-prob between two candidates, NEG_INF if
+        infeasible.  Same rules as the production kernels, re-derived in
+        float64 with exact routes."""
+        a, cfg = self.a, self.cfg
+        ea, oa, _ = ca
+        eb, ob, _ = cb
+        same_known = False
+        if ea == eb and ob >= oa:
+            route = ob - oa
+            rtime = route / max(float(a.edge_speed[ea]), 0.1)
+            same_known = True
+        elif ea == eb and (oa - ob) <= 2.0 * cfg.sigma_z + 5.0:
+            # small backward jitter on one edge: lightly penalised
+            route = (oa - ob) * 1.05 + 1.0
+            rtime = (oa - ob) / max(float(a.edge_speed[ea]), 0.1)
+            same_known = True
+        else:
+            dist_map, time_map = self._routes_from(int(a.edge_to[ea]))
+            nd = int(a.edge_from[eb])
+            if nd not in dist_map:
+                return NEG_INF
+            route = (float(a.edge_len[ea]) - oa) + dist_map[nd] + ob
+            rtime = ((float(a.edge_len[ea]) - oa)
+                     / max(float(a.edge_speed[ea]), 0.1)
+                     + time_map[nd]
+                     + ob / max(float(a.edge_speed[eb]), 0.1))
+        if route > cfg.max_route_distance_factor * (gc + cfg.search_radius):
+            return NEG_INF
+        if dt > 0 and rtime > cfg.max_route_time_factor * max(dt, 1.0):
+            return NEG_INF
+        logp = -abs(route - gc) / cfg.beta
+        if cfg.turn_penalty_factor > 0.0 and not same_known:
+            turn = float(a.edge_head0[eb]) - float(a.edge_head1[ea])
+            turn = abs((turn + math.pi) % (2.0 * math.pi) - math.pi)
+            logp -= cfg.turn_penalty_factor * turn / (math.pi * cfg.beta)
+        return logp
+
+    # -- viterbi ------------------------------------------------------------
+
+    def match_points(self, xs, ys, times):
+        """(edge[T], offset[T], breaks[T]) numpy; edge=-1 unmatched.  Same
+        contract as CPUViterbiMatcher.match_points."""
+        T = len(xs)
+        edge = np.full(T, -1, np.int64)
+        offset = np.zeros(T, np.float64)
+        breaks = np.zeros(T, bool)
+        if T == 0:
+            return edge, offset, breaks
+        cands = [self.candidates(float(xs[t]), float(ys[t])) for t in range(T)]
+        sigma = float(self.cfg.sigma_z)
+
+        # forward pass, segmented at breaks
+        score = [[-0.5 * (c[2] / sigma) ** 2 for c in cands[0]]]
+        bptr: List[List[int]] = [[-1] * len(cands[0])]
+        seg_bounds = [0]
+        for t in range(1, T):
+            gc = math.hypot(float(xs[t] - xs[t - 1]),
+                            float(ys[t] - ys[t - 1]))
+            dt = float(times[t] - times[t - 1])
+            prev, cur = cands[t - 1], cands[t]
+            sc = [NEG_INF] * len(cur)
+            bp = [-1] * len(cur)
+            broke = (gc > self.cfg.breakage_distance or not prev
+                     or not cur or max(score[-1], default=NEG_INF) <= NEG_INF / 2)
+            if not broke:
+                for j, cj in enumerate(cur):
+                    for i, ci in enumerate(prev):
+                        if score[-1][i] <= NEG_INF / 2:
+                            continue
+                        v = score[-1][i] + self._transition(ci, cj, gc, dt)
+                        if v > sc[j]:
+                            sc[j], bp[j] = v, i
+                if all(v <= NEG_INF / 2 for v in sc):
+                    broke = True
+            if broke:
+                seg_bounds.append(t)
+                sc = [-0.5 * (c[2] / sigma) ** 2 for c in cur]
+                bp = [-1] * len(cur)
+                breaks[t] = True
+            else:
+                sc = [v + -0.5 * (cur[j][2] / sigma) ** 2
+                      if v > NEG_INF / 2 else NEG_INF
+                      for j, v in enumerate(sc)]
+            score.append(sc)
+            bptr.append(bp)
+        seg_bounds.append(T)
+
+        # backtrace each segment from its best final state
+        for s0, s1 in zip(seg_bounds, seg_bounds[1:]):
+            sc = score[s1 - 1]
+            if not sc or max(sc) <= NEG_INF / 2:
+                continue
+            j = int(np.argmax(sc))
+            for t in range(s1 - 1, s0 - 1, -1):
+                if j < 0 or not cands[t]:
+                    break
+                edge[t] = cands[t][j][0]
+                offset[t] = cands[t][j][1]
+                j = bptr[t][j] if t > s0 else -1
+        breaks[0] = True
+        return edge, offset, breaks
+
+    def run_batch(self, px, py, times, valid):
+        """Same contract as CPUViterbiMatcher.run_batch / the device path."""
+        B, T = px.shape
+        edge = np.full((B, T), -1, np.int64)
+        offset = np.zeros((B, T), np.float64)
+        breaks = np.zeros((B, T), bool)
+        for b in range(B):
+            n = int(valid[b].sum())
+            if n == 0:
+                continue
+            e, o, br = self.match_points(px[b, :n], py[b, :n], times[b, :n])
+            edge[b, :n] = e
+            offset[b, :n] = o
+            breaks[b, :n] = br
+        return edge, offset, breaks
+
+    def candidate_counts(self, xs, ys) -> List[int]:
+        """Candidates within radius per point — tests assert max() <=
+        beam_k so the exhaustive pool and the device's K-beam see the same
+        candidate sets and the triple agreement is meaningful."""
+        return [len(self.candidates(float(x), float(y)))
+                for x, y in zip(xs, ys)]

@@ -127,6 +127,7 @@ def associate_segments_batch(
             rc = lib.rn_associate_batch_mt(
                 g_from, g_to, g_len, g_seg, g_seg_off, g_internal, g_way,
                 s_ids, s_len, t_packed, int(ubodt.bmask),
+                int(ubodt.bucket_entries),
                 int(ubodt.num_rows), B, T, m_edge,
                 m_off, m_brk, m_tim, n_pts, float(queue_thresh_mps),
                 float(back_tol), n_threads, out_cap, way_cap,
@@ -141,7 +142,7 @@ def associate_segments_batch(
             continue
         rc = lib.rn_associate_batch(
             g_from, g_to, g_len, g_seg, g_seg_off, g_internal, g_way, s_ids,
-            s_len, t_packed, int(ubodt.bmask),
+            s_len, t_packed, int(ubodt.bmask), int(ubodt.bucket_entries),
             int(ubodt.num_rows), B, T, m_edge, m_off, m_brk, m_tim, n_pts,
             float(queue_thresh_mps), float(back_tol), out_cap, way_cap,
             rec_start[1:], has_seg, seg_id, t0, t1, length, internal, qlen,

@@ -29,6 +29,23 @@ class MatcherConfig:
     # TPU kernel shape knobs
     beam_k: int = 8
     ubodt_delta: float = 3000.0
+    # UBODT memory layout (docs/performance.md "The UBODT memory system"):
+    # "cuckoo" = 2-choice 16-entry buckets, two 512 B row gathers per probe
+    # (the shipped round-4 layout, the differential reference); "wide32" =
+    # single-hash 32-entry buckets, ONE 1 KB row gather per probe — half
+    # the gathered row count of the row-count-bound dominant kernel stage.
+    # $REPORTER_UBODT_LAYOUT overrides at runtime; a prebuilt table whose
+    # layout differs is repacked (rows extracted, no graph re-search).
+    ubodt_layout: str = "cuckoo"
+    # in-batch probe-pair dedup (same doc section): sort-unique-gather-
+    # scatter over the dispatch's packed (src, dst) probe keys inside the
+    # jitted program, so each distinct pair pays one row gather per
+    # dispatch (fleet batches measure 4-8x redundant; the
+    # reporter_probe_dedup_ratio gauge / bench probe_dedup field carry the
+    # live number).  Bit-identical output either way — an overflow of the
+    # static unique budget falls back to the plain probe in-program.
+    # $REPORTER_PROBE_DEDUP=0|1 overrides at runtime.
+    probe_dedup: bool = False
     # viterbi forward selection (docs/performance.md): "scan" = sequential
     # lax.scan (O(T) depth, least work), "assoc" = log-depth associative
     # max-plus scan, "auto" = assoc for padded window lengths >=

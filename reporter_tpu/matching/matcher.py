@@ -77,6 +77,12 @@ C_PROBES = obs.counter(
     "Sampled UBODT transition-probe outcomes (ops/diagnostics.py; enable "
     "with REPORTER_OBS_PROBE_EVERY=N)",
     ("outcome",))
+G_DEDUP_RATIO = obs.gauge(
+    "reporter_probe_dedup_ratio",
+    "Sampled in-batch UBODT probe redundancy: probe pairs / distinct "
+    "(src, dst) pairs in the last sampled dispatch — the factor the "
+    "probe-dedup path removes (docs/performance.md; sampled with "
+    "REPORTER_OBS_PROBE_EVERY=N)")
 
 # chunks allowed in flight on the device while the host associates earlier
 # ones.  Each in-flight chunk pins its packed input + result,
@@ -130,7 +136,29 @@ class SegmentMatcher:
                 % (arrays.cell_size, 2.0 * self.cfg.search_radius)
             )
         self.arrays = arrays
-        self.ubodt = ubodt or build_ubodt(arrays, delta=self.cfg.ubodt_delta)
+        # UBODT memory layout + in-batch probe dedup (docs/performance.md
+        # "The UBODT memory system").  $REPORTER_UBODT_LAYOUT /
+        # $REPORTER_PROBE_DEDUP override the config; a prebuilt table whose
+        # layout differs from the resolved one is repacked in place (row
+        # extraction + re-hash, no graph re-search).
+        env_layout = os.environ.get("REPORTER_UBODT_LAYOUT", "").strip().lower()
+        self._ubodt_layout = env_layout or getattr(
+            self.cfg, "ubodt_layout", "cuckoo") or "cuckoo"
+        if self._ubodt_layout not in ("cuckoo", "wide32"):
+            raise ValueError(
+                "REPORTER_UBODT_LAYOUT/ubodt_layout must be cuckoo|wide32, "
+                "got %r" % (self._ubodt_layout,))
+        env_dd = os.environ.get("REPORTER_PROBE_DEDUP", "").strip().lower()
+        if env_dd:
+            self._probe_dedup = env_dd not in ("0", "false", "off", "no")
+        else:
+            self._probe_dedup = bool(getattr(self.cfg, "probe_dedup", False))
+        if ubodt is None:
+            ubodt = build_ubodt(arrays, delta=self.cfg.ubodt_delta,
+                                layout=self._ubodt_layout)
+        elif getattr(ubodt, "layout", "cuckoo") != self._ubodt_layout:
+            ubodt = ubodt.relayout(self._ubodt_layout)
+        self.ubodt = ubodt
         self.backend = backend
         # viterbi forward selection (docs/performance.md): scan = sequential
         # lax.scan (O(T) depth), assoc = log-depth associative max-plus scan,
@@ -290,12 +318,25 @@ class SegmentMatcher:
                     match_batch_compact_packed, precompute_batch_packed,
                 )
 
+                # in-batch probe dedup applies where the UBODT probe sees a
+                # whole dispatch's key set: the bucketed "compact" program
+                # and the long-trace "pre" precompute.  The chain/carry
+                # programs probe only tiny seam [K, K] sets (and the legacy
+                # fused carry is the dedup-off differential reference).
                 if kind == "pre":
                     self._jits[key] = jax.jit(
-                        precompute_batch_packed, static_argnums=(4,))
+                        functools.partial(
+                            precompute_batch_packed,
+                            dedup=self._probe_dedup),
+                        static_argnums=(4,))
+                elif kind == "compact":
+                    self._jits[key] = jax.jit(
+                        functools.partial(
+                            match_batch_compact_packed, kernel=kernel,
+                            dedup=self._probe_dedup),
+                        static_argnums=(4,))
                 else:
                     base, k_argnum = {
-                        "compact": (match_batch_compact_packed, 4),
                         "carry": (match_batch_carry_packed, 4),
                         "chain": (chain_batch_carry_packed, 5),
                     }[kind]
@@ -528,6 +569,10 @@ class SegmentMatcher:
         for i, outcome in enumerate(
                 ("pairs", "miss", "costly_miss", "beyond_delta")):
             C_PROBES.labels(outcome).inc(int(stats[i]))
+        # [4] = distinct (src, dst) pairs: pairs/distinct is the in-batch
+        # probe redundancy the dedup path removes
+        if len(stats) > 4 and int(stats[4]) > 0:
+            G_DEDUP_RATIO.set(int(stats[0]) / int(stats[4]))
 
     def _harvest_probe_stats(self) -> None:
         """Collect-side drain of dispatched probe programs (the np.asarray

@@ -85,11 +85,15 @@ _SYMBOLS = {
         ctypes.c_int64, _i32p, _i32p, _f32p, _f32p, _i32p,
         ctypes.c_int64, _i32p,
     ]),
+    "rn_wide_pack": (ctypes.c_int64, [
+        ctypes.c_int64, _i32p, _i32p, _f32p, _f32p, _i32p,
+        ctypes.c_int64, _i32p,
+    ]),
     "rn_associate_batch": (ctypes.c_int32, [
         # graph
         _i32p, _i32p, _f32p, _i32p, _f32p, _u8p, _i64p, _i64p, _f32p,
-        # ubodt (packed cuckoo table + bmask + rows)
-        _i32p, ctypes.c_int64, ctypes.c_int64,
+        # ubodt (packed table + bmask + entries-per-bucket + rows)
+        _i32p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
         # matches
         ctypes.c_int64, ctypes.c_int64, _i32p, _f32p, _u8p, _f64p, _i32p,
         # params
@@ -101,8 +105,8 @@ _SYMBOLS = {
     "rn_associate_batch_mt": (ctypes.c_int32, [
         # graph
         _i32p, _i32p, _f32p, _i32p, _f32p, _u8p, _i64p, _i64p, _f32p,
-        # ubodt (packed cuckoo table + bmask + rows)
-        _i32p, ctypes.c_int64, ctypes.c_int64,
+        # ubodt (packed table + bmask + entries-per-bucket + rows)
+        _i32p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
         # matches
         ctypes.c_int64, ctypes.c_int64, _i32p, _f32p, _u8p, _f64p, _i32p,
         # params

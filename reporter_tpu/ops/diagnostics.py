@@ -17,7 +17,7 @@ import jax
 import jax.numpy as jnp
 
 from .candidates import find_candidates_batch
-from .hashtable import ubodt_lookup
+from .hashtable import count_distinct_pairs, ubodt_lookup
 from .viterbi import MatchParams, unpack_inputs
 
 
@@ -28,7 +28,7 @@ def ubodt_probe_stats(dg, du, xin, p: MatchParams, k: int,
     ``delta``: the table's build bound (metres) — a table property, so it
     is a parameter here, not a MatchParams field.
 
-    Returns int32 [4]:
+    Returns int32 [5]:
       [0] pairs        valid candidate pairs needing a table probe
                        (same-edge pairs resolve without the table and are
                        excluded)
@@ -45,6 +45,11 @@ def ubodt_probe_stats(dg, du, xin, p: MatchParams, k: int,
                        bound's accuracy cost; the [2]-[3] remainder is
                        no-path or truncation, indistinguishable without an
                        on-line router)
+      [4] distinct     distinct (src, dst) node pairs among [0] across the
+                       WHOLE batch — pairs/distinct is the in-batch probe
+                       redundancy the dedup path exploits
+                       (reporter_probe_dedup_ratio, bench ``probe_dedup``;
+                       docs/performance.md memory-system section)
     """
     px, py, tm, valid = unpack_inputs(xin)
 
@@ -66,6 +71,14 @@ def ubodt_probe_stats(dg, du, xin, p: MatchParams, k: int,
         costly = miss & (gc <= p.breakage_distance)
         beyond = costly & (gc > delta)
         cnt = lambda m: jnp.sum(m.astype(jnp.int32))
-        return jnp.stack([cnt(need), cnt(miss), cnt(costly), cnt(beyond)])
+        counts = jnp.stack([cnt(need), cnt(miss), cnt(costly), cnt(beyond)])
+        keys = (jnp.broadcast_to(to_a[:, :, None], need.shape),
+                jnp.broadcast_to(from_b[:, None, :], need.shape))
+        return counts, keys, need
 
-    return jnp.sum(jax.vmap(one)(px, py, valid), axis=0)
+    counts, keys, need = jax.vmap(one)(px, py, valid)
+    # distinct pairs are a batch-level property (the dedup path sorts the
+    # whole dispatch's key set), so count OUTSIDE the vmap
+    distinct = count_distinct_pairs(keys[0], keys[1], need)
+    return jnp.concatenate(
+        [jnp.sum(counts, axis=0), distinct[None].astype(jnp.int32)])

@@ -90,26 +90,34 @@ class MatchResult(NamedTuple):
 
 
 def transition_matrix(dg: DeviceGraph, du: DeviceUBODT, src: Candidates, dst: Candidates,
-                      gc: jnp.ndarray, dt: jnp.ndarray, p: MatchParams):
+                      gc: jnp.ndarray, dt: jnp.ndarray, p: MatchParams,
+                      pre=None):
     """[K, K] transition log-probs and route distances for one step.
 
     gc: great-circle (projected straight-line) metres between the two points.
     dt: measurement seconds between them (<= 0 disables the time-factor cut).
+    pre: optional (era, erb, sp, sp_time) — the step's gathered edge rows
+    ([K, 8] each) and UBODT probe results ([K, K] each), precomputed by a
+    batched caller (precompute_batch hoists the gathers above the vmap so
+    the probe sees the whole dispatch's key set and can dedup it); None =
+    self-contained (the seam transition and the per-trace/oracle paths).
     """
     ea, oa = src.edge, src.offset  # [K]
     eb, ob = dst.edge, dst.offset  # [K]
-    safe_ea = jnp.where(ea >= 0, ea, 0)
-    safe_eb = jnp.where(eb >= 0, eb, 0)
+    if pre is None:
+        safe_ea = jnp.where(ea >= 0, ea, 0)
+        safe_eb = jnp.where(eb >= 0, eb, 0)
 
-    # one interleaved row-gather per edge instead of seven scalar gathers
-    # (to-bits, from-bits, len, speed, head0, head1 — tiles/arrays.py)
-    era = dg.edge_rows[safe_ea]  # [K, 8]
-    erb = dg.edge_rows[safe_eb]
-    to_a = jax.lax.bitcast_convert_type(era[:, 0], jnp.int32)
-    from_b = jax.lax.bitcast_convert_type(erb[:, 1], jnp.int32)
+        # one interleaved row-gather per edge instead of seven scalar gathers
+        # (to-bits, from-bits, len, speed, head0, head1 — tiles/arrays.py)
+        era = dg.edge_rows[safe_ea]  # [K, 8]
+        erb = dg.edge_rows[safe_eb]
+        to_a = jax.lax.bitcast_convert_type(era[:, 0], jnp.int32)
+        from_b = jax.lax.bitcast_convert_type(erb[:, 1], jnp.int32)
+        sp, sp_time, _ = ubodt_lookup(du, to_a[:, None], from_b[None, :])
+    else:
+        era, erb, sp, sp_time = pre
     len_a = era[:, 2]
-
-    sp, sp_time, _ = ubodt_lookup(du, to_a[:, None], from_b[None, :])
     remain = (len_a - oa)[:, None]
     route = remain + sp + ob[None, :]
     # same 0.1 m/s floor as the UBODT builder and CPU oracle: a zero-speed
@@ -230,6 +238,54 @@ def precompute_trace(dg: DeviceGraph, du: DeviceUBODT, px, py, times, valid,
     logp_all, route_all = jax.vmap(
         transition_matrix, in_axes=(None, None, 0, 0, 0, 0, None)
     )(dg, du, src_c, dst_c, gc, dts, p)  # [T-1, K, K]
+    return TracePre(cand=cand, emis=emis, logp=logp_all, route=route_all, gc=gc)
+
+
+def precompute_batch(dg: DeviceGraph, du: DeviceUBODT, px, py, times, valid,
+                     p: MatchParams, k: int, dedup: bool = False) -> TracePre:
+    """Batched precompute: [B, T] leaves -> TracePre with leading [B].
+
+    Identical math (bit-identical results) to vmapping precompute_trace,
+    but with the two gather streams HOISTED above the per-trace vmap:
+
+      * each candidate's graph edge row is gathered ONCE per point slot
+        ([B, T, K] rows) and sliced into the src/dst views, instead of
+        twice per step (as transition src, again as transition dst);
+      * the UBODT route-distance probe runs as ONE call over the batch's
+        entire [B, T-1, K, K] key set — the only level where in-batch
+        probe dedup (``dedup=True`` -> ops/hashtable._lookup_dedup's
+        sort-unique-gather-scatter) can deduplicate across the whole
+        dispatch rather than per step or per trace.
+
+    The per-step transition arithmetic then runs with ``pre`` supplied, so
+    XLA sees the same elementwise ops as the fused per-trace program.
+    """
+    cand = jax.vmap(
+        find_candidates_batch, in_axes=(None, 0, 0, None, None)
+    )(dg, px, py, k, p.search_radius)  # [B, T, K]
+
+    emis = -0.5 * jnp.square(cand.dist / p.sigma_z)  # [B, T, K]
+    emis = jnp.where(jnp.isfinite(cand.dist), emis, NEG_INF)
+    emis = jnp.where(valid[..., None], emis, NEG_INF)
+
+    gc = jnp.hypot(px[:, 1:] - px[:, :-1], py[:, 1:] - py[:, :-1])  # [B, T-1]
+    dts = times[:, 1:] - times[:, :-1]
+
+    er = dg.edge_rows[jnp.where(cand.edge >= 0, cand.edge, 0)]  # [B, T, K, 8]
+    era, erb = er[:, :-1], er[:, 1:]  # [B, T-1, K, 8]
+    to_a = jax.lax.bitcast_convert_type(era[..., 0], jnp.int32)
+    from_b = jax.lax.bitcast_convert_type(erb[..., 1], jnp.int32)
+    sp, sp_time, _ = ubodt_lookup(
+        du, to_a[..., :, None], from_b[..., None, :], dedup=dedup
+    )  # [B, T-1, K, K]
+
+    src_c = jax.tree_util.tree_map(lambda a: a[:, :-1], cand)
+    dst_c = jax.tree_util.tree_map(lambda a: a[:, 1:], cand)
+    step_axes = (None, None, 0, 0, 0, 0, None, 0)
+    tm = jax.vmap(jax.vmap(transition_matrix, in_axes=step_axes),
+                  in_axes=step_axes)
+    logp_all, route_all = tm(
+        dg, du, src_c, dst_c, gc, dts, p, (era, erb, sp, sp_time))
     return TracePre(cand=cand, emis=emis, logp=logp_all, route=route_all, gc=gc)
 
 
@@ -537,13 +593,18 @@ def backtrace_assoc(scores_mat: jnp.ndarray, backptr: jnp.ndarray, valid: jnp.nd
 
 
 def match_batch(dg: DeviceGraph, du: DeviceUBODT, px, py, times, valid, p: MatchParams, k: int,
-                kernel: str = "scan") -> MatchResult:
-    """px/py/times/valid: [B, T] -> MatchResult leaves with leading [B]."""
+                kernel: str = "scan", dedup: bool = False) -> MatchResult:
+    """px/py/times/valid: [B, T] -> MatchResult leaves with leading [B].
+
+    precompute_batch (hoisted gathers, optional in-batch probe dedup) +
+    the vmapped carry-free chain — the same composition match_trace fuses
+    per trace, with the gather-bound stage at batch level."""
     import functools
 
-    fn = functools.partial(match_trace, kernel=kernel)
-    return jax.vmap(fn, in_axes=(None, None, 0, 0, 0, 0, None, None))(
-        dg, du, px, py, times, valid, p, k
+    pre = precompute_batch(dg, du, px, py, times, valid, p, k, dedup)
+    fn = functools.partial(chain_trace, kernel=kernel)
+    return jax.vmap(fn, in_axes=(None, None, 0, 0, 0, 0, 0, None, None))(
+        dg, du, pre, px, py, times, valid, p, k
     )
 
 
@@ -558,9 +619,9 @@ class CompactMatch(NamedTuple):
 
 
 def match_batch_compact(dg: DeviceGraph, du: DeviceUBODT, px, py, times, valid, p: MatchParams, k: int,
-                        kernel: str = "scan") -> CompactMatch:
+                        kernel: str = "scan", dedup: bool = False) -> CompactMatch:
     """match_batch + on-device gather of the chosen candidate per point."""
-    res = match_batch(dg, du, px, py, times, valid, p, k, kernel)
+    res = match_batch(dg, du, px, py, times, valid, p, k, kernel, dedup)
     return _compact(res)
 
 
@@ -634,10 +695,12 @@ def unpack_compact(out):
 
 def match_batch_compact_packed(dg: DeviceGraph, du: DeviceUBODT, xin,
                                p: MatchParams, k: int,
-                               kernel: str = "scan") -> jnp.ndarray:
+                               kernel: str = "scan",
+                               dedup: bool = False) -> jnp.ndarray:
     """match_batch_compact over a packed [4, B, T] input -> packed [3, B, T]."""
     px, py, times, valid = unpack_inputs(xin)
-    return pack_compact(match_batch_compact(dg, du, px, py, times, valid, p, k, kernel))
+    return pack_compact(match_batch_compact(
+        dg, du, px, py, times, valid, p, k, kernel, dedup))
 
 
 def match_batch_carry_packed(dg: DeviceGraph, du: DeviceUBODT, xin,
@@ -653,18 +716,18 @@ def match_batch_carry_packed(dg: DeviceGraph, du: DeviceUBODT, xin,
 
 
 def precompute_batch_packed(dg: DeviceGraph, du: DeviceUBODT, xin,
-                            p: MatchParams, k: int) -> TracePre:
+                            p: MatchParams, k: int,
+                            dedup: bool = False) -> TracePre:
     """Carry-independent precompute over a packed [4, B, T] input ->
     TracePre with leading [B] on every leaf.  For long traces B is
     B_trace x chunks_per_wave: the chunk axis of a trace group folds into
     the batch axis, so the candidate sweep, emissions, and the
     [T-1, K, K] transition build for MANY chunks run as ONE dispatch
-    instead of once per carry step.  The result stays on device and feeds
-    chain_batch_carry_packed chunk by chunk."""
+    instead of once per carry step — and, with ``dedup``, the UBODT probe
+    deduplicates across ALL those chunks' keys at once.  The result stays
+    on device and feeds chain_batch_carry_packed chunk by chunk."""
     px, py, times, valid = unpack_inputs(xin)
-    return jax.vmap(
-        precompute_trace, in_axes=(None, None, 0, 0, 0, 0, None, None)
-    )(dg, du, px, py, times, valid, p, k)
+    return precompute_batch(dg, du, px, py, times, valid, p, k, dedup)
 
 
 def chain_batch_carry_packed(dg: DeviceGraph, du: DeviceUBODT, pre: TracePre,

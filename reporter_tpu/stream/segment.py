@@ -50,14 +50,17 @@ class Segment:
 
     def csv_row(self, mode: str, source: str) -> str:
         """One histogram CSV row (Segment.java:59-74); next_id empty when
-        invalid, duration rounded, min floored, max ceiled."""
+        invalid, duration rounded, min floored, max ceiled.  Duration uses
+        Java's Math.round — floor(x + 0.5), half-up — NOT Python's
+        banker's round: a 26.5 s duration is 27 on the reference's wire
+        (caught by the golden-bytes fixtures, tests/test_parity_fixtures)."""
         import math
 
         next_s = "" if self.next_id == INVALID_SEGMENT_ID else str(self.next_id)
         return "%d,%s,%d,1,%d,%d,%d,%d,%s,%s" % (
             self.id,
             next_s,
-            int(round(self.max - self.min)),
+            int(math.floor((self.max - self.min) + 0.5)),
             self.length,
             self.queue,
             int(math.floor(self.min)),

@@ -8,21 +8,37 @@ node pairs within ``delta`` metres, stored in a hash table whose array lives in
 HBM.  At match time the [batch, T, K, K] transition route-distances become
 pure vectorised gathers (ops/hashtable.py) — no graph search on device at all.
 
-Table layout (round 4): **2-choice bucketed cuckoo sized to the TPU tile**.
-One interleaved int32 array ``packed[n_buckets, BUCKET, ROW_W]`` holds
-(src, dst, dist-bits, time-bits, first_edge, 0, 0, 0) per entry, with
-BUCKET=16 entries per bucket so one bucket is exactly **one 128-lane
-(512-byte) row** — the TPU's native (8, 128) tile width.  On device the
-table is a rank-2 ``[n_buckets, 128]`` array (zero layout padding) and a
-lookup is exactly **two row-gathers** (one aligned DMA per hash function)
-regardless of load; the hit is selected from the 2x16 candidate entries
-with lane-local compares.  The linear-probe layout this replaces unrolled
+Two selectable table layouts (``layout=`` on every builder; the device
+probes in ops/hashtable.py dispatch on the same static tag):
+
+``cuckoo`` (round 4, the shipped default): **2-choice bucketed cuckoo
+sized to the TPU tile**.  One interleaved int32 array
+``packed[n_buckets, BUCKET, ROW_W]`` holds (src, dst, dist-bits,
+time-bits, first_edge, 0, 0, 0) per entry, with BUCKET=16 entries per
+bucket so one bucket is exactly **one 128-lane (512-byte) row** — the
+TPU's native (8, 128) tile width.  On device the table is a rank-2
+``[n_buckets, 128]`` array (zero layout padding) and a lookup is exactly
+**two row-gathers** (one aligned DMA per hash function) regardless of
+load; the hit is selected from the 2x16 candidate entries with
+lane-local compares.  The linear-probe layout this replaces unrolled
 up to 64 probes of 5 scalar gathers each — and every scattered 4-byte
 gather still cost a full tile DMA, the single worst HBM access pattern a
 TPU can have.  Insertion uses deterministic displacement at build time
 (2-choice with bucket 16 supports loads >0.9, so kicks are rare); the C++
 packer (rn_cuckoo_pack) and the Python twin below produce bit-identical
 tables.
+
+``wide32`` (round 6, docs/gather-experiments.md): **single-hash 32-entry
+buckets** — one 1 KB (256-lane) row per (src, dst) probe instead of two
+512 B cuckoo rows.  Random row gathers are row-count-bound (~20-38 M
+rows/s regardless of row width, measured on chip with
+tools/gather_probe.py), so halving the gathered row count halves the
+dominant kernel stage while the doubled payload per row is nearly free.
+No kick chains: entries land in the first free slot of their single home
+bucket (pair_hash), sized to WIDE_LOAD so a bucket overflow is a
+~1e-8/bucket event handled by grow-and-retry, exactly like the cuckoo
+growth path.  The C++ packer (rn_wide_pack) and _pack_wide_python are
+bit-identical by test.
 
 Each row also records the first edge of the shortest path so the full edge
 path can be reconstructed host-side after Viterbi (subpaths of shortest paths
@@ -64,6 +80,27 @@ F_SRC, F_DST, F_DIST, F_TIME, F_FE = 0, 1, 2, 3, 4
 LOAD_TARGET = 0.75
 MAX_KICKS = 500
 
+# wide32 layout: 32 entries per single-hash bucket = one 256-lane (1 KB)
+# row, TWO TPU tile rows moved as one aligned DMA.  Single-hash insertion
+# has no displacement safety valve, so the table is sized sparser: at
+# WIDE_LOAD the per-bucket occupancy is Poisson(~10.6) and the chance any
+# bucket exceeds 32 entries is ~1e-8/bucket — the growth loop below
+# doubles the table on that (astronomically rare) overflow, same policy
+# as a failed cuckoo chain.
+WIDE_BUCKET = 32
+WIDE_LOAD = 0.33
+LAYOUTS = ("cuckoo", "wide32")
+
+
+def bucket_entries(layout: str) -> int:
+    """Entries per bucket row for a table layout (16 cuckoo / 32 wide32)."""
+    if layout == "wide32":
+        return WIDE_BUCKET
+    if layout == "cuckoo":
+        return BUCKET
+    raise ValueError("unknown UBODT layout %r (expected one of %s)"
+                     % (layout, LAYOUTS))
+
 
 def pair_hash(src, dst, mask):
     """Bucket choice 1.  Identical on host (numpy) and device (jnp)."""
@@ -91,27 +128,40 @@ def pair_hash2(src, dst, mask):
 
 class DeviceUBODT:
     """Pytree whose packed table array is the leaf and whose (bmask,
-    shard_axis) are static aux data.
+    shard_axis, layout) are static aux data.
 
     ``shard_axis`` names a mesh axis when the packed array is a 1/N
     bucket-range slice inside a shard_map (parallel/mesh.py graph sharding):
     the device prober then masks probes to the local bucket range and
     resolves hits with pmin/pmax collectives over that axis.  None = whole
-    table resident."""
+    table resident.
 
-    # architectural probe bound: one gather per hash function
-    max_probes = 2
+    ``layout`` is the table layout tag ("cuckoo" / "wide32"); because it is
+    aux data, the jitted probes specialise on it statically — a cuckoo and
+    a wide32 table trace to different (1- vs 2-gather) programs."""
 
-    def __init__(self, packed, bmask: int, shard_axis=None):
-        self.packed = packed  # [n_buckets, BUCKET*ROW_W = 128] int32 rows
+    def __init__(self, packed, bmask: int, shard_axis=None,
+                 layout: str = "cuckoo"):
+        # [n_buckets, BUCKET*ROW_W = 128] (cuckoo) or [n_buckets, 256]
+        # (wide32) int32 rows
+        self.packed = packed
         self.bmask = int(bmask)
         self.shard_axis = shard_axis
+        if layout not in LAYOUTS:
+            raise ValueError("unknown UBODT layout %r" % (layout,))
+        self.layout = layout
+
+    @property
+    def max_probes(self) -> int:
+        """Architectural probe bound: one row gather per hash function."""
+        return 1 if self.layout == "wide32" else 2
 
     def with_shard_axis(self, axis: str) -> "DeviceUBODT":
-        return DeviceUBODT(self.packed, self.bmask, shard_axis=axis)
+        return DeviceUBODT(self.packed, self.bmask, shard_axis=axis,
+                           layout=self.layout)
 
     def tree_flatten(self):
-        return ((self.packed,), (self.bmask, self.shard_axis))
+        return ((self.packed,), (self.bmask, self.shard_axis, self.layout))
 
     @classmethod
     def tree_unflatten(cls, aux, children):
@@ -137,27 +187,38 @@ except ImportError:  # pragma: no cover - host-only usage without jax
 @dataclass
 class UBODT:
     delta: float
-    packed: np.ndarray  # [n_buckets, BUCKET, ROW_W] int32
+    packed: np.ndarray  # [n_buckets, bucket_entries, ROW_W] int32
     bmask: int  # n_buckets - 1
     num_rows: int
-    max_kicks: int  # longest displacement chain seen during packing
-    # architectural probe bound (two bucket gathers per lookup)
+    max_kicks: int  # longest displacement chain (cuckoo) / 0 (wide32)
+    # architectural probe bound (bucket gathers per lookup: 2 cuckoo,
+    # 1 wide32) — set by the builder
     max_probes: int = 2
+    layout: str = "cuckoo"
 
     @property
     def n_buckets(self) -> int:
         return self.bmask + 1
 
+    @property
+    def bucket_entries(self) -> int:
+        return bucket_entries(self.layout)
+
     def _find(self, src: int, dst: int) -> int:
         """Flat entry index of the (src, dst) row, or -1."""
-        for h in (
-            int(pair_hash(np.int64(src), np.int64(dst), self.bmask)),
-            int(pair_hash2(np.int64(src), np.int64(dst), self.bmask)),
-        ):
-            for s in range(BUCKET):
+        if self.layout == "wide32":
+            hashes = (int(pair_hash(np.int64(src), np.int64(dst), self.bmask)),)
+        else:
+            hashes = (
+                int(pair_hash(np.int64(src), np.int64(dst), self.bmask)),
+                int(pair_hash2(np.int64(src), np.int64(dst), self.bmask)),
+            )
+        be = self.bucket_entries
+        for h in hashes:
+            for s in range(be):
                 e = self.packed[h, s]
                 if e[F_SRC] == src and e[F_DST] == dst:
-                    return h * BUCKET + s
+                    return h * be + s
         return -1
 
     def lookup(self, src: int, dst: int) -> Tuple[float, int]:
@@ -207,17 +268,53 @@ class UBODT:
         self._edge_to = edge_to
         return self
 
+    def rows(self) -> Tuple[np.ndarray, ...]:
+        """(src, dst, dist, time, first_edge) columns of every occupied
+        entry, in deterministic (bucket, slot) scan order — the extraction
+        ``relayout`` repacks from.  NOT the original insertion order (the
+        hash placement scrambled that), so a relayout round-trip is
+        content-identical, not byte-identical, to a direct build."""
+        flat = self.packed.reshape(-1, ROW_W)
+        occ = flat[:, F_SRC] != EMPTY
+        e = flat[occ]
+        return (
+            e[:, F_SRC].astype(np.int32),
+            e[:, F_DST].astype(np.int32),
+            e[:, F_DIST].astype(np.int32).view(np.float32),
+            e[:, F_TIME].astype(np.int32).view(np.float32),
+            e[:, F_FE].astype(np.int32),
+        )
+
+    def relayout(self, layout: str, use_native: bool = True) -> "UBODT":
+        """Repack this table's rows into ``layout`` (no graph re-search —
+        the rows are extracted from the packed array).  Returns self when
+        the layout already matches.  Used by SegmentMatcher when a prebuilt
+        table's layout differs from the configured/$REPORTER_UBODT_LAYOUT
+        one."""
+        if layout == self.layout:
+            return self
+        src, dst, dist, tm, fe = self.rows()
+        out = ubodt_from_columns(
+            src, dst, dist, tm, fe, self.delta,
+            use_native=use_native, layout=layout,
+        )
+        out._edge_to = self._edge_to
+        return out
+
     def to_device(self) -> DeviceUBODT:
         import jax.numpy as jnp
 
-        # rank-2 [n_buckets, BUCKET*ROW_W=128]: the minor dim is exactly
-        # the TPU lane width, so the device layout carries zero padding and
-        # a bucket probe is one aligned row DMA
+        # rank-2 [n_buckets, bucket_entries*ROW_W] (128 cuckoo / 256
+        # wide32): the minor dim is a whole number of TPU lane rows, so the
+        # device layout carries zero padding and a bucket probe is one
+        # aligned row DMA
         return DeviceUBODT(
             packed=jnp.asarray(
-                self.packed.reshape(self.n_buckets, BUCKET * ROW_W), jnp.int32
+                self.packed.reshape(
+                    self.n_buckets, self.bucket_entries * ROW_W), jnp.int32
             ),
             bmask=self.bmask,
+            layout=self.layout,
         )
 
 
@@ -260,9 +357,10 @@ def _bounded_dijkstra(
 def build_ubodt(
     arrays,
     delta: float = 3000.0,
-    load_factor: float = LOAD_TARGET,
+    load_factor: "float | None" = None,
     num_threads: int = 0,
     use_native: bool = True,
+    layout: str = "cuckoo",
 ) -> UBODT:
     """Build the table from GraphArrays.
 
@@ -278,7 +376,7 @@ def build_ubodt(
         if built is not None:
             src, dst, dist, tm, fe = built
             return ubodt_from_columns(
-                src, dst, dist, tm, fe, delta, load_factor
+                src, dst, dist, tm, fe, delta, load_factor, layout=layout
             ).attach_graph(arrays.edge_to)
     rows: List[Tuple[int, int, float, float, int]] = []
     for src in range(arrays.num_nodes):
@@ -288,7 +386,7 @@ def build_ubodt(
         ):
             rows.append((src, dst, d, tm, fe))
     return ubodt_from_rows(
-        rows, delta, load_factor, use_native=use_native
+        rows, delta, load_factor, use_native=use_native, layout=layout
     ).attach_graph(arrays.edge_to)
 
 
@@ -400,6 +498,46 @@ def _pack_python(src, dst, dist, time, first_edge, n_buckets, packed) -> int:
     return max_chain
 
 
+def _pack_wide_python(src, dst, dist, time, first_edge, n_buckets,
+                      packed) -> int:
+    """Python twin of rn_wide_pack: single-hash first-free-slot insert into
+    ``packed`` [n_buckets, WIDE_BUCKET, ROW_W] (pre-zeroed with src =
+    EMPTY).  Returns the fullest bucket's occupancy, or -1 when a bucket
+    overflows its 32 slots (caller doubles n_buckets and retries — a
+    ~1e-8/bucket event at WIDE_LOAD).
+
+    No kick chains: a row's slot is its rank among same-bucket rows in
+    input order, which is what the row-loop C++ twin produces — so the
+    whole placement vectorises here (stable argsort by bucket) while
+    staying bit-identical to the C++ insert loop."""
+    n = len(src)
+    if n == 0:
+        return 0
+    bmask = n_buckets - 1
+    b = pair_hash(np.asarray(src, np.int64), np.asarray(dst, np.int64),
+                  bmask).astype(np.int64)
+    order = np.argsort(b, kind="stable")
+    # slot index = rank within the bucket in input order (stable sort keeps
+    # input order inside each bucket group)
+    sb = b[order]
+    start = np.concatenate([[0], np.flatnonzero(sb[1:] != sb[:-1]) + 1])
+    group = np.repeat(np.arange(len(start)), np.diff(np.append(start, n)))
+    slot = np.arange(n) - start[group]
+    fill = int(slot.max()) + 1
+    if fill > WIDE_BUCKET:
+        return -1
+    rows = order  # original row index per (bucket, slot) placement
+    dist_bits = np.asarray(dist, np.float32).view(np.int32)
+    time_bits = np.asarray(time, np.float32).view(np.int32)
+    packed[sb, slot, :] = 0
+    packed[sb, slot, F_SRC] = np.asarray(src, np.int32)[rows]
+    packed[sb, slot, F_DST] = np.asarray(dst, np.int32)[rows]
+    packed[sb, slot, F_DIST] = dist_bits[rows]
+    packed[sb, slot, F_TIME] = time_bits[rows]
+    packed[sb, slot, F_FE] = np.asarray(first_edge, np.int32)[rows]
+    return fill
+
+
 def ubodt_from_columns(
     src: np.ndarray,
     dst: np.ndarray,
@@ -407,32 +545,45 @@ def ubodt_from_columns(
     time: np.ndarray,
     first_edge: np.ndarray,
     delta: float,
-    load_factor: float = LOAD_TARGET,
+    load_factor: "float | None" = None,
     use_native: bool = True,
+    layout: str = "cuckoo",
 ) -> UBODT:
-    """Pack row columns into the cuckoo table.  The single home of the sizing
-    and grow-on-insert-failure policy; the displacement inner loop runs in
-    C++ (rn_cuckoo_pack) when available and ``use_native``, else in
-    _pack_python -- both produce bit-identical tables."""
+    """Pack row columns into the hash table.  The single home of the sizing
+    and grow-on-insert-failure policy for BOTH layouts; the insert inner
+    loop runs in C++ (rn_cuckoo_pack / rn_wide_pack) when available and
+    ``use_native``, else in _pack_python / _pack_wide_python -- each pair
+    produces bit-identical tables."""
+    if layout not in LAYOUTS:
+        raise ValueError("unknown UBODT layout %r" % (layout,))
+    wide = layout == "wide32"
+    if load_factor is None:
+        load_factor = WIDE_LOAD if wide else LOAD_TARGET
+    entries = bucket_entries(layout)
     n = int(len(src))
     src = np.ascontiguousarray(src, np.int32)
     dst = np.ascontiguousarray(dst, np.int32)
     dist = np.ascontiguousarray(dist, np.float32)
     time = np.ascontiguousarray(time, np.float32)
     first_edge = np.ascontiguousarray(first_edge, np.int32)
-    lib = _get_native("rn_cuckoo_pack") if use_native else None
+    sym = "rn_wide_pack" if wide else "rn_cuckoo_pack"
+    lib = _get_native(sym) if use_native else None
 
     n_buckets = 1
-    while n_buckets * BUCKET * load_factor < max(n, 1):
+    while n_buckets * entries * load_factor < max(n, 1):
         n_buckets <<= 1
     n_buckets = max(n_buckets, 4)
     while True:
-        packed = np.zeros((n_buckets, BUCKET, ROW_W), np.int32)
+        packed = np.zeros((n_buckets, entries, ROW_W), np.int32)
         packed[:, :, F_SRC] = EMPTY
         if lib is not None:
-            max_chain = lib.rn_cuckoo_pack(
+            max_chain = getattr(lib, sym)(
                 n, src, dst, dist, time, first_edge, n_buckets,
                 packed.reshape(-1),
+            )
+        elif wide:
+            max_chain = _pack_wide_python(
+                src, dst, dist, time, first_edge, n_buckets, packed
             )
         else:
             max_chain = _pack_python(
@@ -441,21 +592,28 @@ def ubodt_from_columns(
         if max_chain >= 0:
             break
         n_buckets <<= 1
-        log.info("ubodt: cuckoo insert chain exceeded %d kicks, growing table "
-                 "to %d buckets", MAX_KICKS, n_buckets)
-    log.info("ubodt: %d rows, %d buckets (load %.2f), max kick chain %d",
-             n, n_buckets, n / max(n_buckets * BUCKET, 1), max_chain)
+        log.info("ubodt: %s insert failed (%s), growing table to %d buckets",
+                 layout,
+                 "bucket overflow" if wide
+                 else "cuckoo chain exceeded %d kicks" % MAX_KICKS,
+                 n_buckets)
+    log.info("ubodt: %d rows, %d x %d-entry buckets (%s, load %.2f), %s %d",
+             n, n_buckets, entries, layout,
+             n / max(n_buckets * entries, 1),
+             "max bucket fill" if wide else "max kick chain", max_chain)
     return UBODT(
         delta=delta, packed=packed, bmask=n_buckets - 1, num_rows=n,
-        max_kicks=int(max_chain),
+        max_kicks=0 if wide else int(max_chain),
+        max_probes=1 if wide else 2, layout=layout,
     )
 
 
 def ubodt_from_rows(
     rows: List[Tuple[int, int, float, float, int]],
     delta: float,
-    load_factor: float = LOAD_TARGET,
+    load_factor: "float | None" = None,
     use_native: bool = True,
+    layout: str = "cuckoo",
 ) -> UBODT:
     """Pack (src, dst, dist, time, first_edge) row tuples into the hash
     table.  Thin column-conversion wrapper over ubodt_from_columns, which
@@ -468,5 +626,5 @@ def ubodt_from_rows(
         np.asarray(srcs, np.int32), np.asarray(dsts, np.int32),
         np.asarray(dists, np.float32), np.asarray(times, np.float32),
         np.asarray(fes, np.int32), delta, load_factor,
-        use_native=use_native,
+        use_native=use_native, layout=layout,
     )

@@ -43,12 +43,17 @@ def test_full_delta_has_low_miss_rate(city):
     synth = TraceSynthesizer(arrays, seed=3)
     stats = _stats(
         arrays, ubodt, cfg, synth.batch(8, 32, dt=5.0, sigma=3.0), 32, 10000.0)
-    pairs, miss, costly, beyond = (int(v) for v in stats)
+    pairs, miss, costly, beyond, distinct = (int(v) for v in stats)
     assert pairs > 0
     # no hop is provably beyond a 10 km table on a ~2 km city
     assert beyond == 0
     # dense sampling on a connected grid: nearly every probe is answerable
     assert costly / pairs < 0.05
+    # the redundancy diagnostic: distinct pairs are a (much smaller)
+    # subset of probed pairs on road-following fleets — the headroom the
+    # in-batch probe dedup exploits (docs/performance.md)
+    assert 0 < distinct <= pairs
+    assert pairs / distinct > 2.0
 
 
 def test_tiny_delta_drives_misses_up(city):

@@ -425,3 +425,98 @@ def test_random_topology_backend_parity(seed):
         cd, co = _canon(d), _canon(o)
         assert cd == co, "seed %d trace %d diverged:\n%s\nvs\n%s" % (
             seed, i, json.dumps(cd)[:400], json.dumps(co)[:400])
+
+
+# -- UBODT memory system: {cuckoo, wide32} x {dedup on, off} -----------------
+#
+# The wide-bucket relayout and the in-batch probe dedup are pure memory-
+# system optimisations: both must be WIRE-identical to the shipped
+# (cuckoo, no-dedup) path on every cohort — bucketed short traces, a
+# medium bucket, and long multi-chunk carry chains including a break
+# engineered exactly onto a carry seam — on both viterbi kernels.  Dedup
+# exactness includes its truncation edge: the half-random fuzz traces
+# drive high distinct-pair counts, exercising the in-program full-width
+# fallback, while road-following traces exercise the deduped gather.
+
+
+def _mem_matchers(arrays, ubodt, kernel):
+    """(baseline, variants): the shipped config against the three
+    memory-system combos, all sharing one prebuilt (cuckoo) table —
+    wide32 matchers repack it through UBODT.relayout, the product path."""
+    def mk(layout, dedup):
+        return SegmentMatcher(
+            arrays=arrays, ubodt=ubodt,
+            config=MatcherConfig(viterbi_kernel=kernel,
+                                 length_buckets=list(LONG_BUCKETS),
+                                 ubodt_layout=layout, probe_dedup=dedup))
+    base = mk("cuckoo", False)
+    variants = {("cuckoo", True): mk("cuckoo", True),
+                ("wide32", False): mk("wide32", False),
+                ("wide32", True): mk("wide32", True)}
+    assert base.ubodt.layout == "cuckoo" and not base._probe_dedup
+    assert variants[("wide32", True)].ubodt.layout == "wide32"
+    assert variants[("wide32", True)]._probe_dedup
+    return base, variants
+
+
+@pytest.mark.parametrize("seed,kernel", [(7, "scan"), (19, "assoc"),
+                                         (43, "scan"), (61, "assoc")])
+def test_memory_system_wire_identical(seed, kernel, monkeypatch):
+    """{cuckoo, wide32} x {dedup on, off} x {scan, assoc} over mixed
+    cohorts: short (one bucket), medium (a larger bucket), and long
+    multi-chunk carry chains with a seam-boundary break."""
+    monkeypatch.delenv("REPORTER_VITERBI", raising=False)
+    monkeypatch.delenv("REPORTER_UBODT_LAYOUT", raising=False)
+    monkeypatch.delenv("REPORTER_PROBE_DEDUP", raising=False)
+    rng = np.random.default_rng(seed)
+    net = random_network(rng)
+    arrays = build_graph_arrays(net)
+    ubodt = build_ubodt(arrays, delta=2000.0)
+    base, variants = _mem_matchers(arrays, ubodt, kernel)
+
+    traces = random_traces(rng, net, arrays, n_traces=6, n_pts=12)  # short
+    traces += random_traces(rng, net, arrays, n_traces=4, n_pts=28)  # med
+    traces += random_traces(rng, net, arrays, n_traces=4,
+                            n_pts=int(rng.integers(72, 97)))  # long chains
+    traces.append(_seam_break_trace(net))  # break exactly on a carry seam
+
+    want = base.match_many(traces)
+    for combo, m in variants.items():
+        got = m.match_many(traces)
+        for i, (w, g) in enumerate(zip(want, got)):
+            assert w == g, "seed %d kernel %s %s trace %d diverged:\n%s\nvs\n%s" % (
+                seed, kernel, combo, i, json.dumps(w)[:300],
+                json.dumps(g)[:300])
+
+
+def test_memory_system_compact_identical_across_seams(monkeypatch):
+    """CompactMatch-level differential for the long carry-chain path: the
+    raw (edge, offset-bits, breaks) device arrays must be identical across
+    all four memory-system combos at every point, seam columns included."""
+    monkeypatch.delenv("REPORTER_VITERBI", raising=False)
+    monkeypatch.delenv("REPORTER_UBODT_LAYOUT", raising=False)
+    monkeypatch.delenv("REPORTER_PROBE_DEDUP", raising=False)
+    rng = np.random.default_rng(29)
+    net = random_network(rng)
+    arrays = build_graph_arrays(net)
+    ubodt = build_ubodt(arrays, delta=2000.0)
+    base, variants = _mem_matchers(arrays, ubodt, "scan")
+
+    W = LONG_BUCKETS[-1]
+    traces = random_traces(rng, net, arrays, n_traces=4, n_pts=80)
+    traces.append(_seam_break_trace(net, W=W, n_pts=96))
+    idxs = list(range(len(traces)))
+
+    def raw(m):
+        handles = m._dispatch_long(traces, idxs)
+        group_rows, res, _times = m._fetch_long(handles[0])
+        assert len(handles) == 1
+        return group_rows, res
+
+    rows0, want = raw(base)
+    for combo, m in variants.items():
+        rows, got = raw(m)
+        assert rows == rows0, combo
+        for field, (w, g) in enumerate(zip(want, got)):
+            np.testing.assert_array_equal(w, g,
+                                          err_msg="%s field %d" % (combo, field))

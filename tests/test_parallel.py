@@ -83,9 +83,20 @@ def test_histogram_semantics(setup):
     assert tc.max() == 1.0 and tc.sum() >= 1.0
 
 
-def test_graph_sharded_matches_unsharded(setup):
+def _has_shard_map() -> bool:
+    import jax
+
+    return hasattr(jax, "shard_map")
+
+
+@pytest.mark.skipif(not _has_shard_map(),
+                    reason="this jax build lacks jax.shard_map")
+@pytest.mark.parametrize("layout", ["cuckoo", "wide32"])
+def test_graph_sharded_matches_unsharded(setup, layout):
     """UBODT sharded over gp: decode and histogram must agree with the
-    single-device path (probes resolve exactly via pmin/pmax)."""
+    single-device path (probes resolve exactly via pmin/pmax) — for both
+    table layouts (the wide32 sharded probe masks ONE bucket range per
+    rank instead of two)."""
     import jax
     import jax.numpy as jnp
 
@@ -98,6 +109,7 @@ def test_graph_sharded_matches_unsharded(setup):
     )
 
     arrays, ubodt = setup
+    ubodt = ubodt.relayout(layout)
     cfg = MatcherConfig()
     p = MatchParams.from_config(cfg)
     dg = arrays.to_device()

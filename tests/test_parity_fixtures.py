@@ -166,3 +166,127 @@ def test_replay_matches_recorded_on_both_backends(recorded, matcher):
             _diff_segment(g, w, "%s.segments[%d]" % (uid, i))
 
         assert got["stats"] == want["stats"], uid
+
+
+# -- golden-bytes serde parity vs the reference implementation ---------------
+#
+# VERDICT r05 next #5: the wire layouts were previously asserted against
+# spec CONSTANTS (sizes, field order) but never against concrete bytes
+# derived from the reference code's exact serde semantics.  The literals
+# below are hand-encoded from those semantics and diffed byte-for-byte, so
+# any drift in endianness, field order, width, or float encoding fails
+# here even if the sizes still line up.
+#
+#   Point.java:50-58   writeFloat(lat) writeFloat(lon) writeInt(accuracy)
+#                      writeLong(time) — big-endian, 20 bytes.
+#   Segment.java:76-129  writeLong(id) writeLong(next_id, INVALID=2^46-1)
+#                      writeDouble(min) writeDouble(max) writeInt(length)
+#                      writeInt(queue) — big-endian, 40 bytes.
+#   Batch.java:92-146  writeInt(count) writeFloat(max_separation)
+#                      writeLong(last_update), then the packed points.
+#   Segment.java:59-74 + AnonymisingProcessor.java:184-188: the CSV row
+#                      (duration rounded, min floored, max ceiled, empty
+#                      next_id when invalid) and the {start}_{end}/{level}/
+#                      {tile_index} tile path.
+
+
+def test_point_golden_bytes():
+    """37.75°N -122.45°E, 5 m accuracy, t=1461176476 (the reference's own
+    README sample epoch).  IEEE-754 single bits: 37.75 = 0x42170000,
+    -122.45 = 0xC2F4E666; 5 = 0x00000005; the long is 0x00000000_5717C89C."""
+    from reporter_tpu.stream.point import Point
+
+    want = bytes.fromhex("42170000c2f4e66600000005000000005717c89c")
+    assert len(want) == 20
+    p = Point(lat=37.75, lon=-122.45, accuracy=5, time=1461176476)
+    assert p.pack() == want
+    # round-trip: the unpacked lat/lon are the float32-quantised values
+    # (the wire's precision), so compare at the byte level
+    rt = Point.unpack(want)
+    assert rt.pack() == want
+    assert (rt.accuracy, rt.time) == (5, 1461176476)
+
+
+def test_segment_golden_bytes():
+    """One observation with a next-segment transition, and one without
+    (next_id absent serialises as INVALID_SEGMENT_ID = 2^46 - 1 =
+    0x3FFFFFFFFFFF, Segment.java:16).  Doubles: 1461176476.25 =
+    0x41D5C5F227100000, 1461176502.75 = 0x41D5C5F22DB00000."""
+    from reporter_tpu.stream.segment import INVALID_SEGMENT_ID, Segment
+
+    want = bytes.fromhex(
+        "000000000ac94500" "000000000ead5487"
+        "41d5c5f227100000" "41d5c5f22db00000"
+        "0000011c" "00000025")
+    assert len(want) == 40
+    s = Segment(id=180962560, next_id=246240391,
+                min=1461176476.25, max=1461176502.75, length=284, queue=37)
+    assert s.pack() == want
+    assert Segment.unpack(want) == s
+
+    want_noid = bytes.fromhex(
+        "000000000ac94500" "00003fffffffffff"
+        "41d5c5f227100000" "41d5c5f22db00000"
+        "0000011c" "00000000")
+    s2 = Segment(id=180962560, next_id=None,
+                 min=1461176476.25, max=1461176502.75, length=284, queue=0)
+    assert s2.next_id == INVALID_SEGMENT_ID
+    assert s2.pack() == want_noid
+
+
+def test_batch_golden_bytes():
+    """Batch header (count=2, max_separation=523.25 = 0x4402D000,
+    last_update=1461176500) followed by the two packed points, exactly the
+    reference's count-then-records stream (Batch.java:92-146)."""
+    from reporter_tpu.stream.batch import Batch
+    from reporter_tpu.stream.point import Point
+
+    want = bytes.fromhex(
+        "00000002" "4402d000" "000000005717c8b4"
+        "42170000c2f4e66600000005000000005717c89c"
+        "42170193c2f4e5130000000c000000005717c8a1")
+    assert len(want) == 16 + 2 * 20  # >ifq header + two 20-byte points
+    b = Batch()
+    b.points = [
+        Point(lat=37.75, lon=-122.45, accuracy=5, time=1461176476),
+        Point(lat=37.751537, lon=-122.447412, accuracy=12, time=1461176481),
+    ]
+    b.max_separation = 523.25
+    b.last_update = 1461176500
+    assert b.pack() == want
+    rt = Batch.unpack(want)
+    assert (len(rt.points), rt.max_separation, rt.last_update) == (
+        2, 523.25, 1461176500)
+    # point lat/lon round-trip at float32 wire precision: byte-compare
+    assert rt.pack() == want
+
+
+def test_csv_row_and_tile_path_golden():
+    """The histogram CSV row (Segment.java:59-74: duration = round(max-min),
+    min floored, max ceiled, next_id empty when invalid) and the
+    time-quantised tile path (AnonymisingProcessor.java:184-188:
+    {start}_{start+q-1}/{level}/{tile_index})."""
+    from reporter_tpu.anonymise.tiles import TimeQuantisedTile
+    from reporter_tpu.stream.segment import Segment
+
+    s = Segment(id=180962560, next_id=246240391,
+                min=1461176476.25, max=1461176502.75, length=284, queue=37)
+    assert s.csv_row(mode="auto", source="ref") == (
+        "180962560,246240391,27,1,284,37,1461176476,1461176503,ref,auto")
+    s2 = Segment(id=180962560, next_id=None,
+                 min=1461176476.25, max=1461176502.75, length=284, queue=0)
+    assert s2.csv_row(mode="auto", source="ref") == (
+        "180962560,,27,1,284,0,1461176476,1461176503,ref,auto")
+    assert Segment.column_layout() == (
+        "segment_id,next_segment_id,duration,count,length,queue_length,"
+        "minimum_timestamp,maximum_timestamp,source,vehicle_type")
+
+    # tile id = low 25 bits of the segment id: 180962560 = 0xAC94500 ->
+    # low-25 0xC94500; level = low 3 bits (0), index = the next 22
+    # (0xC94500 >> 3 = 0x1928A0 = 1648800).  Hour quantisation bucket
+    # starting at 1461175200.
+    tile = TimeQuantisedTile(time_start=1461175200,
+                             tile_id=180962560 & 0x1FFFFFF)
+    assert tile.level == 0
+    assert tile.tile_index == 1648800
+    assert tile.path(3600) == "1461175200_1461178799/0/1648800"

@@ -176,3 +176,146 @@ def test_cuckoo_pack_high_load_bit_identical():
         assert u_nat.bmask == u_py.bmask
         assert u_nat.max_kicks == u_py.max_kicks
         np.testing.assert_array_equal(u_nat.packed, u_py.packed)
+
+
+# -- wide32 layout (single-hash 32-entry buckets) ----------------------------
+
+
+def _random_columns(rng, n):
+    keys = rng.choice(10_000_000, size=(n, 2), replace=False)
+    return (keys[:, 0].astype(np.int32), keys[:, 1].astype(np.int32),
+            (rng.random(n) * 1000).astype(np.float32),
+            (rng.random(n) * 100).astype(np.float32),
+            rng.integers(0, 1 << 20, n).astype(np.int32))
+
+
+@pytest.mark.parametrize("seed,n", [(1, 500), (2, 26000), (3, 0)])
+def test_wide_pack_python_native_bit_identical(seed, n):
+    """The C++ wide packer (rn_wide_pack) and the Python twin must produce
+    byte-identical tables on random key columns, including the empty
+    table."""
+    from reporter_tpu.native import get_lib
+    from reporter_tpu.tiles.ubodt import ubodt_from_columns
+
+    if get_lib() is None:
+        pytest.skip("native library unavailable")
+    rng = np.random.default_rng(seed)
+    src, dst, dist, tm, fe = _random_columns(rng, n)
+    u_py = ubodt_from_columns(src, dst, dist, tm, fe, delta=1000.0,
+                              layout="wide32", use_native=False)
+    u_nat = ubodt_from_columns(src, dst, dist, tm, fe, delta=1000.0,
+                               layout="wide32", use_native=True)
+    assert u_py.layout == u_nat.layout == "wide32"
+    assert u_py.max_probes == 1
+    assert u_nat.bmask == u_py.bmask
+    np.testing.assert_array_equal(u_nat.packed, u_py.packed)
+
+
+def test_wide_pack_grow_on_overflow():
+    """Forcing > 32 rows into one bucket (same (src, dst)-hash home via a
+    crafted load factor) must grow-and-retry, never corrupt: pack 200 rows
+    at a table size of 4 buckets (50 expected per bucket > 32) and verify
+    every key still resolves."""
+    from reporter_tpu.tiles.ubodt import WIDE_BUCKET, ubodt_from_columns
+
+    rng = np.random.default_rng(7)
+    src, dst, dist, tm, fe = _random_columns(rng, 200)
+    # load_factor > 1 forces an initial 4-bucket table; the packer must
+    # detect the overflow and double until every bucket fits
+    u = ubodt_from_columns(src, dst, dist, tm, fe, delta=1000.0,
+                           layout="wide32", load_factor=50.0,
+                           use_native=False)
+    assert u.n_buckets > 4
+    occupancy = (u.packed[:, :, 0] != -1).sum(axis=1)
+    assert occupancy.max() <= WIDE_BUCKET
+    for i in range(0, 200, 17):
+        d, t, f = u.lookup_full(int(src[i]), int(dst[i]))
+        assert d == pytest.approx(float(dist[i]), rel=1e-6)
+        assert f == int(fe[i])
+
+
+@pytest.mark.parametrize("seed", [11, 12])
+def test_layout_probe_equivalence_roundtrip(seed):
+    """Property-based round-trip: the SAME rows packed into both layouts
+    must answer every lookup identically — hits bit-for-bit (the stored
+    f32 payloads), misses as misses — on host and on device, with dedup
+    on and off."""
+    import jax.numpy as jnp
+
+    from reporter_tpu.ops.hashtable import ubodt_lookup
+    from reporter_tpu.tiles.ubodt import ubodt_from_columns
+
+    rng = np.random.default_rng(seed)
+    src, dst, dist, tm, fe = _random_columns(rng, 3000)
+    u_c = ubodt_from_columns(src, dst, dist, tm, fe, delta=1000.0,
+                             layout="cuckoo")
+    u_w = ubodt_from_columns(src, dst, dist, tm, fe, delta=1000.0,
+                             layout="wide32")
+    assert (u_c.max_probes, u_w.max_probes) == (2, 1)
+
+    # host probes: every packed key + guaranteed misses
+    for i in range(0, 3000, 113):
+        assert u_c.lookup_full(int(src[i]), int(dst[i])) == \
+            u_w.lookup_full(int(src[i]), int(dst[i]))
+    assert u_w.lookup(int(src[0]), int(dst[0]) + 10_000_001)[0] == float("inf")
+
+    # device probes over a duplicate-heavy query set (dedup's home turf):
+    # half real keys (some repeated), half random misses
+    du_c, du_w = u_c.to_device(), u_w.to_device()
+    qs = np.concatenate([src[rng.integers(0, 3000, 2048)],
+                         rng.integers(0, 1 << 24, 2048).astype(np.int32)])
+    qd = np.concatenate([dst[rng.integers(0, 3000, 2048)],
+                         rng.integers(0, 1 << 24, 2048).astype(np.int32)])
+    results = {}
+    for layout, du in (("cuckoo", du_c), ("wide32", du_w)):
+        for dedup in (False, True):
+            r = ubodt_lookup(du, jnp.asarray(qs), jnp.asarray(qd),
+                             dedup=dedup)
+            results[(layout, dedup)] = tuple(np.asarray(x) for x in r)
+    base = results[("cuckoo", False)]
+    for key, r in results.items():
+        for i in range(3):
+            np.testing.assert_array_equal(r[i], base[i], err_msg=str(key))
+
+
+def test_dedup_overflow_fallback_exact():
+    """When a batch's distinct-pair count exceeds the static dedup budget
+    (all-distinct keys), the in-program fallback must return exactly the
+    plain probe's results — the truncation edge case of the dedup path."""
+    import jax.numpy as jnp
+
+    from reporter_tpu.ops.hashtable import (
+        _DEDUP_MIN_PAIRS, ubodt_lookup)
+    from reporter_tpu.tiles.ubodt import ubodt_from_columns
+
+    rng = np.random.default_rng(21)
+    src, dst, dist, tm, fe = _random_columns(rng, 4000)
+    u = ubodt_from_columns(src, dst, dist, tm, fe, delta=1000.0,
+                           layout="wide32")
+    du = u.to_device()
+    n = max(2 * _DEDUP_MIN_PAIRS, 4000)
+    qs = src[np.arange(n) % 4000]
+    qd = dst[np.arange(n) % 4000]  # aligned -> all-hit, all-distinct
+    r_d = ubodt_lookup(du, jnp.asarray(qs), jnp.asarray(qd), dedup=True)
+    r_p = ubodt_lookup(du, jnp.asarray(qs), jnp.asarray(qd), dedup=False)
+    for a, b in zip(r_d, r_p):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_relayout_preserves_content():
+    """relayout() repacks rows without a graph re-search: content-identical
+    lookups, layout-appropriate probe bound, original left untouched."""
+    from reporter_tpu.tiles.ubodt import ubodt_from_columns
+
+    rng = np.random.default_rng(31)
+    src, dst, dist, tm, fe = _random_columns(rng, 1000)
+    u_c = ubodt_from_columns(src, dst, dist, tm, fe, delta=750.0)
+    u_w = u_c.relayout("wide32")
+    assert u_c.layout == "cuckoo" and u_w.layout == "wide32"
+    assert u_w.delta == u_c.delta and u_w.num_rows == u_c.num_rows
+    assert u_w.relayout("wide32") is u_w  # no-op when layouts match
+    back = u_w.relayout("cuckoo")
+    for i in range(0, 1000, 41):
+        want = u_c.lookup_full(int(src[i]), int(dst[i]))
+        assert u_w.lookup_full(int(src[i]), int(dst[i])) == want
+        assert back.lookup_full(int(src[i]), int(dst[i])) == want

@@ -1,21 +1,47 @@
-"""On-chip stage isolation for the compact kernel's UBODT probe cost.
+"""Stage isolation for the compact kernel's UBODT probe cost.
 
 Times `match_batch_compact_packed` at the short-cohort fleet shape
-[512, 64] in three configs:
-  full    -- as shipped
-  noprobe -- ubodt_lookup stubbed to constants (gathers + select removed)
-  noselect-- _select replaced by a plain lane-reduce (gathers kept)
+[512, 64] across the memory-system configs:
+  full        -- as shipped (cuckoo layout, no dedup)
+  noprobe     -- ubodt_lookup stubbed to constants (gathers + select removed)
+  noselect    -- gathers kept, select trivialised
+  rollsel     -- select's spread-matmul replaced by static lane rolls
+  dedup       -- cuckoo + in-batch probe dedup (the REAL dedup path)
+  wide32      -- the REAL wide32 single-hash layout (ops/hashtable.py), not
+                 a mock: one 1 KB row gather per probe
+  wide32_dedup-- both knobs, the round-6 end state
 
-The table is a random REAL-SIZED [2^20, 128] int32 cuckoo image so the
-gather physics (row count, table footprint) match the bench; results are
-all-miss garbage, which costs the same as hits.  Each timed call
-perturbs the input slightly -- the tunnel relay memoises identical
-executions, so repeating the same args measures nothing.
+The timed tables are random REAL-SIZED images ([2^20, 128] cuckoo /
+[2^20, 256] wide32 int32) so the gather physics (row count, table
+footprint) match the bench; results are all-miss garbage, which costs the
+same as hits.  A small REAL table additionally feeds the probe-stats
+program so the reported ``probe_pairs``/``distinct_pairs`` numbers (the
+dedup headroom) are measured, not assumed.
+
+Each config also reports ``rows_per_rep``: the executed bucket-row gather
+count per kernel rep, the row-count-bound cost model the relayout targets
+(docs/gather-experiments.md: rows/s is flat across row widths).  This is
+the CPU-measurable proxy for the on-chip stage win — run with
+``JAX_PLATFORMS=cpu`` for the accounting + dedup measurements without a
+chip (timings then reflect the CPU backend and are labelled so).
+
+Measurement traps (formerly doc lore, now asserted in-run):
+  * relay memoisation -- through the tunnel, repeating an identical call
+    is memoised by the relay and `block_until_ready` is a no-op.  The
+    probe times in-jit 8x repeats with per-iteration input perturbation;
+    it ALSO times one identical-args repeat and RAISES if the perturbed
+    path is indistinguishable from the memoised one (tainted measurement).
+  * DRAM-page locality -- a `+i` index walk gives consecutive iterations
+    page locality that inflates gather rates ~8x; the in-jit loop salts
+    indices multiplicatively (see tools/gather_probe.py, which asserts the
+    walk-vs-salt inflation directly).
 
 Usage: JAX_PLATFORMS=axon python tools/kernel_stage_probe.py
+       JAX_PLATFORMS=cpu  python tools/kernel_stage_probe.py   # proxy mode
 """
 from __future__ import annotations
 
+import functools
 import json
 import os
 import sys
@@ -27,16 +53,18 @@ sys.path.insert(0, REPO)
 
 def main() -> int:
     os.environ.setdefault("JAX_PLATFORMS", "axon")
+    on_chip = os.environ["JAX_PLATFORMS"] == "axon"
     import jax
     import jax.numpy as jnp
     import numpy as np
 
-    from reporter_tpu.utils.relay import acquire_axon_lock
+    if on_chip:
+        from reporter_tpu.utils.relay import acquire_axon_lock
 
-    lock = acquire_axon_lock(timeout=120)
-    if lock is None:
-        print(json.dumps({"error": "axon_lock_timeout"}))
-        return 5
+        lock = acquire_axon_lock(timeout=120)
+        if lock is None:
+            print(json.dumps({"error": "axon_lock_timeout"}))
+            return 5
     print("device:", jax.devices()[0].device_kind, file=sys.stderr)
 
     from reporter_tpu.matching import MatcherConfig, SegmentMatcher
@@ -55,13 +83,20 @@ def main() -> int:
     params = matcher._params
 
     rng = np.random.default_rng(0)
-    n_buckets = 1 << 20
-    du = DeviceUBODT(
+    # real-sized garbage tables for gather physics; CPU proxy mode shrinks
+    # them (the accounting below is size-independent)
+    n_buckets = 1 << (20 if on_chip else 14)
+    du_cuckoo = DeviceUBODT(
         jnp.asarray(rng.integers(0, 1 << 30, (n_buckets, 128),
                                  dtype=np.int32)),
         n_buckets - 1)
+    du_wide = DeviceUBODT(
+        jnp.asarray(rng.integers(0, 1 << 30, (n_buckets, 256),
+                                 dtype=np.int32)),
+        n_buckets - 1, layout="wide32")
 
-    B, T = 512, 64
+    B, T = (512, 64) if on_chip else (64, 64)
+    K = cfg.beam_k
     # plausible in-bbox tracks so the candidate stage does real work
     x0 = float(np.mean(arrays.node_x)); y0 = float(np.mean(arrays.node_y))
     px = x0 + rng.normal(0, 400, (B, T)).cumsum(axis=1) * 0.1
@@ -71,48 +106,108 @@ def main() -> int:
     xin0 = np.asarray(vt.pack_inputs(px, py, tm, valid))
 
     LOOPS = 8
+    memo_evidence = {}
 
-    def timeit(fn, label):
+    def timeit(fn, label, dux):
         # Through the tunnel, block_until_ready is a no-op -- the sync
         # happens on the device-to-host fetch.  So: repeat the kernel
         # in-jit with a per-iteration input perturbation (the relay
         # memoises identical executions) and time one scalar fetch; the
         # ~70 ms transport floor is shared by every config and the 8x
         # kernel repetition dominates the differences.
-        def looped(dgx, dux, xin, p, k):
+        def looped(dgx, dx, xin, p, k):
             def body(i, acc):
-                r = fn(dgx, dux, xin + i.astype(jnp.float32) * 1e-3, p, k)
+                r = fn(dgx, dx, xin + i.astype(jnp.float32) * 1e-3, p, k)
                 return acc + jnp.sum(r)
             return jax.lax.fori_loop(0, LOOPS, body, jnp.int32(0))
 
         f = jax.jit(looped, static_argnums=(4,))
         xin = jnp.asarray(xin0)
-        np.asarray(f(dg, du, xin, params, cfg.beam_k))  # compile + warm
+        np.asarray(f(dg, dux, xin, params, cfg.beam_k))  # compile + warm
         ts = []
         for i in range(1, 4):
             xv = jnp.asarray(xin0 + np.float32(i) * 1e-2)
             t0 = time.time()
-            np.asarray(f(dg, du, xv, params, cfg.beam_k))
+            np.asarray(f(dg, dux, xv, params, cfg.beam_k))
             ts.append(time.time() - t0)
         ms = round(min(ts) * 1000 / LOOPS, 1)
-        print("%-9s min %.1f ms/iter  (calls %s ms)" %
+        # memoisation trap, asserted in-run: an IDENTICAL-args repeat must
+        # not be what we measured.  If the relay memoises (repeat much
+        # cheaper than a fresh perturbed call) that is fine -- the timed
+        # calls above perturb -- but if the perturbed calls are themselves
+        # indistinguishable from the memoised floor, the measurement is
+        # tainted and the tool refuses to print a number for it.
+        xv = jnp.asarray(xin0 + np.float32(3) * 1e-2)  # same as last call
+        t0 = time.time()
+        np.asarray(f(dg, dux, xv, params, cfg.beam_k))
+        memo_ms = (time.time() - t0) * 1000
+        memo_detected = memo_ms < 0.25 * min(ts) * 1000
+        memo_evidence[label] = {
+            "memo_repeat_ms": round(memo_ms, 1),
+            "memo_detected": bool(memo_detected),
+        }
+        if memo_detected and min(ts) * 1000 < 2.0 * memo_ms:
+            raise RuntimeError(
+                "%s: perturbed-call time (%.1f ms) is within 2x of the "
+                "memoised repeat (%.1f ms) -- relay memoisation is "
+                "swallowing the kernel; measurement tainted"
+                % (label, min(ts) * 1000, memo_ms))
+        print("%-12s min %.1f ms/iter  (calls %s ms)" %
               (label, ms, [round(t * 1000) for t in ts]), file=sys.stderr)
         return ms
 
-    out = {}
-    out["full"] = timeit(vt.match_batch_compact_packed, "full")
+    # executed bucket-row gathers per kernel rep: the row-count-bound cost
+    # model (docs/gather-experiments.md).  Dedup's budget is the static
+    # compacted capacity -- the data-dependent distinct count is measured
+    # separately below and must fit it for the deduped gather to run.
+    n_pairs = B * (T - 1) * K * K
+    dedup_m = max(ht._DEDUP_MIN_PAIRS // 2, n_pairs // ht._DEDUP_CAP_RATIO)
+    rows_per_rep = {
+        "full": 2 * n_pairs,
+        "noprobe": 0,
+        "noselect": 2 * n_pairs,
+        "rollsel": 2 * n_pairs,
+        "dedup": 2 * dedup_m,
+        "wide32": n_pairs,
+        "wide32_dedup": dedup_m,
+    }
+
+    out = {"shape": [B, T], "probe_pairs_per_rep": n_pairs,
+           "dedup_budget": dedup_m, "rows_per_rep": rows_per_rep,
+           "platform": "tpu" if on_chip else "cpu-proxy"}
+
+    # measured dedup headroom on the REAL (small) table: distinct pairs per
+    # dispatch from the probe-stats program -- if distinct exceeded the
+    # budget the deduped configs would run their full-width fallback, so
+    # assert the accounting is honest for THIS batch
+    from reporter_tpu.ops.diagnostics import ubodt_probe_stats
+
+    st = np.asarray(jax.jit(
+        functools.partial(ubodt_probe_stats, delta=2000.0),
+        static_argnums=(4,))(
+            dg, matcher._du, jnp.asarray(xin0), params, cfg.beam_k))
+    out["measured"] = {"probe_pairs": int(st[0]),
+                       "distinct_pairs": int(st[4]),
+                       "dedup_ratio": round(int(st[0]) / max(int(st[4]), 1), 2)}
+    if int(st[4]) > dedup_m:
+        out["note"] = ("distinct_pairs exceed the dedup budget on this "
+                       "batch: deduped configs fell back to full width")
+    print("dedup headroom: %s" % (out["measured"],), file=sys.stderr)
+
+    out["full"] = timeit(vt.match_batch_compact_packed, "full", du_cuckoo)
 
     real_lookup = ht.ubodt_lookup
     real_select = ht._select
 
-    def stub_lookup(u, src, dst):
+    def stub_lookup(u, src, dst, dedup=False):
         s, d = jnp.broadcast_arrays(src, dst)
         z = (s + d).astype(jnp.float32)
         return z * 0 + 750.0, z * 0 + 30.0, jnp.zeros_like(s)
 
     try:
         vt.ubodt_lookup = stub_lookup
-        out["noprobe"] = timeit(vt.match_batch_compact_packed, "noprobe")
+        out["noprobe"] = timeit(vt.match_batch_compact_packed, "noprobe",
+                                du_cuckoo)
     finally:
         vt.ubodt_lookup = real_lookup
 
@@ -123,7 +218,8 @@ def main() -> int:
 
     try:
         ht._select = cheap_select
-        out["noselect"] = timeit(vt.match_batch_compact_packed, "noselect")
+        out["noselect"] = timeit(vt.match_batch_compact_packed, "noselect",
+                                 du_cuckoo)
     finally:
         ht._select = real_select
 
@@ -134,8 +230,7 @@ def main() -> int:
         # per-entry src AND dst via a static +1 lane roll instead of the
         # [LANES, LANES] spread matmul; field values picked by rolling the
         # hit flag onto each field lane
-        lanes = rows.shape[-1]
-        fld = jax.lax.iota(jnp.int32, lanes) % ROW_W
+        fld = jax.lax.iota(jnp.int32, rows.shape[-1]) % ROW_W
         m_src = (rows == src[..., None]) & (fld == F_SRC)
         m_dst = (rows == dst[..., None]) & (fld == F_DST)
         hit = jnp.roll(m_src, F_DST - F_SRC, axis=-1) & m_dst
@@ -150,43 +245,19 @@ def main() -> int:
 
     try:
         ht._select = roll_select
-        out["rollsel"] = timeit(vt.match_batch_compact_packed, "rollsel")
+        out["rollsel"] = timeit(vt.match_batch_compact_packed, "rollsel",
+                                du_cuckoo)
     finally:
         ht._select = real_select
 
-    # end-state mock of the wide single-hash layout: BUCKET=32, one 1 KB
-    # row per (src, dst) pair, select over 256 lanes with a local spread
-    # matrix.  Table values are garbage (all-miss == same cost as hits).
-    du_wide = DeviceUBODT(
-        jnp.asarray(rng.integers(0, 1 << 30, (n_buckets, 256),
-                                 dtype=np.int32)),
-        n_buckets - 1)
-    lanes = 256
-    li = np.arange(lanes)
-    same_entry = (li[:, None] // 8) == (li[None, :] // 8)
-    is_key = (li[:, None] % 8 == 0) | (li[:, None] % 8 == 1)
-    spread = jnp.asarray((same_entry & is_key).astype(np.float32))
+    # the real dedup + wide32 code paths (ops/hashtable.py) -- the round-5
+    # "wide32" mock this tool used to carry became product code in round 6
+    dedup_fn = functools.partial(vt.match_batch_compact_packed, dedup=True)
+    out["dedup"] = timeit(dedup_fn, "dedup", du_cuckoo)
+    out["wide32"] = timeit(vt.match_batch_compact_packed, "wide32", du_wide)
+    out["wide32_dedup"] = timeit(dedup_fn, "wide32_dedup", du_wide)
 
-    def wide_lookup(u, src, dst):
-        src, dst = jnp.broadcast_arrays(src, dst)
-        b1 = ht.device_pair_hash(src, dst, du_wide.bmask)
-        rows = du_wide.packed[b1]  # [..., 256]: ONE 1 KB DMA per pair
-        fld = jax.lax.iota(jnp.int32, lanes) % 8
-        m = ((rows == src[..., None]) & (fld == 0)) | (
-            (rows == dst[..., None]) & (fld == 1))
-        both = jnp.dot(m.astype(jnp.float32), spread) == 2.0
-        vf = jax.lax.bitcast_convert_type(rows, jnp.float32)
-        dist = jnp.min(jnp.where(both & (fld == 2), vf, jnp.inf), axis=-1)
-        time_ = jnp.min(jnp.where(both & (fld == 3), vf, jnp.inf), axis=-1)
-        first = jnp.max(jnp.where(both & (fld == 4), rows, -1), axis=-1)
-        return dist, time_, first
-
-    try:
-        vt.ubodt_lookup = wide_lookup
-        out["wide32"] = timeit(vt.match_batch_compact_packed, "wide32")
-    finally:
-        vt.ubodt_lookup = real_lookup
-
+    out["traps"] = memo_evidence
     print(json.dumps(out))
     return 0
 
