@@ -91,53 +91,15 @@ def _relay_ports_open():
 
 def _last_onchip():
     """Provenance block for the newest VERIFIED on-chip capture under
-    docs/measurements/ (platform "tpu" only): file path, capture date, git
-    hash, and the headline numbers.  Embedded in every emitted JSON line so
-    the official artifact carries the on-chip evidence even when the relay
-    is down for the whole driver window (VERDICT r05 next #1c).  Returns
-    None when no on-chip capture exists."""
-    import glob
-    import re
+    docs/measurements/ (platform "tpu" only) — the scan itself lives in
+    obs/attrib (last_onchip), shared with the /statusz attribution
+    summary.  Embedded in every emitted JSON line so the official artifact
+    carries the on-chip evidence even when the relay is down for the whole
+    driver window (VERDICT r05 next #1c).  Returns None when no on-chip
+    capture exists."""
+    from reporter_tpu.obs.attrib import last_onchip
 
-    repo = os.path.dirname(os.path.abspath(__file__))
-    best = None
-    for path in glob.glob(os.path.join(repo, "docs", "measurements", "*.json")):
-        try:
-            with open(path) as f:
-                d = json.load(f)
-        except (OSError, json.JSONDecodeError, UnicodeDecodeError):
-            continue
-        if d.get("platform") != "tpu" or d.get("value") is None:
-            continue
-        m = re.search(r"(\d{4}-\d{2}-\d{2})", os.path.basename(path))
-        # capture date from the filename (checkout resets mtimes); within
-        # one day, the best headline — same-day captures are the same build
-        # at different operating points, and the provenance block should
-        # carry the one the round's claims rest on
-        key = (m.group(1) if m else "", float(d.get("value") or 0))
-        if best is None or key > best[0]:
-            best = (key, path, d)
-    if best is None:
-        return None
-    key, path, d = best
-    git_hash = None
-    try:
-        git_hash = subprocess.run(
-            ["git", "rev-parse", "--short", "HEAD"], cwd=repo,
-            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, timeout=10,
-        ).stdout.decode().strip() or None
-    except (OSError, subprocess.SubprocessError):
-        pass
-    return {
-        "file": os.path.relpath(path, repo),
-        "captured": key[0] or None,
-        "git": git_hash,
-        "traces_per_sec": d.get("value"),
-        "points_per_sec": d.get("points_per_sec"),
-        "vs_baseline": d.get("vs_baseline"),
-        "device_util": d.get("device_util"),
-        "kernel_by_cohort": d.get("kernel_by_cohort"),
-    }
+    return last_onchip(os.path.dirname(os.path.abspath(__file__)))
 
 
 # ---------------------------------------------------------------------------
@@ -452,21 +414,23 @@ def run_device() -> int:
     # HBM-traffic model for the roofline (VERDICT r03 weak #5): the two
     # dominant gather streams per trace are the UBODT transition probes
     # (max_probes bucket rows per [T-1, K, K] entry: 2 x 512 B cuckoo /
-    # 1 x 1 KB wide32) and the candidate sweep (9 cell rows of cap 32-byte
-    # records per point).  Probe dedup lowers the EXECUTED row count below
-    # this model (per-dispatch, data-dependent), so with dedup on the
-    # roofline is an upper bound on probe traffic.
-    from reporter_tpu.tiles.ubodt import ROW_W
+    # 1 x 1 KB wide32) and the 2x2 quadrant candidate sweep (4 cell rows
+    # of cap 32-byte records per point).  The accounting lives in
+    # obs/attrib.roofline_block, shared with the probe tools; probe dedup
+    # lowers the EXECUTED row count (reported as rows_per_rep) below the
+    # byte model, so with dedup on the GB/s figure is an upper bound on
+    # probe traffic.
+    from reporter_tpu.obs import attrib as obs_attrib
 
     grid_cap = int(arrays.grid_items.shape[1])
-    hbm_peak = float(os.environ.get("BENCH_HBM_GBS", "819")) * 1e9  # v5e
+    hbm_gbs = float(os.environ.get("BENCH_HBM_GBS", "819"))  # v5e
 
-    def _bytes_per_trace(T: int) -> int:
-        k = cfg.beam_k
-        row_bytes = ubodt.bucket_entries * ROW_W * 4
-        ubodt_b = (T - 1) * k * k * ubodt.max_probes * row_bytes
-        cand_b = T * 9 * grid_cap * 32  # nine cell rows of cap records
-        return ubodt_b + cand_b
+    def _roofline(T: int, n: int, secs: float) -> dict:
+        return obs_attrib.roofline_block(
+            n, T, cfg.beam_k, secs,
+            bucket_entries=ubodt.bucket_entries, max_probes=ubodt.max_probes,
+            grid_cap=grid_cap, hbm_gbs=hbm_gbs,
+            dedup=bool(getattr(matcher, "_probe_dedup", False)))
 
     kernel_secs = 0.0
     kernel_by_cohort = {}
@@ -493,11 +457,7 @@ def run_device() -> int:
         kernel_secs += dt
         kernel_by_cohort[name] = len(ss) / dt
         kernel_secs_by_cohort[name] = round(dt, 4)
-        gbs = _bytes_per_trace(T) * len(ss) / dt / 1e9
-        roofline[name] = {
-            "est_gather_gb_per_s": round(gbs, 2),
-            "hbm_frac": round(gbs * 1e9 / hbm_peak, 4),
-        }
+        roofline[name] = _roofline(T, len(ss), dt)
     # long cohort: W-window chunks with carried state, exactly the program
     # set SegmentMatcher._dispatch_long dispatches — the hoisted
     # chunk-batched precompute + chain pipeline by default, the legacy
@@ -538,35 +498,70 @@ def run_device() -> int:
     kernel_secs += dt
     kernel_by_cohort["long"] = len(ss) / dt
     kernel_secs_by_cohort["long"] = round(dt, 4)
-    gbs = _bytes_per_trace(T) * len(ss) / dt / 1e9
-    roofline["long"] = {
-        "est_gather_gb_per_s": round(gbs, 2),
-        "hbm_frac": round(gbs * 1e9 / hbm_peak, 4),
-    }
+    roofline["long"] = _roofline(T, len(ss), dt)
 
-    # profiler trace artifact (TPU only; BENCH_PROFILE=0 disables): one
-    # kernel rep per cohort under jax.profiler so a roofline argument can
-    # be checked against the real timeline, not just the byte model
+    # named-stage attribution (obs/attrib; BENCH_PROFILE=0 disables): one
+    # kernel rep per cohort, each in its OWN jax.profiler window, parsed
+    # into the per-(stage, cohort) device-time table — the automated
+    # replacement for the hand-run round-4/5 attribution ritual
+    # (docs/onchip-attribution.md).  Runs on EVERY platform: a CPU capture
+    # resolves stages through the compiled modules' op-name metadata, so
+    # the full round-trip works without a chip (stage RATIOS measured on
+    # the cpu backend still do not transfer to the chip — the platform
+    # label rides the block).  The raw traces stay on disk for
+    # tools/trace_analyze.py.
     profile_dir = None
-    if platform == "tpu" and os.environ.get("BENCH_PROFILE", "1") != "0":
+    attrib_block = None
+    attrib_reason = None
+    if os.environ.get("BENCH_PROFILE", "1") != "0":
         try:
-            import jax.profiler as _prof
-
             # under the ignored scratch dir, not the repo root (VERDICT r05
             # weak #5: profiler output was a root-level dropping)
             profile_dir = os.path.abspath(os.environ.get(
                 "BENCH_PROFILE_DIR", os.path.join("scratch", "bench_profile")))
-            os.makedirs(profile_dir, exist_ok=True)
-            with _prof.trace(profile_dir):
-                for name in ("short", "med"):
-                    px, py, tm, valid = cohort_xy[name]
+            stages_by_cohort = {}
+            totals_ms = {}
+            plat_seen = None
+            for cname, T, ss in cohorts:
+                if cname == "long":
+                    # the pre/chain programs registered with obs/attrib at
+                    # their first dispatch; the capture maps through them
+                    run, programs = (lambda: np.asarray(_long_pass())), None
+                else:
+                    px, py, tm, valid = cohort_xy[cname]
                     fn, args = _compact_args(px, py, tm, valid)
-                    jax.block_until_ready(fn(*args, cfg.beam_k))
-                jax.block_until_ready(_long_pass())
-            _stderr("profiler trace written to %s" % profile_dir)
+                    cargs = args + (cfg.beam_k,)
+                    run = lambda fn=fn, cargs=cargs: np.asarray(fn(*cargs))
+                    programs = [(fn, cargs)]
+                res = obs_attrib.capture(
+                    run, reps=1, out_dir=os.path.join(profile_dir, cname),
+                    programs=programs)
+                stages_by_cohort[cname] = res["stages_ms"]
+                totals_ms[cname] = res["device_total_ms"]
+                plat_seen = res["platform"]
+            attrib_block = {
+                "platform": plat_seen,
+                "captured": time.strftime("%Y-%m-%d"),
+                "scenario": scenario,
+                "edges": int(arrays.num_edges),
+                "kernel": primary_kernel,
+                "ubodt_layout": ubodt.layout,
+                "probe_dedup": bool(getattr(matcher, "_probe_dedup", False)),
+                "stages_ms_by_cohort": stages_by_cohort,
+                "device_total_ms_by_cohort": totals_ms,
+                "roofline": roofline,
+                "trace_dir": profile_dir,
+            }
+            attrib_block["archived"] = obs_attrib.archive(
+                attrib_block, plat_seen)
+            _stderr("stage attribution captured per cohort under %s "
+                    "(archived: %s)" % (profile_dir, attrib_block["archived"]))
         except Exception as e:  # noqa: BLE001 - diagnostics must not sink the bench
-            _stderr("profiler trace failed: %s" % (e,))
-            profile_dir = None
+            _stderr("attribution capture failed: %s" % (e,))
+            attrib_block, profile_dir = None, None
+            attrib_reason = "capture failed: %s" % (e,)
+    else:
+        attrib_reason = "BENCH_PROFILE=0"
 
     # --kernel comparison: time BOTH viterbi forwards over the same cohorts
     # (same padded shapes, same fetch discipline) so one bench line carries
@@ -769,6 +764,8 @@ def run_device() -> int:
         "kernel_secs_by_cohort": kernel_secs_by_cohort,
         "dispatch_by_cohort": dispatch_by_cohort,
         "roofline": roofline,
+        "attrib": attrib_block,
+        "attrib_reason": attrib_reason,
         "profile_dir": profile_dir,
         "device_util": round(device_util, 3),
         "warmup_s": round(warmup_s, 1),
@@ -1169,6 +1166,13 @@ def main() -> int:
             "last_onchip": _last_onchip(),
             "acquire": {"diag": diag, "attempts": attempts},
         }
+        # the attrib block rides every emitted line (schema-complete even
+        # on the banked/no-result paths: an explicit null carries a reason)
+        out["attrib"] = (best or {}).get("attrib")
+        if out["attrib"] is None:
+            out["attrib_reason"] = (
+                (best or {}).get("attrib_reason")
+                or "terminated before an attribution capture was banked")
         if best is None:
             out["note"] = ("terminated during accelerator wait before any "
                            "result was banked")
@@ -1282,6 +1286,8 @@ def main() -> int:
         print(json.dumps({"metric": "traces_matched_per_sec_per_chip", "value": None,
                           "unit": "traces/s", "vs_baseline": None,
                           "error": "device worker produced no result",
+                          "attrib": None,
+                          "attrib_reason": "device worker produced no result",
                           "last_onchip": _last_onchip(),
                           "acquire": {"diag": diag, "attempts": attempts}}))
         return 1
@@ -1305,7 +1311,8 @@ def main() -> int:
               "dispatch_floor_ms", "viterbi_kernel", "kernel_compare",
               "latency_cohort", "e2e_mode", "forward_by_cohort", "kernel_traces_per_sec",
               "kernel_points_per_sec", "kernel_by_cohort",
-              "kernel_secs_by_cohort", "dispatch_by_cohort", "roofline", "profile_dir",
+              "kernel_secs_by_cohort", "dispatch_by_cohort", "roofline",
+              "attrib", "attrib_reason", "profile_dir",
               "device_util", "warmup_s", "agreement", "ubodt_miss", "probe_dedup",
               "oracle_cmp", "agreement_by_cohort", "device_mb",
               "fleet", "scenario", "edges", "ubodt_rows", "ubodt_layout",

@@ -485,7 +485,9 @@ class SegmentMatcher:
             res = fn(self._dg, self._du, xin, self._params, self.cfg.beam_k)
             C_DISPATCHES.labels(kernel).inc()
             C_DISPATCH_COHORT.labels("bucketed", "compact").inc()
-            self._note_dispatch(px.shape, _time.monotonic() - t0, kernel=kernel)
+            self._note_dispatch(
+                px.shape, _time.monotonic() - t0, kernel=kernel, fn=fn,
+                args=(self._dg, self._du, xin, self._params, self.cfg.beam_k))
             if self._probe_every:
                 self._dispatch_count += 1
                 if self._dispatch_count % self._probe_every == 0:
@@ -495,16 +497,24 @@ class SegmentMatcher:
         return ("cpu", self._cpu.run_batch(px, py, times, valid))
 
     def _note_dispatch(self, shape, dt: float, kind: str = "",
-                       kernel: str = "scan") -> None:
+                       kernel: str = "scan", fn=None, args=None) -> None:
         """Feed the compile counters on a shape's first dispatch (the call
         that blocked on XLA).  ``shape`` is the padded (B, T) the kernel
         compiled for; ``kind`` distinguishes the carry-chain program and
-        ``kernel`` the viterbi forward (scan / assoc) that compiled."""
+        ``kernel`` the viterbi forward (scan / assoc) that compiled.
+        ``fn``/``args`` (the dispatched jit and its call arguments)
+        register the program with obs/attrib for named-stage attribution —
+        array args are abstracted to ShapeDtypeStructs immediately, so
+        nothing stays pinned."""
         key = (kind, kernel) + tuple(shape)
         if key in self._compiled_shapes:
             return
         self._compiled_shapes.add(key)
         lbl = kind + "%dx%d" % tuple(shape)
+        if fn is not None and args is not None:
+            from ..obs import attrib
+
+            attrib.register_program("%s:%s" % (lbl, kernel), fn, args)
         C_COMPILES.labels(lbl, kernel).inc()
         C_COMPILE_S.labels(lbl, kernel).inc(dt)
         # structured compile event: the dispatch thread is bound to the
@@ -1010,8 +1020,11 @@ class SegmentMatcher:
                 )
                 C_DISPATCHES.labels(kernel).inc()
                 C_DISPATCH_COHORT.labels("long", "carry").inc()
-                self._note_dispatch((B_pad, W), _time.monotonic() - t0,
-                                    kind="carry", kernel=kernel)
+                self._note_dispatch(
+                    (B_pad, W), _time.monotonic() - t0, kind="carry",
+                    kernel=kernel, fn=fn_carry,
+                    args=(self._dg, self._du,
+                          xin[:, :, :W], self._params, k, carry))
                 _bank(out)
             return host_parts, outs
 
@@ -1042,7 +1055,9 @@ class SegmentMatcher:
                          self._params, k)
             C_DISPATCH_COHORT.labels("long", "pre").inc()
             self._note_dispatch((rung, W), _time.monotonic() - t0,
-                                kind="pre", kernel="none")
+                                kind="pre", kernel="none", fn=fn_pre,
+                                args=(self._dg, self._du, seg,
+                                      self._params, k))
             for i in range(m):
                 c = c0 + i
                 pre_c = jax.tree_util.tree_map(
@@ -1056,7 +1071,10 @@ class SegmentMatcher:
                 C_DISPATCHES.labels(kernel).inc()
                 C_DISPATCH_COHORT.labels("long", "chain").inc()
                 self._note_dispatch((B_pad, W), _time.monotonic() - t0,
-                                    kind="chain", kernel=kernel)
+                                    kind="chain", kernel=kernel, fn=fn_chain,
+                                    args=(self._dg, self._du, pre_c,
+                                          xin[:, :, :W], self._params, k,
+                                          carry))
                 _bank(out)
         return host_parts, outs
 
@@ -1122,21 +1140,7 @@ class SegmentMatcher:
         if batch_sizes is None:
             batch_sizes = list(
                 getattr(self.cfg, "warmup_batch_sizes", None) or (1,))
-        ax, ay, bx, by = self._probe_edge_coords()
-
-        def _dummy_traces(n: int, b: int):
-            xs = np.linspace(ax, bx, n)
-            ys = np.linspace(ay, by, n)
-            lat, lon = self.arrays.proj.to_latlon(xs, ys)
-            tr = {
-                "uuid": "_warmup",
-                "trace": [
-                    {"lat": float(a), "lon": float(o), "time": 1.0 + 5.0 * i}
-                    for i, (a, o) in enumerate(zip(lat, lon))
-                ],
-            }
-            return [tr] * b
-
+        _dummy_traces = self.dummy_traces
         n_shapes = 0
         for n in lengths:
             n = max(2, int(n))
@@ -1167,6 +1171,24 @@ class SegmentMatcher:
         C_WARM_S.inc(dt)
         log.info("matcher warmup: %d shapes in %.1fs", n_shapes, dt)
         return dt
+
+    def dummy_traces(self, n: int, b: int) -> List[dict]:
+        """``b`` copies of an ``n``-point synthetic trace along the graph's
+        first edge — the same full-dispatch-path probe warmup uses, also
+        driven by obs/attrib.capture_matcher (/debug/attrib's on-demand
+        capture) so the profiled programs are exactly the serving ones."""
+        ax, ay, bx, by = self._probe_edge_coords()
+        xs = np.linspace(ax, bx, n)
+        ys = np.linspace(ay, by, n)
+        lat, lon = self.arrays.proj.to_latlon(xs, ys)
+        tr = {
+            "uuid": "_warmup",
+            "trace": [
+                {"lat": float(a), "lon": float(o), "time": 1.0 + 5.0 * i}
+                for i, (a, o) in enumerate(zip(lat, lon))
+            ],
+        }
+        return [tr] * b
 
     def _probe_edge_coords(self):
         """Endpoints of the graph's first edge — the dummy-trace span used

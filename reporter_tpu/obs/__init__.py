@@ -10,7 +10,13 @@
               (GET /debug/traces; dumped on SIGTERM/fatal)
 ``log``       structured one-line-JSON/text event logger; one
               ``configure()`` shared by every entrypoint
-``profiler``  on-demand jax.profiler captures (GET /debug/profile)
+``profiler``  on-demand jax.profiler captures (GET /debug/profile),
+              single-flight across every capture kind
+``attrib``    named-stage device-time attribution: the kernels'
+              jax.named_scope labels parsed out of profiler captures
+              (GET /debug/attrib, bench.py's ``attrib`` block, the
+              reporter_stage_device_seconds gauges) plus the shared
+              roofline/row accounting and last_onchip provenance
 """
 
 from .metrics import (  # noqa: F401

@@ -47,6 +47,7 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
+from ..obs.attrib import stage
 from ..tiles.arrays import DeviceGraph
 
 # finite stand-in for +inf through the one-hot matmuls (inf * 0 = nan).
@@ -80,6 +81,11 @@ def find_candidates(dg: DeviceGraph, px, py, k: int, search_radius: float) -> Ca
     silently incomplete candidates (the quadrant block cannot cover the
     disk), because the radius is a traced value and cannot be checked at
     trace time here."""
+    with stage("candidate-sweep"):
+        return _find_candidates(dg, px, py, k, search_radius)
+
+
+def _find_candidates(dg: DeviceGraph, px, py, k: int, search_radius: float) -> Candidates:
     nx = dg.grid_dims[0]
     ny = dg.grid_dims[1]
     cell = dg.cell_size

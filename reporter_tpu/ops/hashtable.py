@@ -43,6 +43,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from ..obs.attrib import stage
 from ..tiles.ubodt import (
     F_DIST, F_DST, F_FE, F_SRC, F_TIME, ROW_W, DeviceUBODT,
 )
@@ -121,19 +122,23 @@ def _select(rows: jnp.ndarray, src: jnp.ndarray, dst: jnp.ndarray):
 def _lookup_plain(u: DeviceUBODT, src: jnp.ndarray, dst: jnp.ndarray):
     """The architectural-constant probe: one aligned row DMA per hash
     function (wide32: one; cuckoo: two, merged elementwise)."""
-    b1 = device_pair_hash(src, dst, u.bmask)
-    r1 = u.packed[b1]  # [..., 128 or 256]: one aligned lane-row DMA per probe
+    with stage("ubodt-probe"):
+        b1 = device_pair_hash(src, dst, u.bmask)
+        r1 = u.packed[b1]  # [..., 128 or 256]: one aligned lane-row DMA per probe
     if u.layout == "wide32":
-        return _select(r1, src, dst)
-    b2 = device_pair_hash2(src, dst, u.bmask)
-    r2 = u.packed[b2]
+        with stage("select"):
+            return _select(r1, src, dst)
+    with stage("ubodt-probe"):
+        b2 = device_pair_hash2(src, dst, u.bmask)
+        r2 = u.packed[b2]
     # select per bucket and combine: keys are unique, so at most one bucket
     # hits and an elementwise min/max merges exactly.  (Concatenating the
     # two row sets first materialised a [..., 2*BUCKET*ROW_W] array — ~11 ms
     # of pure layout work per kernel rep on chip, docs/onchip-attribution.md)
-    d1, t1, f1 = _select(r1, src, dst)
-    d2, t2, f2 = _select(r2, src, dst)
-    return jnp.minimum(d1, d2), jnp.minimum(t1, t2), jnp.maximum(f1, f2)
+    with stage("select"):
+        d1, t1, f1 = _select(r1, src, dst)
+        d2, t2, f2 = _select(r2, src, dst)
+        return jnp.minimum(d1, d2), jnp.minimum(t1, t2), jnp.maximum(f1, f2)
 
 
 def _lookup_dedup(u: DeviceUBODT, src: jnp.ndarray, dst: jnp.ndarray):
@@ -157,27 +162,30 @@ def _lookup_dedup(u: DeviceUBODT, src: jnp.ndarray, dst: jnp.ndarray):
         dist, time, fe = _lookup_plain(u, s, d)
         return dist.reshape(shape), time.reshape(shape), fe.reshape(shape)
 
-    iota = jax.lax.iota(jnp.int32, n)
-    # lexicographic stable sort carrying the original position
-    sk, dk, perm = jax.lax.sort((s, d, iota), num_keys=2)
-    head = jnp.concatenate([
-        jnp.ones((1,), bool), (sk[1:] != sk[:-1]) | (dk[1:] != dk[:-1])])
-    seg = jnp.cumsum(head.astype(jnp.int32)) - 1  # [n] segment id, ascending
-    n_unique = seg[-1] + 1
+    with stage("dedup-sort"):
+        iota = jax.lax.iota(jnp.int32, n)
+        # lexicographic stable sort carrying the original position
+        sk, dk, perm = jax.lax.sort((s, d, iota), num_keys=2)
+        head = jnp.concatenate([
+            jnp.ones((1,), bool), (sk[1:] != sk[:-1]) | (dk[1:] != dk[:-1])])
+        seg = jnp.cumsum(head.astype(jnp.int32)) - 1  # [n] segment id, ascending
+        n_unique = seg[-1] + 1
 
-    # compact segment-head keys into the M-slot buffer (drop-mode scatter:
-    # non-heads and beyond-budget heads target index m = out of bounds).
-    # Unfilled tail slots stay (0, 0) — probed but never read back.
-    tgt = jnp.where(head & (seg < m), seg, m)
-    cs = jnp.zeros((m,), jnp.int32).at[tgt].set(sk, mode="drop")
-    cd = jnp.zeros((m,), jnp.int32).at[tgt].set(dk, mode="drop")
+    with stage("dedup-compact"):
+        # compact segment-head keys into the M-slot buffer (drop-mode scatter:
+        # non-heads and beyond-budget heads target index m = out of bounds).
+        # Unfilled tail slots stay (0, 0) — probed but never read back.
+        tgt = jnp.where(head & (seg < m), seg, m)
+        cs = jnp.zeros((m,), jnp.int32).at[tgt].set(sk, mode="drop")
+        cd = jnp.zeros((m,), jnp.int32).at[tgt].set(dk, mode="drop")
 
     def _deduped(_):
         dist_u, time_u, fe_u = _lookup_plain(u, cs, cd)  # M row gathers
-        idx = jnp.minimum(seg, m - 1)
-        # scatter-back: sorted-order values, then undo the sort permutation
-        inv = jnp.zeros((n,), jnp.int32).at[perm].set(iota)
-        return dist_u[idx][inv], time_u[idx][inv], fe_u[idx][inv]
+        with stage("dedup-scatter"):
+            idx = jnp.minimum(seg, m - 1)
+            # scatter-back: sorted-order values, then undo the sort permutation
+            inv = jnp.zeros((n,), jnp.int32).at[perm].set(iota)
+            return dist_u[idx][inv], time_u[idx][inv], fe_u[idx][inv]
 
     def _full(_):
         return _lookup_plain(u, s, d)
@@ -237,23 +245,26 @@ def _ubodt_lookup_sharded(u: DeviceUBODT, src: jnp.ndarray, dst: jnp.ndarray):
     b1 = device_pair_hash(src, dst, u.bmask)
 
     def local_rows(b):
-        loc = b - lo
-        inr = (loc >= 0) & (loc < L)
-        r = u.packed[jnp.where(inr, loc, 0)]  # [..., 128 or 256]
-        # out-of-range buckets contribute entries that match nothing (-2)
-        return jnp.where(inr[..., None], r, -2)
+        with stage("ubodt-probe"):
+            loc = b - lo
+            inr = (loc >= 0) & (loc < L)
+            r = u.packed[jnp.where(inr, loc, 0)]  # [..., 128 or 256]
+            # out-of-range buckets contribute entries that match nothing (-2)
+            return jnp.where(inr[..., None], r, -2)
 
     if u.layout == "wide32":
-        d1, t1, f1 = _select(local_rows(b1), src, dst)
+        with stage("select"):
+            d1, t1, f1 = _select(local_rows(b1), src, dst)
     else:
         b2 = device_pair_hash2(src, dst, u.bmask)
         # per-bucket select + min/max merge, like the unsharded path: avoids
         # materialising the concatenated [..., 2*BUCKET*ROW_W] layout
-        da, ta, fa = _select(local_rows(b1), src, dst)
-        db, tb, fb = _select(local_rows(b2), src, dst)
-        d1 = jnp.minimum(da, db)
-        t1 = jnp.minimum(ta, tb)
-        f1 = jnp.maximum(fa, fb)
+        with stage("select"):
+            da, ta, fa = _select(local_rows(b1), src, dst)
+            db, tb, fb = _select(local_rows(b2), src, dst)
+            d1 = jnp.minimum(da, db)
+            t1 = jnp.minimum(ta, tb)
+            f1 = jnp.maximum(fa, fb)
     dist = jax.lax.pmin(d1, u.shard_axis)
     time = jax.lax.pmin(t1, u.shard_axis)
     first = jax.lax.pmax(f1, u.shard_axis)

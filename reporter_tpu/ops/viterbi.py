@@ -48,6 +48,7 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
+from ..obs.attrib import stage
 from ..tiles.arrays import DeviceGraph
 from ..tiles.ubodt import DeviceUBODT
 from .candidates import Candidates, find_candidates_batch
@@ -102,6 +103,13 @@ def transition_matrix(dg: DeviceGraph, du: DeviceUBODT, src: Candidates, dst: Ca
     the probe sees the whole dispatch's key set and can dedup it); None =
     self-contained (the seam transition and the per-trace/oracle paths).
     """
+    with stage("transition-build"):
+        return _transition_matrix(dg, du, src, dst, gc, dt, p, pre)
+
+
+def _transition_matrix(dg: DeviceGraph, du: DeviceUBODT, src: Candidates,
+                       dst: Candidates, gc: jnp.ndarray, dt: jnp.ndarray,
+                       p: MatchParams, pre=None):
     ea, oa = src.edge, src.offset  # [K]
     eb, ob = dst.edge, dst.offset  # [K]
     if pre is None:
@@ -222,9 +230,10 @@ def precompute_trace(dg: DeviceGraph, du: DeviceUBODT, px, py, times, valid,
     px/py/times/valid: [T].  vmap over batch (precompute_batch_packed)."""
     cand = find_candidates_batch(dg, px, py, k, p.search_radius)  # [T, K]
 
-    emis = -0.5 * jnp.square(cand.dist / p.sigma_z)  # [T, K]
-    emis = jnp.where(jnp.isfinite(cand.dist), emis, NEG_INF)
-    emis = jnp.where(valid[:, None], emis, NEG_INF)
+    with stage("emission"):
+        emis = -0.5 * jnp.square(cand.dist / p.sigma_z)  # [T, K]
+        emis = jnp.where(jnp.isfinite(cand.dist), emis, NEG_INF)
+        emis = jnp.where(valid[:, None], emis, NEG_INF)
 
     gc = jnp.hypot(px[1:] - px[:-1], py[1:] - py[:-1])  # [T-1]
     dts = times[1:] - times[:-1]  # [T-1]
@@ -264,17 +273,19 @@ def precompute_batch(dg: DeviceGraph, du: DeviceUBODT, px, py, times, valid,
         find_candidates_batch, in_axes=(None, 0, 0, None, None)
     )(dg, px, py, k, p.search_radius)  # [B, T, K]
 
-    emis = -0.5 * jnp.square(cand.dist / p.sigma_z)  # [B, T, K]
-    emis = jnp.where(jnp.isfinite(cand.dist), emis, NEG_INF)
-    emis = jnp.where(valid[..., None], emis, NEG_INF)
+    with stage("emission"):
+        emis = -0.5 * jnp.square(cand.dist / p.sigma_z)  # [B, T, K]
+        emis = jnp.where(jnp.isfinite(cand.dist), emis, NEG_INF)
+        emis = jnp.where(valid[..., None], emis, NEG_INF)
 
     gc = jnp.hypot(px[:, 1:] - px[:, :-1], py[:, 1:] - py[:, :-1])  # [B, T-1]
     dts = times[:, 1:] - times[:, :-1]
 
-    er = dg.edge_rows[jnp.where(cand.edge >= 0, cand.edge, 0)]  # [B, T, K, 8]
-    era, erb = er[:, :-1], er[:, 1:]  # [B, T-1, K, 8]
-    to_a = jax.lax.bitcast_convert_type(era[..., 0], jnp.int32)
-    from_b = jax.lax.bitcast_convert_type(erb[..., 1], jnp.int32)
+    with stage("transition-build"):
+        er = dg.edge_rows[jnp.where(cand.edge >= 0, cand.edge, 0)]  # [B, T, K, 8]
+        era, erb = er[:, :-1], er[:, 1:]  # [B, T-1, K, 8]
+        to_a = jax.lax.bitcast_convert_type(era[..., 0], jnp.int32)
+        from_b = jax.lax.bitcast_convert_type(erb[..., 1], jnp.int32)
     sp, sp_time, _ = ubodt_lookup(
         du, to_a[..., :, None], from_b[..., None, :], dedup=dedup
     )  # [B, T-1, K, K]
@@ -368,11 +379,13 @@ def chain_trace(dg: DeviceGraph, du: DeviceUBODT, pre: TracePre, px, py, times,
             route0[best_src0, jnp.arange(k)], jnp.inf,
         )
     if kernel == "assoc" and T >= 2:
-        all_scores, all_backptr, all_broke, all_route = _forward_assoc(
-            init_scores, logp_all, route_all, emis, gc, valid, p)
+        with stage("assoc-recursion"):
+            all_scores, all_backptr, all_broke, all_route = _forward_assoc(
+                init_scores, logp_all, route_all, emis, gc, valid, p)
     elif kernel in ("scan", "assoc"):  # assoc degenerates to scan at T < 2
         xs = (logp_all, route_all, emis[1:], gc, valid[1:])
-        _, (all_scores, all_backptr, all_broke, all_route) = jax.lax.scan(step, init_scores, xs)
+        with stage("scan-recursion"):
+            _, (all_scores, all_backptr, all_broke, all_route) = jax.lax.scan(step, init_scores, xs)
     else:
         raise ValueError("unknown viterbi kernel %r" % (kernel,))
 
@@ -382,10 +395,11 @@ def chain_trace(dg: DeviceGraph, du: DeviceUBODT, pre: TracePre, px, py, times,
     breaks = jnp.concatenate([first_break[None], all_broke], axis=0) & valid
     route_in = jnp.concatenate([first_route[None], all_route], axis=0)  # [T, K]
 
-    if kernel == "assoc" and T >= 2:
-        idx = backtrace_assoc(scores_mat, backptr, valid)  # [T]
-    else:
-        idx = backtrace(scores_mat, backptr, valid)  # [T]
+    with stage("backtrace"):
+        if kernel == "assoc" and T >= 2:
+            idx = backtrace_assoc(scores_mat, backptr, valid)  # [T]
+        else:
+            idx = backtrace(scores_mat, backptr, valid)  # [T]
 
     chosen_score = jnp.take_along_axis(scores_mat, jnp.maximum(idx, 0)[:, None], axis=1)[:, 0]
     chosen_score = jnp.where(idx >= 0, chosen_score, NEG_INF)
@@ -626,11 +640,12 @@ def match_batch_compact(dg: DeviceGraph, du: DeviceUBODT, px, py, times, valid, 
 
 
 def _compact(res: MatchResult) -> CompactMatch:
-    sel = jnp.maximum(res.idx, 0)[..., None]  # [B, T, 1]
-    edge = jnp.take_along_axis(res.cand.edge, sel, axis=-1)[..., 0]
-    offset = jnp.take_along_axis(res.cand.offset, sel, axis=-1)[..., 0]
-    edge = jnp.where(res.idx >= 0, edge, -1)
-    return CompactMatch(edge=edge, offset=offset, breaks=res.breaks)
+    with stage("compact-gather"):
+        sel = jnp.maximum(res.idx, 0)[..., None]  # [B, T, 1]
+        edge = jnp.take_along_axis(res.cand.edge, sel, axis=-1)[..., 0]
+        offset = jnp.take_along_axis(res.cand.offset, sel, axis=-1)[..., 0]
+        edge = jnp.where(res.idx >= 0, edge, -1)
+        return CompactMatch(edge=edge, offset=offset, breaks=res.breaks)
 
 
 def match_batch_carry(dg: DeviceGraph, du: DeviceUBODT, px, py, times, valid,

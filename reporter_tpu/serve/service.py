@@ -49,7 +49,7 @@ from ..tiles.network import RoadNetwork, grid_city
 log = logging.getLogger(__name__)
 
 ACTIONS = {"report", "trace_attributes_batch", "health",
-           "metrics", "statusz", "profile", "traces"}
+           "metrics", "statusz", "profile", "traces", "attrib"}
 
 # metric families (docs/observability.md): the batch-fill/wait tradeoff and
 # the device-step tail are THE operating signals of a batched-accelerator
@@ -436,7 +436,12 @@ class ReporterService:
 
     def handle_statusz(self) -> Tuple[int, dict]:
         """JSON ops snapshot: uptime + config + bucket tables + every metric
-        family (the dict form of /metrics, for humans and scripts)."""
+        family (the dict form of /metrics, for humans and scripts).  The
+        ``attrib`` line carries the last capture's age and top stage plus
+        the ``last_onchip`` provenance, so a stale (or CPU-only)
+        attribution headline is visible at a glance."""
+        from ..obs import attrib as obs_attrib
+
         m = self.matcher
         return 200, {
             "uptime_s": round(_time.time() - self._t_boot, 1),
@@ -448,6 +453,7 @@ class ReporterService:
             "latency_buckets_s": list(obs.LATENCY_BUCKETS_S),
             "batch_fill_buckets": list(obs.BATCH_FILL_BUCKETS),
             "flight": obs_flight.RECORDER.summary(),
+            "attrib": obs_attrib.summary(),
             "metrics": obs.REGISTRY.snapshot(),
         }
 
@@ -478,11 +484,49 @@ class ReporterService:
         try:
             trace_dir, recorded = profiler.capture(seconds)
         except profiler.ProfilerBusy as e:
-            return 409, {"error": str(e)}
+            # single-flight: the in-flight capture's trace_id rides the 409
+            # so the caller can find (or wait out) the owner
+            return 409, {"error": str(e), "inflight": e.inflight}
         except Exception as e:  # noqa: BLE001 - surfaced to the caller
             log.exception("profiler capture failed")
             return 500, {"error": str(e)}
         return 200, {"trace_dir": trace_dir, "seconds": recorded}
+
+    def handle_attrib(self, query: dict) -> Tuple[int, dict]:
+        """GET /debug/attrib — the last parsed named-stage attribution
+        (plus its age), or with ``?capture=1[&reps=N]`` an on-demand
+        capture: ``reps`` dummy dispatches through the real dispatch path
+        under a jax.profiler window, parsed into the per-stage table and
+        published to the gauges.  Single-flight with /debug/profile: a
+        concurrent capture gets 409 with the in-flight capture's
+        trace_id."""
+        from ..obs import attrib as obs_attrib
+        from ..obs import profiler
+
+        capture = query.get("capture", ["0"])[0] not in ("", "0", "false")
+        if not capture:
+            res = obs_attrib.last()
+            out = {"attrib": res, "summary": obs_attrib.summary()}
+            return 200, out
+        m = self.matcher
+        if m is None:
+            return 503, {"error": "service initialising"}
+        if m.backend != "jax":
+            return 501, {"error": "attribution needs the jax backend (got %r)"
+                                  % m.backend}
+        try:
+            reps = int(query.get("reps", ["3"])[0])
+        except (TypeError, ValueError):
+            return 400, {"error": "reps must be an integer"}
+        reps = max(1, min(reps, 20))
+        try:
+            res = obs_attrib.capture_matcher(m, reps=reps)
+        except profiler.ProfilerBusy as e:
+            return 409, {"error": str(e), "inflight": e.inflight}
+        except Exception as e:  # noqa: BLE001 - surfaced to the caller
+            log.exception("attribution capture failed")
+            return 500, {"error": str(e)}
+        return 200, {"attrib": res, "summary": obs_attrib.summary()}
 
     # -- server ------------------------------------------------------------
 
@@ -595,9 +639,18 @@ class ReporterService:
                     if action == "statusz":
                         self._drain_body(post)
                         return self._answer(*service.handle_statusz())
-                    if action == "profile":  # GET /debug/profile?seconds=N
+                    if action in ("profile", "attrib"):
+                        # GET /debug/profile?seconds=N | /debug/attrib
+                        # [?capture=1&reps=N] — bound to a span so the
+                        # single-flight guard can name the owning request's
+                        # trace_id on a concurrent caller's 409
                         self._drain_body(post)
-                        return self._answer(*service.handle_profile(query))
+                        with obs_trace.bind(
+                                Span(action, trace_id=self._trace_id)):
+                            handler = (service.handle_profile
+                                       if action == "profile"
+                                       else service.handle_attrib)
+                            return self._answer(*handler(query))
                     if action == "traces":  # GET /debug/traces?n=K
                         self._drain_body(post)
                         return self._answer(*service.handle_traces(query))

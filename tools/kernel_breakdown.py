@@ -1,23 +1,18 @@
-"""Time the match kernel's stages in isolation on the visible device.
+"""Named-stage device-time breakdown of the match kernel on the visible
+device — a thin CLI over ``reporter_tpu.obs.attrib``.
 
-Splits one [B, T] batch's device work into:
-  candidates   find_candidates_batch only
-  transitions  candidates + the [T-1, K, K] transition matrices (UBODT probes)
-  full         match_batch_compact (adds viterbi scan + backtrace + compact)
-
-The deltas between rows attribute kernel time to the candidate sweep, the
-transition/UBODT stage, and the sequential scan machinery — the evidence
-needed before optimising any one of them (e.g. a temporal-parallel Viterbi
-only pays if `full - transitions` dominates).
+Historically this tool timed hand-built stage-subset programs
+(candidates-only / candidates+transitions / full) and attributed kernel
+time to the deltas; that duplicated attribution logic is retired — the
+kernels now self-report through their ``jax.named_scope`` labels, and
+this tool just captures N reps of the REAL dispatched compact program
+under a profiler window and prints the parsed per-stage table (the same
+parse /debug/attrib and bench.py's ``attrib`` block serve).
 
 WARNING: stage ratios measured on the CPU backend DO NOT transfer to the
 chip (round 4 measured "transitions ~95%" here; the on-chip traces said
-candidates ~57% — docs/onchip-attribution.md).  For device claims, run this
-on the real chip (--platform axon) or analyse a profiler capture with
-tools/trace_analyze.py.
-
-Timing fetches a scalar reduction per rep (block_until_ready is optimistic
-on the tunneled backend); tables are jit arguments, never closures.
+candidates ~57% — docs/onchip-attribution.md).  The table is labelled
+with the platform it measured; only platform "tpu" rows are chip claims.
 
 Run:  python tools/kernel_breakdown.py [--platform axon|cpu] [--scenario osm]
 """
@@ -39,6 +34,7 @@ def main():
     ap.add_argument("--b", type=int, default=16)
     ap.add_argument("--t", type=int, default=1024)
     ap.add_argument("--reps", type=int, default=5)
+    ap.add_argument("--kernel", default="scan", choices=("scan", "assoc"))
     args = ap.parse_args()
 
     sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
@@ -49,24 +45,23 @@ def main():
     import jax.numpy as jnp
     import numpy as np
 
-    from reporter_tpu.matching import MatcherConfig
-    from reporter_tpu.ops.candidates import find_candidates_batch
-    from reporter_tpu.ops.viterbi import (
-        MatchParams, match_batch_compact, transition_matrix,
-    )
+    from reporter_tpu.matching import MatcherConfig, SegmentMatcher
+    from reporter_tpu.obs import attrib
+    from reporter_tpu.ops.viterbi import pack_inputs
     from reporter_tpu.synth import TraceSynthesizer
-    from reporter_tpu.tiles.arrays import build_graph_arrays
+    from reporter_tpu.synth.generator import cohort_xy
     from reporter_tpu.synth.osm_city import realistic_city_network
+    from reporter_tpu.tiles.arrays import build_graph_arrays
     from reporter_tpu.tiles.network import grid_city
     from reporter_tpu.tiles.ubodt import build_ubodt
 
     print("platform:", jax.devices()[0], flush=True)
     if jax.devices()[0].platform != "tpu":
         print("WARNING: CPU-backend stage ratios do not transfer to the chip "
-              "(docs/onchip-attribution.md); use trace_analyze.py for device "
-              "claims", flush=True)
-    cfg = MatcherConfig()
-    k = cfg.beam_k
+              "(docs/onchip-attribution.md); for device claims run on the "
+              "real chip (--platform axon) or analyse an on-chip capture "
+              "with trace_analyze.py", flush=True)
+    cfg = MatcherConfig(viterbi_kernel=args.kernel)
     t0 = time.time()
     if args.scenario == "grid":
         city = grid_city(rows=args.grid, cols=args.grid, spacing_m=150.0)
@@ -77,65 +72,39 @@ def main():
     print("scenario %s: %d edges, ubodt %d rows (%.1fs)"
           % (args.scenario, arrays.num_edges, ubodt.num_rows, time.time() - t0), flush=True)
 
-    from reporter_tpu.synth.generator import cohort_xy
-
+    matcher = SegmentMatcher(arrays=arrays, ubodt=ubodt, config=cfg)
     synth = TraceSynthesizer(arrays, seed=7)
     B, T = args.b, args.t
     # same packing as the bench's cohorts: identical inputs, comparable times
     px, py, tm, valid = cohort_xy(
         arrays, synth.batch(B, T, dt=5.0, sigma=5.0, max_tries=400), T)
+    px, py, tm, valid = SegmentMatcher._pad_batch(px, py, tm, valid)
+    xin = jnp.asarray(pack_inputs(px, py, tm, valid))
+    fn = matcher._get_jit("compact", args.kernel)
+    cargs = (matcher._dg, matcher._du, xin, matcher._params, cfg.beam_k)
 
-    dg = arrays.to_device()
-    du = ubodt.to_device()
-    p = MatchParams.from_config(cfg)
-    jpx, jpy, jtm, jvalid = map(jnp.asarray, (px, py, tm, valid))
-
-    def stage_candidates(dg, du, px, py, tm, valid):
-        c = find_candidates_batch(dg, px, py, k, p.search_radius)
-        return (jnp.sum(jnp.where(jnp.isfinite(c.dist), c.dist, 0.0))
-                + jnp.sum(c.edge))
-
-    def stage_transitions(dg, du, px, py, tm, valid):
-        def one(px, py, tm):
-            cand = find_candidates_batch(dg, px, py, k, p.search_radius)
-            src = jax.tree_util.tree_map(lambda a: a[:-1], cand)
-            dst = jax.tree_util.tree_map(lambda a: a[1:], cand)
-            gc = jnp.hypot(px[1:] - px[:-1], py[1:] - py[:-1])
-            dts = tm[1:] - tm[:-1]
-            logp, route = jax.vmap(
-                transition_matrix, in_axes=(None, None, 0, 0, 0, 0, None)
-            )(dg, du, src, dst, gc, dts, p)
-            return (jnp.sum(jnp.where(logp > -1e29, logp, 0.0))
-                    + jnp.sum(jnp.where(jnp.isfinite(route), route, 0.0)))
-        return jnp.sum(jax.vmap(one)(px, py, tm))
-
-    def stage_full(dg, du, px, py, tm, valid):
-        cm = match_batch_compact(dg, du, px, py, tm, valid, p, k)
-        return (jnp.sum(cm.edge) + jnp.sum(cm.offset)
-                + jnp.sum(cm.breaks.astype(jnp.int32)))
-
-    results = {}
-    for name, fn in (("candidates", stage_candidates),
-                     ("transitions", stage_transitions),
-                     ("full", stage_full)):
-        jf = jax.jit(fn)
-        t0 = time.time()
-        float(jf(dg, du, jpx, jpy, jtm, jvalid))
-        compile_s = time.time() - t0
-        t0 = time.time()
-        for _ in range(args.reps):
-            float(jf(dg, du, jpx, jpy, jtm, jvalid))
-        dt = (time.time() - t0) / args.reps
-        results[name] = dt
-        print("%-12s %8.2f ms   (%.0f pts/s; compile %.1fs)"
-              % (name, dt * 1e3, B * T / dt, compile_s), flush=True)
-    cand = results["candidates"]
-    trans = results["transitions"] - cand
-    scan = results["full"] - results["transitions"]
-    tot = results["full"]
-    print("attribution: candidates %.0f%%  transitions/UBODT %.0f%%  "
-          "scan+backtrace+compact %.0f%%"
-          % (100 * cand / tot, 100 * trans / tot, 100 * scan / tot), flush=True)
+    t0 = time.time()
+    np.asarray(fn(*cargs))  # compile + warm
+    compile_s = time.time() - t0
+    t0 = time.time()
+    res = attrib.capture(lambda: np.asarray(fn(*cargs)), reps=args.reps,
+                         programs=[(fn, cargs)])
+    wall = time.time() - t0
+    total = res["device_total_ms"]
+    print("full kernel  %8.2f ms/rep device  (%d reps in %.1fs wall; "
+          "compile %.1fs; %.0f pts/s)"
+          % (total / args.reps, args.reps, wall, compile_s,
+             B * T * args.reps / max(wall, 1e-9)), flush=True)
+    for name, ms in res["stages_ms"].items():
+        print("%-18s %8.2f ms  %5.1f%%" % (name, ms, 100.0 * ms / max(total, 1e-9)),
+              flush=True)
+    named = {k: v for k, v in res["stages_ms"].items()
+             if k != attrib.UNATTRIBUTED}
+    top = sorted(named.items(), key=lambda kv: -kv[1])[:3]
+    print("attribution (%s): %s" % (
+        res["platform"],
+        "  ".join("%s %.0f%%" % (k, 100.0 * v / max(total, 1e-9))
+                  for k, v in top)), flush=True)
 
 
 if __name__ == "__main__":

@@ -157,19 +157,23 @@ def main() -> int:
         return ms
 
     # executed bucket-row gathers per kernel rep: the row-count-bound cost
-    # model (docs/gather-experiments.md).  Dedup's budget is the static
-    # compacted capacity -- the data-dependent distinct count is measured
-    # separately below and must fit it for the deduped gather to run.
+    # model (docs/gather-experiments.md), shared with bench's roofline via
+    # obs/attrib (dedup_budget / executed_rows — the same _DEDUP_* maths
+    # as ops/hashtable).  Dedup's budget is the static compacted capacity
+    # -- the data-dependent distinct count is measured separately below
+    # and must fit it for the deduped gather to run.
+    from reporter_tpu.obs import attrib
+
     n_pairs = B * (T - 1) * K * K
-    dedup_m = max(ht._DEDUP_MIN_PAIRS // 2, n_pairs // ht._DEDUP_CAP_RATIO)
+    dedup_m = attrib.dedup_budget(n_pairs)
     rows_per_rep = {
-        "full": 2 * n_pairs,
+        "full": attrib.executed_rows(n_pairs, 2),
         "noprobe": 0,
-        "noselect": 2 * n_pairs,
-        "rollsel": 2 * n_pairs,
-        "dedup": 2 * dedup_m,
-        "wide32": n_pairs,
-        "wide32_dedup": dedup_m,
+        "noselect": attrib.executed_rows(n_pairs, 2),
+        "rollsel": attrib.executed_rows(n_pairs, 2),
+        "dedup": attrib.executed_rows(n_pairs, 2, dedup=True),
+        "wide32": attrib.executed_rows(n_pairs, 1),
+        "wide32_dedup": attrib.executed_rows(n_pairs, 1, dedup=True),
     }
 
     out = {"shape": [B, T], "probe_pairs_per_rep": n_pairs,

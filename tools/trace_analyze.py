@@ -1,83 +1,46 @@
 #!/usr/bin/env python3
-"""Offline analysis of a jax.profiler chrome-trace capture.
+"""Offline analysis of a jax.profiler chrome-trace capture — a thin CLI
+over ``reporter_tpu.obs.attrib`` (the one home for the trace-event
+bucketing this tool used to duplicate).
 
-Groups on-device XLA op time by the *source line* XLA recorded for each
-fusion (the bench's kernels all trace back to reporter_tpu/ops/*.py), so a
-`bench_profile/**/vm.trace.json.gz` becomes a stage attribution:
+Per capture it reports, exactly as before, on-device XLA op time grouped
+by module / source file / source line (the bench's kernels all trace back
+to reporter_tpu/ops/*.py) — PLUS the named-stage table the kernels now
+self-report through their ``jax.named_scope`` labels
+(candidate-sweep / ubodt-probe / select / transition-build / scan
+recursion / ... — obs/attrib.STAGES):
 
     candidates.py   candidate sweep (grid gathers + distance/min selection)
-    hashtable.py    UBODT probes (two bucket-row gathers + select)
+    hashtable.py    UBODT probes (bucket-row gathers + select)
     viterbi.py      emission/transition assembly, scan, backtrace, compact
 
-This is the on-chip evidence for the which-stage-dominates question
-(VERDICT r04 next #7: the round-4 claim 'transitions ~95%' was CPU-only).
+CPU captures carry no scope metadata in their events; the stage table
+then resolves through the op->stage map of whatever programs this
+process registered with obs/attrib (for an offline CPU trace from
+another process the stages stay "(unattributed)" — capture through
+bench.py or /debug/attrib instead, which map in-process).
 
-Run:  python tools/trace_analyze.py bench_profile/plugins/profile/<ts>/vm.trace.json.gz
+Run:  python tools/trace_analyze.py scratch/bench_profile/<cohort>/plugins/profile/<ts>/vm.trace.json.gz
 """
 
 from __future__ import annotations
 
-import collections
-import gzip
 import json
+import os
 import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def analyze(path: str) -> dict:
-    with gzip.open(path) as f:
-        tr = json.load(f)
-    ev = tr["traceEvents"]
+    from reporter_tpu.obs import attrib
 
-    # device pids (all of them: a mesh capture has one per chip) + threads
-    dev_pids = set()
-    tids = {}
-    for e in ev:
-        if e.get("ph") != "M":
-            continue
-        if e.get("name") == "process_name" and "TPU" in str(e.get("args", {}).get("name", "")):
-            dev_pids.add(e["pid"])
-        if e.get("name") == "thread_name":
-            tids[(e.get("pid"), e.get("tid"))] = e["args"]["name"]
-    if not dev_pids:
-        raise SystemExit("no TPU process in trace")
-
-    # args are attached to the first occurrence of each op name; collect
-    name_src: dict = {}
-    by_file = collections.defaultdict(float)
-    by_line = collections.defaultdict(float)
-    by_module = collections.defaultdict(float)
-    total = 0.0
-    for e in ev:
-        if e.get("ph") != "X" or e.get("pid") not in dev_pids:
-            continue
-        tname = tids.get((e.get("pid"), e.get("tid")), "")
-        dur = e.get("dur", 0) / 1e3  # us -> ms
-        if tname == "XLA Modules":
-            by_module[e["name"].split("(")[0]] += dur
-            continue
-        if tname != "XLA Ops":
-            continue
-        total += dur
-        args = e.get("args") or {}
-        if "source" in args:
-            name_src[e["name"]] = args["source"]
-        src = name_src.get(e["name"], "")
-        fname = src.rsplit("/", 1)[-1].split(":")[0] if src else "(no source)"
-        by_file[fname] += dur
-        if src:
-            by_line[src.replace("/root/repo/", "")] += dur
-
-    return {
-        "path": path,
-        "devices": len(dev_pids),
-        "device_total_ms": round(total, 1),
-        "by_module_ms": {k: round(v, 1) for k, v in sorted(
-            by_module.items(), key=lambda kv: -kv[1]) if v > 0.05},
-        "by_file_ms": {k: round(v, 1) for k, v in sorted(
-            by_file.items(), key=lambda kv: -kv[1])},
-        "top_lines_ms": {k: round(v, 1) for k, v in sorted(
-            by_line.items(), key=lambda kv: -kv[1])[:14]},
-    }
+    out = attrib.parse_trace_file(path, attrib.build_op_stage_map() or None)
+    # keep the historical output shape (path/devices/device_total_ms/
+    # by_module_ms/by_file_ms/top_lines_ms) with stages_ms added
+    return {k: out[k] for k in (
+        "path", "platform", "devices", "device_total_ms", "stages_ms",
+        "by_module_ms", "by_file_ms", "top_lines_ms")}
 
 
 def main() -> int:
@@ -86,9 +49,11 @@ def main() -> int:
         import glob
 
         # default profiler output moved under the ignored scratch dir; the
-        # legacy root-level location is still scanned for old captures
+        # legacy root-level location is still scanned for old captures.
+        # bench.py now writes one capture per cohort in subdirs.
         paths = sorted(
-            glob.glob("scratch/bench_profile/plugins/profile/*/vm.trace.json.gz")
+            glob.glob("scratch/bench_profile/**/vm.trace.json.gz",
+                      recursive=True)
             or glob.glob("bench_profile/plugins/profile/*/vm.trace.json.gz"))
     for p in paths:
         out = analyze(p)
