@@ -8,8 +8,10 @@ Mirrors the egress options of the reference's anonymiser
     "PUT\n\n{content-type}\n{date}\n/{bucket}/{key}", HttpClient.java:44-58)
     using urllib only -- no boto dependency.
 
-All network backends honour the reference's budget: 1 s connect-ish timeout,
-10 s total, 3 retries (HttpClient.java:80-88).
+All network backends honour the reference's budget: 10 s total, 3 retries
+(HttpClient.java:80-88), now with exponential backoff + full jitter and
+``Retry-After`` honoured on 429/503 (utils/retry; docs/robustness.md) so a
+fleet of writers doesn't hammer a struggling datastore in lock-step.
 """
 
 from __future__ import annotations
@@ -19,16 +21,18 @@ import hashlib
 import hmac
 import logging
 import os
-import time
 import urllib.error
 import urllib.request
 from email.utils import formatdate
 from typing import Optional
 
+from .. import faults
+from ..utils import retry
+
 log = logging.getLogger(__name__)
 
-RETRIES = 3
-TIMEOUT_SEC = 10.0
+RETRIES = retry.RETRIES
+TIMEOUT_SEC = retry.BUDGET_S
 
 
 class DirStore:
@@ -103,22 +107,30 @@ class S3Store:
 
 
 def _do_with_retries(req: urllib.request.Request) -> None:
-    last: Optional[Exception] = None
-    for attempt in range(RETRIES):
-        if attempt:
-            time.sleep(0.2 * attempt)
-        try:
-            with urllib.request.urlopen(req, timeout=TIMEOUT_SEC) as resp:
-                resp.read()
-                return
-        except urllib.error.HTTPError as e:
-            # 4xx won't improve on retry
-            if 400 <= e.code < 500:
-                raise
-            last = e
-        except Exception as e:  # URLError, socket timeouts
-            last = e
-    raise RuntimeError("store failed after %d attempts: %s" % (RETRIES, last))
+    def _do():
+        # chaos seams: the datastore answering 5xx or hanging to timeout
+        # (docs/robustness.md) — armed only by REPORTER_FAULT_STORE_PUT
+        tok = faults.fire("store_put")
+        if tok == "5xx":
+            raise urllib.error.HTTPError(
+                req.full_url, 503, "injected store fault", None, None)
+        if tok == "timeout":
+            raise TimeoutError("injected store timeout")
+        with urllib.request.urlopen(req, timeout=TIMEOUT_SEC) as resp:
+            resp.read()
+
+    # reference budget (HttpClient.java:80-88) via the shared policy:
+    # backoff + jitter, Retry-After on 429/503, 4xx gives up immediately
+    try:
+        retry.call_with_retries(_do, target="store")
+    except urllib.error.HTTPError as e:
+        if 400 <= e.code < 500 and e.code != 429:
+            raise  # a malformed upload won't improve on retry
+        raise RuntimeError(
+            "store failed after %d attempts: %s" % (RETRIES, e)) from e
+    except Exception as e:  # URLError, socket timeouts
+        raise RuntimeError(
+            "store failed after %d attempts: %s" % (RETRIES, e)) from e
 
 
 def make_store(spec: str):

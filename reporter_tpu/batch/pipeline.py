@@ -86,6 +86,12 @@ C_TILES_UP = obs.counter(
 C_CULLED = obs.counter(
     "reporter_batch_segments_culled_total",
     "Tile rows dropped by the phase-3 privacy cull (incl. malformed rows)")
+C_REQUEUED = obs.counter(
+    "reporter_batch_shard_requeues_total",
+    "Work units (phase-1 source files / phase-3 tile files) a dead fan-out "
+    "worker left unfinished, requeued once onto the surviving parent "
+    "(docs/robustness.md)",
+    ("phase",))
 
 # snapshots collected from fan-out workers this process spawned (appended
 # by get_traces/report_tiles; merged by the batch head's --metrics dump)
@@ -114,6 +120,36 @@ def _collect_worker_snaps(snap_dir: str) -> None:
         except Exception:  # noqa: BLE001 - a dead worker may have written none
             log.warning("unreadable worker metrics snapshot %s", name)
     shutil.rmtree(snap_dir, ignore_errors=True)
+
+
+def _mark_done(done_path: Optional[str], unit: str) -> None:
+    """Worker-side progress journal: one line per processed work unit, so
+    the parent can requeue ONLY what a dead worker left unfinished (a unit
+    in flight at the crash replays — at-least-once, never silent loss)."""
+    if not done_path:
+        return
+    try:
+        with open(done_path, "a") as f:
+            f.write(unit + "\n")
+    except OSError:  # progress journalling must never fail the phase
+        log.warning("could not journal progress to %s", done_path)
+
+
+def _unfinished_units(chunks, procs, done_dir: str) -> List[str]:
+    """Units assigned to dead workers minus what their done-journals
+    record as processed."""
+    remaining: List[str] = []
+    for i, p in enumerate(procs):
+        if p.exitcode == 0:
+            continue
+        done = set()
+        try:
+            with open(os.path.join(done_dir, "w%d.done" % i)) as f:
+                done = {line.rstrip("\n") for line in f}
+        except OSError:
+            pass  # worker died before journalling anything
+        remaining.extend(k for k in chunks[i] if k not in done)
+    return remaining
 
 
 DEFAULT_VALUER = (
@@ -219,7 +255,7 @@ def make_archive(spec: str):
 
 
 def _gather(archive_spec, keys, valuer_src, time_pattern, bbox, dest_dir,
-            snap_path=None):
+            snap_path=None, done_path=None):
     archive = make_archive(archive_spec)
     valuer = compile_valuer(valuer_src)
     try:
@@ -254,6 +290,9 @@ def _gather(archive_spec, keys, valuer_src, time_pattern, bbox, dest_dir,
             except Exception as e:
                 C_SRC_FILES.labels("error").inc()
                 log.error("%s was not processed: %s", key, e)
+            # journalled AFTER the shard appends land: a crash mid-key
+            # replays the whole key (at-least-once), never skips it
+            _mark_done(done_path, key)
     finally:
         _dump_registry(snap_path)
 
@@ -280,23 +319,39 @@ def get_traces(
     else:
         # spawn, not fork: the driver process usually has JAX (and its thread
         # pool) initialised, and forking a multithreaded process can deadlock
+        import shutil
+
         ctx = multiprocessing.get_context("spawn")
         snap_dir = tempfile.mkdtemp(prefix="obs_gather_")
+        done_dir = tempfile.mkdtemp(prefix="gather_done_")
         procs = []
-        for i, chunk in enumerate(split(keys, concurrency)):
+        chunks = split(keys, concurrency)
+        for i, chunk in enumerate(chunks):
             p = ctx.Process(
                 target=_gather,
                 args=(archive_spec, chunk, valuer, time_pattern, list(bbox),
-                      dest_dir, os.path.join(snap_dir, "w%d.json" % i)),
+                      dest_dir, os.path.join(snap_dir, "w%d.json" % i),
+                      os.path.join(done_dir, "w%d.done" % i)),
             )
             p.start()
             procs.append(p)
         dead = _join_checked(procs)
         _collect_worker_snaps(snap_dir)
         if dead:
-            raise RuntimeError(
-                "one or more gather workers died; the shard set is incomplete"
-            )
+            # a crashed worker must not fail the whole phase: requeue its
+            # unfinished source files ONCE onto the surviving parent (the
+            # done-journal scopes the re-run to what never processed; a
+            # second failure here does fail the phase)
+            remaining = _unfinished_units(chunks, procs, done_dir)
+            shutil.rmtree(done_dir, ignore_errors=True)
+            C_REQUEUED.labels("gather").inc(len(remaining))
+            log.warning(
+                "%d gather worker(s) died; requeueing %d unfinished source "
+                "file(s) in the parent", dead, len(remaining))
+            _gather(archive_spec, remaining, valuer, time_pattern,
+                    list(bbox), dest_dir)
+        else:
+            shutil.rmtree(done_dir, ignore_errors=True)
     log.info("done gathering traces")
     return dest_dir
 
@@ -500,7 +555,7 @@ def _cull_lines(lines: List[str], privacy: int) -> List[str]:
 
 
 def _report_files(match_dir, file_names, store_spec, privacy, fail_counter=None,
-                  snap_path=None):
+                  snap_path=None, done_path=None):
     """Cull + upload a list of tile files.  Returns the number of failed
     uploads (also added to ``fail_counter`` when given, for fan-out)."""
     store = make_store(store_spec)
@@ -513,6 +568,7 @@ def _report_files(match_dir, file_names, store_spec, privacy, fail_counter=None,
             C_CULLED.inc(len(lines) - len(kept))
             if not kept:
                 log.info("no segments for %s after anonymising", file_name)
+                _mark_done(done_path, file_name)
                 continue
             rel = os.path.relpath(file_name, match_dir)
             # a fresh suffix per run so overlapping backfills accumulate instead
@@ -526,6 +582,10 @@ def _report_files(match_dir, file_names, store_spec, privacy, fail_counter=None,
                 failures += 1
                 C_TILES_UP.labels("error").inc()
                 log.error("failed to upload %s: %s", key, e)
+            # journalled after the upload attempt: a crash mid-put replays
+            # the file (at-least-once; tile keys are uuid4-suffixed so a
+            # replayed upload accumulates instead of clobbering)
+            _mark_done(done_path, file_name)
         if fail_counter is not None and failures:
             with fail_counter.get_lock():
                 fail_counter.value += failures
@@ -549,21 +609,37 @@ def report_tiles(
     if concurrency <= 1 or len(file_names) <= 1:
         failures = _report_files(match_dir, file_names, store_spec, privacy)
     else:
+        import shutil
+
         ctx = multiprocessing.get_context("spawn")  # see get_traces re fork+JAX
         fail_counter = ctx.Value("i", 0)
         snap_dir = tempfile.mkdtemp(prefix="obs_report_")
+        done_dir = tempfile.mkdtemp(prefix="report_done_")
         procs = []
-        for i, chunk in enumerate(split(file_names, concurrency)):
+        chunks = split(file_names, concurrency)
+        for i, chunk in enumerate(chunks):
             p = ctx.Process(
                 target=_report_files,
                 args=(match_dir, chunk, store_spec, privacy, fail_counter,
-                      os.path.join(snap_dir, "w%d.json" % i)),
+                      os.path.join(snap_dir, "w%d.json" % i),
+                      os.path.join(done_dir, "w%d.done" % i)),
             )
             p.start()
             procs.append(p)
         dead = _join_checked(procs)
         _collect_worker_snaps(snap_dir)
-        failures = fail_counter.value + dead
+        failures = fail_counter.value
+        if dead:
+            # requeue a dead worker's unfinished tile files once in the
+            # parent instead of counting the whole worker as failed; only
+            # uploads that then fail (or a second crash) count
+            remaining = _unfinished_units(chunks, procs, done_dir)
+            C_REQUEUED.labels("report").inc(len(remaining))
+            log.warning(
+                "%d report worker(s) died; requeueing %d unfinished tile "
+                "file(s) in the parent", dead, len(remaining))
+            failures += _report_files(match_dir, remaining, store_spec, privacy)
+        shutil.rmtree(done_dir, ignore_errors=True)
     log.info("done reporting tiles (%d upload failures)", failures)
     return failures
 

@@ -81,6 +81,14 @@ class MatcherConfig:
     # region tables larger than one chip's HBM.  Must be a power of two
     # dividing ``devices``; 1 = table replicated.
     graph_devices: int = 1
+    # serve-tier graceful degradation (docs/robustness.md): when the
+    # device watchdog trips on a wedged/failed device step, the service
+    # detaches the engine and answers from the CPU oracle
+    # (baseline/cpu_matcher) with "degraded": true until a re-attach probe
+    # finds the accelerator healthy again.  False fails hard instead
+    # (wedged requests get retryable 503s) — for deployments where a slow
+    # right answer is worse than a fast retry against another replica.
+    cpu_fallback: bool = True
     # report() business-logic default (reporter_service.py:54-58)
     threshold_sec: int = 15
     mode: str = "auto"

@@ -24,6 +24,7 @@ from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
+from .. import faults
 from ..obs import log as obs_log
 from ..obs import metrics as obs
 from ..tiles.arrays import GraphArrays, build_graph_arrays
@@ -468,6 +469,9 @@ class SegmentMatcher:
     def _dispatch_batch(self, px: np.ndarray, py: np.ndarray, times: np.ndarray, valid: np.ndarray):
         """Queue one [B, T] padded batch on the backend without blocking.
         Returns an opaque handle for _collect_batch."""
+        # chaos seam: a UBODT probe-program failure surfaces mid-call, per
+        # chunk, unlike the dispatch point at match_many_async entry
+        faults.maybe_raise("ubodt_probe")
         if self.backend == "jax":
             from ..ops.viterbi import pack_inputs
 
@@ -653,6 +657,12 @@ class SegmentMatcher:
         several async calls multiplies that bound (each unfinished call can
         pin up to PIPELINE_DEPTH chunks); MicroBatcher bounds its overlap
         with max_inflight and documents the composite worst case."""
+        # chaos seam (docs/robustness.md): armed only by REPORTER_FAULT_
+        # env knobs; the uuid: form fires for any batch containing the
+        # poison trace, which is what the MicroBatcher's bisect-retry
+        # quarantine isolates against
+        faults.maybe_raise("dispatch", key=",".join(
+            str(t.get("uuid", "")) for t in traces if isinstance(t, dict)))
         results: List[Optional[dict]] = [None] * len(traces)
 
         # bucket by padded length; traces beyond the largest bucket stream
@@ -712,6 +722,10 @@ class SegmentMatcher:
         )
 
         def finish() -> List[dict]:
+            # chaos seam: a wedged device step (the serve watchdog's prey)
+            # is simulated here, inside the blocking finish the finisher
+            # thread and the re-attach probe both run through
+            faults.hang("device_hang")
             # fetch on a collector thread so the device->host sync cost of
             # chunk i+1 hides under host association of chunk i (on the
             # tunneled deployment every blocking fetch costs a ~73 ms relay

@@ -91,6 +91,11 @@ def main(argv):
         max_wait_ms=float(batch.get("max_wait_ms", 10.0)),
         max_inflight=(int(batch["max_inflight"])
                       if "max_inflight" in batch else None),
+        # fault-domain knobs (docs/robustness.md): bounded submit queue +
+        # shedding, server deadline, device watchdog, poison quarantine,
+        # degraded-mode re-attach probing; REPORTER_* env overrides apply
+        # on top of the config block
+        robustness=conf.get("robustness", {}),
     )
     httpd = service.make_server(host, int(port))
     logging.info("reporter_tpu service on %s:%s (engine deferred)", host, port)

@@ -26,6 +26,7 @@ THRESHOLD_SEC is honoured like the reference (:54-58).
 
 from __future__ import annotations
 
+import contextlib
 import json
 import logging
 import os
@@ -51,6 +52,25 @@ log = logging.getLogger(__name__)
 ACTIONS = {"report", "trace_attributes_batch", "health",
            "metrics", "statusz", "profile", "traces", "attrib"}
 
+
+def _env_num(name: str, default: float) -> float:
+    """Numeric env knob with a safe fallback (a typo'd value must degrade
+    to the default, not refuse to boot)."""
+    try:
+        return float(os.environ[name])
+    except (KeyError, ValueError):
+        return float(default)
+
+
+def _resolve_num(env_name: str, param, default: float) -> float:
+    """Knob resolution order, matching the matcher's convention
+    (REPORTER_UBODT_LAYOUT et al.): env var > config/constructor value >
+    default — an operator can retune a live deployment's robustness knobs
+    without a config rollout."""
+    if os.environ.get(env_name, "").strip():
+        return _env_num(env_name, default if param is None else param)
+    return float(default if param is None else param)
+
 # metric families (docs/observability.md): the batch-fill/wait tradeoff and
 # the device-step tail are THE operating signals of a batched-accelerator
 # service — aggregate throughput alone cannot show a queue-wait regression
@@ -75,8 +95,75 @@ C_BATCHES = obs.counter(
     "Device micro-batches dispatched")
 C_REQUESTS = obs.counter(
     "reporter_requests_total",
-    "Requests by endpoint and outcome (ok / invalid / error)",
+    "Requests by endpoint and outcome (ok / invalid / error / shed / "
+    "expired / quarantined / degraded)",
     ("endpoint", "outcome"))
+# fault-domain surfaces (docs/robustness.md): load shedding, queue-expiry,
+# poison isolation, the device watchdog and the degraded CPU fallback each
+# get their own family so an incident reads directly off /metrics
+C_SHED = obs.counter(
+    "reporter_requests_shed_total",
+    "Requests rejected 429 at admission (submit queue full)")
+C_EXPIRED = obs.counter(
+    "reporter_requests_expired_total",
+    "Requests whose deadline expired in the queue, dropped before "
+    "dispatch (504)")
+C_POISON = obs.counter(
+    "reporter_poison_isolated_total",
+    "Traces isolated as batch poison by the bisect-retry quarantine")
+C_QUAR_REJ = obs.counter(
+    "reporter_quarantine_rejected_total",
+    "Requests rejected at admission because their uuid is quarantined "
+    "as a repeat poison offender")
+C_WD_TRIPS = obs.counter(
+    "reporter_watchdog_trips_total",
+    "Device-step watchdog trips (a finish() exceeded the bound; the "
+    "batcher is wedged and the service degrades to the CPU fallback)")
+C_CRASHES = obs.counter(
+    "reporter_batcher_crashes_total",
+    "MicroBatcher loop-thread crashes (dispatch worker or finisher died "
+    "on an unexpected error; pending futures failed, /health unhealthy)")
+G_DEGRADED = obs.gauge(
+    "reporter_degraded_mode",
+    "1 while the service answers from the CPU fallback after a device "
+    "watchdog trip, 0 when the accelerator engine is attached")
+C_DEGRADED_REQ = obs.counter(
+    "reporter_degraded_requests_total",
+    "Requests answered by the CPU fallback (responses carry "
+    "degraded: true)")
+C_REATTACH = obs.counter(
+    "reporter_engine_reattach_total",
+    "Successful engine re-attach events after degraded-mode probes found "
+    "the device healthy again")
+
+
+class Overloaded(RuntimeError):
+    """Submit queue full: shed with 429 + Retry-After (retryable)."""
+
+
+class DeadlineExpired(RuntimeError):
+    """The request's deadline passed while it sat in the queue: 504,
+    dropped before it could waste a device slot."""
+
+
+class TraceQuarantined(RuntimeError):
+    """The uuid is a repeat poison offender: rejected at admission with a
+    non-retryable 422 (the reference client only retries 5xx)."""
+
+
+class PoisonTrace(RuntimeError):
+    """This trace made its device batch fail while its co-batched
+    neighbours succeeded on bisect-retry."""
+
+
+class DeviceWedged(RuntimeError):
+    """The watchdog tripped: the device step is wedged and this batcher
+    no longer accepts work (the service falls back to CPU)."""
+
+
+class BatcherCrashed(RuntimeError):
+    """A MicroBatcher loop thread died on an unexpected error; the
+    batcher is dead and /health reports unhealthy."""
 
 
 class MicroBatcher:
@@ -106,10 +193,29 @@ class MicroBatcher:
     host association under device compute (e2e 3116 vs 2321 tr/s at
     depth 2, device_util 1.0 vs 0.87 --
     docs/measurements/bench_tpu_2026-07-31_inflight4.json).
+
+    Fault domains (docs/robustness.md): the submit queue is BOUNDED and
+    sheds at admission (Overloaded -> 429), every entry carries a deadline
+    and is dropped before dispatch once it expires (DeadlineExpired ->
+    504), a failed batch is bisect-retried so one poison trace fails alone
+    while its co-batched neighbours succeed (repeat offenders by uuid are
+    then rejected at admission: TraceQuarantined -> 422), a watchdog
+    bounds every device-blocking section and wedges the batcher on a hung
+    device step (DeviceWedged; the service's on_wedged hook degrades to
+    the CPU fallback), and both loop threads are crash-loud — an
+    unexpected loop error fails every pending future and marks the
+    batcher dead (BatcherCrashed; /health flips unhealthy) instead of
+    stranding the peer thread on the bounded hand-off queue.
     """
 
     def __init__(self, matcher: SegmentMatcher, max_batch: int = 64, max_wait_ms: float = 10.0,
-                 max_inflight: Optional[int] = None, instrument: bool = True):
+                 max_inflight: Optional[int] = None, instrument: bool = True,
+                 max_queue: Optional[int] = None,
+                 deadline_ms: Optional[float] = None,
+                 watchdog_s: Optional[float] = None,
+                 quarantine_after: Optional[int] = None,
+                 quarantine_ttl_s: Optional[float] = None,
+                 on_wedged=None, on_crashed=None):
         if max_inflight is None:
             # 4 = measured v5e optimum (hides every dispatch sync quantum
             # and all host association under device compute); when the
@@ -136,33 +242,130 @@ class MicroBatcher:
         # always flow — tracing is always on, one span per request, and
         # ?debug=1 only controls whether the breakdown rides the response
         self._obs = bool(instrument)
-        self._q: "queue.Queue[tuple]" = queue.Queue()
+        # fault-domain knobs (docs/robustness.md), env-overridable so a
+        # deployment can retune without a config rollout.  deadline_ms<=0
+        # disables the server default (client-sent deadlines still apply);
+        # watchdog_s<=0 disables the watchdog.
+        self.max_queue = int(_resolve_num(
+            "REPORTER_MAX_QUEUE", max_queue, 1024))
+        self.deadline_s = _resolve_num(
+            "REPORTER_DEADLINE_MS", deadline_ms, 30000.0) / 1000.0
+        self.watchdog_s = _resolve_num(
+            "REPORTER_WATCHDOG_S", watchdog_s, 120.0)
+        self.quarantine_after = int(_resolve_num(
+            "REPORTER_QUARANTINE_AFTER", quarantine_after, 2))
+        self.quarantine_ttl_s = _resolve_num(
+            "REPORTER_QUARANTINE_TTL_S", quarantine_ttl_s, 300.0)
+        # fault-domain state: wedged = watchdog tripped (device stuck),
+        # crashed = a loop thread died on a bug.  Both are terminal for
+        # this batcher — the service swaps in a new one on re-attach.
+        self.wedged = False
+        self._wedge_reason: Optional[str] = None
+        self._crashed = False
+        self._crash_reason: Optional[str] = None
+        self._on_wedged = on_wedged
+        self._on_crashed = on_crashed
+        self._offender_lock = threading.Lock()
+        self._offenders: dict = {}    # uuid -> poison isolations
+        self._quarantine: dict = {}   # uuid -> monotonic expiry
+        # device-blocking sections under watchdog watch: tid -> (t0, batch)
+        self._step_lock = threading.Lock()
+        self._steps: dict = {}
+        self._q: "queue.Queue[tuple]" = queue.Queue(maxsize=max(1, self.max_queue))
         self._finish_q: "queue.Queue[tuple]" = queue.Queue(maxsize=max_inflight)
         self._thread = threading.Thread(target=self._worker, daemon=True)
         self._thread.start()
         self._finisher = threading.Thread(target=self._finish_worker, daemon=True)
         self._finisher.start()
+        if self.watchdog_s > 0:
+            self._watchdog_thread = threading.Thread(
+                target=self._watchdog, daemon=True, name="batch-watchdog")
+            self._watchdog_thread.start()
 
-    def submit(self, trace: dict, span: Optional[Span] = None) -> Future:
+    def submit(self, trace: dict, span: Optional[Span] = None,
+               deadline: Optional[float] = None) -> Future:
+        """Admission control happens HERE, before any queueing: a dead
+        batcher refuses loudly, quarantined repeat-poison uuids are
+        rejected (non-retryable), and a full queue sheds (retryable) —
+        an overloaded server must answer fast, not queue unboundedly.
+        ``deadline`` is an absolute time.monotonic() bound; None applies
+        the server default."""
+        if self._crashed:
+            raise BatcherCrashed(self._crash_reason or "batcher thread died")
+        if self.wedged:
+            raise DeviceWedged(self._wedge_reason or "device step wedged")
+        uuid = str(trace.get("uuid") or "") if isinstance(trace, dict) else ""
+        if uuid and self._is_quarantined(uuid):
+            C_QUAR_REJ.inc()
+            raise TraceQuarantined(
+                "uuid %r is quarantined after repeated poison-batch "
+                "isolation" % uuid)
+        if deadline is None and self.deadline_s > 0:
+            deadline = _time.monotonic() + self.deadline_s
         f: Future = Future()
-        self._q.put((trace, f, _time.monotonic(), span))
+        try:
+            self._q.put_nowait((trace, f, _time.monotonic(), span, deadline))
+        except queue.Full:
+            C_SHED.inc()
+            raise Overloaded(
+                "submit queue full (%d waiting)" % self._q.qsize()) from None
         return f
 
-    def match(self, trace: dict, span: Optional[Span] = None) -> dict:
-        return self.submit(trace, span).result()
+    def match(self, trace: dict, span: Optional[Span] = None,
+              deadline: Optional[float] = None) -> dict:
+        return self.submit(trace, span, deadline=deadline).result()
 
-    def match_many(self, traces: List[dict]) -> List[dict]:
-        futures = [self.submit(t) for t in traces]
+    def match_many(self, traces: List[dict],
+                   deadline: Optional[float] = None) -> List[dict]:
+        futures = [self.submit(t, deadline=deadline) for t in traces]
         return [f.result() for f in futures]
 
+    def retry_after_s(self) -> int:
+        """Backoff hint for shed (429) responses: deeper queue, longer
+        hint, capped so clients re-probe within their retry budget."""
+        return max(1, min(30, 1 + self._q.qsize() // max(1, self.max_batch)))
+
+    # -- future resolution (idempotent: the watchdog may have failed a
+    # future that a stuck thread later tries to resolve) ------------------
+
     @staticmethod
-    def _fail_batch(batch, e: Exception) -> None:
-        for entry in batch:
-            f = entry[1]
+    def _resolve_exc(f: Future, e: BaseException) -> None:
+        try:
             if f.set_running_or_notify_cancel():
                 f.set_exception(e)
+        except Exception:  # noqa: BLE001 - already resolved elsewhere
+            pass
+
+    @staticmethod
+    def _resolve_result(f: Future, r) -> None:
+        try:
+            if f.set_running_or_notify_cancel():
+                f.set_result(r)
+        except Exception:  # noqa: BLE001 - already resolved elsewhere
+            pass
+
+    @classmethod
+    def _fail_batch(cls, batch, e: Exception) -> None:
+        for entry in batch:
+            cls._resolve_exc(entry[1], e)
+
+    # -- loop threads (crash-loud: an unexpected loop error fails every
+    # pending future and marks the batcher dead, instead of stranding the
+    # peer thread on a bounded queue forever) -----------------------------
 
     def _worker(self):
+        try:
+            self._worker_loop()
+        except BaseException as e:  # noqa: BLE001 - crash-loud by design
+            self._crash("dispatch worker", e)
+
+    def _finish_worker(self):
+        try:
+            self._finisher_loop()
+        except BaseException as e:  # noqa: BLE001 - crash-loud by design
+            self._crash("finisher", e)
+
+    def _worker_loop(self):
         while True:
             entry = self._q.get()
             batch = [entry]
@@ -178,6 +381,23 @@ class MicroBatcher:
                 except queue.Empty:
                     break
             now = _time.monotonic()
+            # deadline scrub BEFORE dispatch: an entry whose budget died in
+            # the queue answers 504 now and never wastes a device slot (its
+            # client has already given up; matching it would starve the
+            # still-live requests behind it)
+            live = []
+            for e_ in batch:
+                dl = e_[4]
+                if dl is not None and now > dl:
+                    C_EXPIRED.inc()
+                    self._resolve_exc(e_[1], DeadlineExpired(
+                        "deadline expired after %.3fs in queue"
+                        % (now - e_[2])))
+                else:
+                    live.append(e_)
+            batch = live
+            if not batch:
+                continue
             # the batch's lead span: its trace_id becomes the histogram
             # exemplar for batch-level observations, and the dispatch
             # thread binds it so a compile stall logged inside the matcher
@@ -188,7 +408,7 @@ class MicroBatcher:
                 M_BATCH_FILL.observe(
                     len(batch), exemplar=lead.trace_id if lead else None)
                 C_BATCHES.inc()
-            for _t, _f, t_enq, sp in batch:
+            for _t, _f, t_enq, sp, _dl in batch:
                 wait = now - t_enq
                 if self._obs:
                     M_QUEUE_WAIT.observe(
@@ -202,43 +422,236 @@ class MicroBatcher:
                     finish = self.matcher.match_many_async(
                         [e[0] for e in batch])
                 dispatch_s = _time.monotonic() - t_d0
-                for _t, _f, _te, sp in batch:
+                for _t, _f, _te, sp, _dl in batch:
                     if sp is not None:
                         # dispatch is async EXCEPT when a shape compiles:
                         # this mark is where a cold-start stall shows up
                         sp.mark("dispatch_s", dispatch_s)
             except Exception as e:
                 log.exception("batch dispatch failed")
-                self._fail_batch(batch, e)
+                self._contain_failure(batch, e)
                 continue
             if self._obs:
                 G_INFLIGHT.inc()
-            self._finish_q.put((batch, finish))  # blocks when finisher lags
+            # bounded hand-off (blocks when the finisher lags), abandoned
+            # when the batcher dies so this thread never wedges on a queue
+            # nobody drains
+            while True:
+                try:
+                    self._finish_q.put((batch, finish), timeout=0.25)
+                    break
+                except queue.Full:
+                    if self.wedged or self._crashed:
+                        self._fail_batch(batch, DeviceWedged(
+                            self._wedge_reason or "batcher dead"))
+                        if self._obs:
+                            G_INFLIGHT.dec()
+                        break
 
-    def _finish_worker(self):
+    def _finisher_loop(self):
         while True:
             batch, finish = self._finish_q.get()
             try:
                 t0 = _time.monotonic()
-                results = finish()
+                with self._watched(batch):
+                    results = finish()
                 step_s = _time.monotonic() - t0
                 if self._obs:
                     lead = next(
                         (e[3] for e in batch if e[3] is not None), None)
                     M_DEVICE_STEP.observe(
                         step_s, exemplar=lead.trace_id if lead else None)
-                for (t, f, _te, sp), r in zip(batch, results):
+                for (t, f, _te, sp, _dl), r in zip(batch, results):
                     if sp is not None:
                         sp.mark("device_step_s", step_s)
-                    if not f.set_running_or_notify_cancel():
-                        continue
-                    f.set_result(r)
-            except Exception as e:  # resolve everything with the error
+                    self._resolve_result(f, r)
+            except Exception as e:  # contain: bisect for poison, else fail
                 log.exception("batch match failed")
-                self._fail_batch(batch, e)
+                self._contain_failure(batch, e)
             finally:
                 if self._obs:
                     G_INFLIGHT.dec()
+
+    # -- device watchdog ---------------------------------------------------
+
+    @contextlib.contextmanager
+    def _watched(self, batch):
+        """Register the calling thread's device-blocking section with the
+        watchdog (finish() in the finisher, match_many in bisect-retry)."""
+        tid = threading.get_ident()
+        with self._step_lock:
+            self._steps[tid] = (_time.monotonic(), batch)
+        try:
+            yield
+        finally:
+            with self._step_lock:
+                self._steps.pop(tid, None)
+
+    def _watchdog(self):
+        """Bound every device-blocking section: a wedged device step must
+        become a visible, contained failure (degraded CPU serving via the
+        service's on_wedged hook), not a silently hung server."""
+        tick = max(0.02, min(1.0, self.watchdog_s / 8.0))
+        while not (self.wedged or self._crashed):
+            _time.sleep(tick)
+            now = _time.monotonic()
+            with self._step_lock:
+                stuck = [b for (t0, b) in self._steps.values()
+                         if now - t0 > self.watchdog_s]
+            if stuck:
+                self._trip("device step exceeded the %.1fs watchdog"
+                           % self.watchdog_s, stuck)
+                return
+
+    def _trip(self, reason: str, stuck_batches=()) -> None:
+        C_WD_TRIPS.inc()
+        self.wedged = True
+        self._wedge_reason = reason
+        obs_log.event(log, "watchdog_trip", level=logging.ERROR,
+                      reason=reason)
+        exc = DeviceWedged(reason)
+        # flip the service into degraded mode FIRST: handlers whose futures
+        # fail below re-check it and answer from the CPU fallback instead
+        # of bouncing a retryable 503 back at the client
+        cb = self._on_wedged
+        if cb is not None:
+            try:
+                cb(reason)
+            except Exception:  # noqa: BLE001 - never lose the trip itself
+                log.exception("on_wedged callback failed")
+        # the stuck thread cannot be interrupted (it is blocked inside the
+        # device runtime); fail its batch's futures so no handler waits on
+        # it — if it ever completes, its resolutions are no-ops
+        for b in stuck_batches:
+            self._fail_batch(b, exc)
+        self._drain_fail(exc)
+
+    def _crash(self, who: str, e: BaseException) -> None:
+        if self._crashed:
+            return
+        self._crashed = True
+        self._crash_reason = "%s thread died: %s" % (who, e)
+        C_CRASHES.inc()
+        log.critical("MicroBatcher %s; failing all pending futures",
+                     self._crash_reason, exc_info=True)
+        obs_log.event(log, "batcher_crash", level=logging.CRITICAL,
+                      thread=who, error=str(e)[:200])
+        # fail what nobody will ever process: the submit queue always (the
+        # dispatch worker is the only consumer and the batcher is now
+        # dead to new work), the dispatched hand-off queue only when the
+        # FINISHER died — on a worker crash the live finisher still
+        # completes batches already dispatched
+        self._drain_fail(BatcherCrashed(self._crash_reason),
+                         include_dispatched=(who == "finisher"))
+        cb = self._on_crashed
+        if cb is not None:
+            try:
+                cb(who, e)
+            except Exception:  # noqa: BLE001
+                log.exception("on_crashed callback failed")
+
+    def _drain_fail(self, exc: Exception,
+                    include_dispatched: bool = True) -> None:
+        """Fail everything queued anywhere in the batcher: the submit
+        queue, and (unless the finisher is still alive to complete them)
+        the dispatched-but-unfinished hand-off queue."""
+        while True:
+            try:
+                entry = self._q.get_nowait()
+            except queue.Empty:
+                break
+            self._resolve_exc(entry[1], exc)
+        if not include_dispatched:
+            return
+        while True:
+            try:
+                batch, _finish = self._finish_q.get_nowait()
+            except queue.Empty:
+                break
+            self._fail_batch(batch, exc)
+            if self._obs:
+                G_INFLIGHT.dec()
+
+    # -- poison-batch quarantine -------------------------------------------
+
+    def _contain_failure(self, batch, exc: Exception) -> None:
+        """A dispatched batch failed.  One malformed trace must not fail
+        its up-to-63 co-batched neighbours: bisect-retry synchronously to
+        isolate the poison (≤ ~2·B extra dispatches, and only on the
+        already-rare failure path), fail ONLY the offender(s), and resolve
+        everyone else with their real results."""
+        if (self.wedged or self._crashed
+                or isinstance(exc, (DeviceWedged, BatcherCrashed))):
+            self._fail_batch(batch, exc)
+            return
+        if len(batch) == 1:
+            self._fail_poison(batch[0], exc)
+            return
+        obs_log.event(log, "poison_bisect", level=logging.WARNING,
+                      batch_size=len(batch), error=str(exc)[:200])
+        budget = [2 * len(batch) + 4]
+        self._bisect(batch, exc, budget)
+
+    def _bisect(self, batch, exc: Exception, budget) -> None:
+        if len(batch) == 1:
+            self._fail_poison(batch[0], exc)
+            return
+        if budget[0] <= 0:
+            # systemic failure (every retry fails): stop paying for
+            # retries and fail the remainder with the underlying error
+            self._fail_batch(batch, exc)
+            return
+        mid = len(batch) // 2
+        for half in (batch[:mid], batch[mid:]):
+            budget[0] -= 1
+            try:
+                with self._watched(half):
+                    results = self.matcher.match_many([e[0] for e in half])
+            except Exception as e2:  # noqa: BLE001 - recurse to isolate
+                self._bisect(half, e2, budget)
+            else:
+                for entry, r in zip(half, results):
+                    self._resolve_result(entry[1], r)
+
+    def _fail_poison(self, entry, exc: Exception) -> None:
+        trace, f, _te, sp, _dl = entry
+        uuid = str(trace.get("uuid") or "") if isinstance(trace, dict) else ""
+        C_POISON.inc()
+        if uuid:
+            self._record_offender(uuid)
+        if sp is not None:
+            sp.meta["poison"] = True
+        # flight-recorded via the handler's error path; this event makes
+        # the isolation visible server-side with the offending trace_id
+        obs_log.event(log, "poison_trace", level=logging.ERROR,
+                      uuid=uuid[:64],
+                      trace_id=sp.trace_id if sp else None,
+                      error=str(exc)[:200])
+        self._resolve_exc(f, PoisonTrace(
+            "trace %r failed its device batch alone (co-batched requests "
+            "succeeded): %s" % (uuid, exc)))
+
+    def _record_offender(self, uuid: str) -> None:
+        with self._offender_lock:
+            n = self._offenders.get(uuid, 0) + 1
+            self._offenders[uuid] = n
+            if n >= self.quarantine_after:
+                self._quarantine[uuid] = (
+                    _time.monotonic() + self.quarantine_ttl_s)
+                obs_log.event(log, "uuid_quarantined", level=logging.WARNING,
+                              uuid=uuid[:64], offences=n,
+                              ttl_s=self.quarantine_ttl_s)
+
+    def _is_quarantined(self, uuid: str) -> bool:
+        with self._offender_lock:
+            exp = self._quarantine.get(uuid)
+            if exp is None:
+                return False
+            if _time.monotonic() > exp:
+                del self._quarantine[uuid]
+                self._offenders.pop(uuid, None)
+                return False
+            return True
 
 
 class ReporterService:
@@ -251,6 +664,7 @@ class ReporterService:
         max_batch: int = 64,
         max_wait_ms: float = 10.0,
         max_inflight: Optional[int] = None,
+        robustness: Optional[dict] = None,
     ):
         """``matcher=None`` defers the engine: the HTTP socket can bind and
         /health can answer before the accelerator backend is even
@@ -258,13 +672,36 @@ class ReporterService:
         bind-after-init boot dark indefinitely, 2026-07-31).  /report and
         /trace_attributes_batch return 503 until ``attach_matcher`` runs,
         which the reference's client treats as a retryable failure
-        (HttpClient.java:80-88: 3 retries on its 10 s budget)."""
+        (HttpClient.java:80-88: 3 retries on its 10 s budget).
+
+        ``robustness`` (config key of the same name, docs/robustness.md)
+        passes the fault-domain knobs through to the MicroBatcher
+        (max_queue / deadline_ms / watchdog_s / quarantine_after /
+        quarantine_ttl_s) plus the service-level ``reattach_probe_s``;
+        every knob also has a REPORTER_* env override."""
         self._batch_params = dict(max_batch=max_batch, max_wait_ms=max_wait_ms,
                                   max_inflight=max_inflight)
+        rb = dict(robustness or {})
+        self._reattach_probe_s = _resolve_num(
+            "REPORTER_REATTACH_PROBE_S", rb.pop("reattach_probe_s", None),
+            15.0)
+        self._robust_params = {
+            k: rb[k] for k in ("max_queue", "deadline_ms", "watchdog_s",
+                               "quarantine_after", "quarantine_ttl_s")
+            if k in rb
+        }
         self._threshold_arg = threshold_sec
         self.matcher = None
         self.batcher = None
         self.threshold_sec = None
+        # degraded mode: after a device watchdog trip the engine is
+        # detached and requests are answered by the CPU oracle with
+        # "degraded": true until a probe re-attaches the accelerator
+        self.degraded = False
+        self._degraded_lock = threading.Lock()
+        self._cpu_matcher = None
+        self._cpu_lock = threading.Lock()
+        self.unhealthy_reason: Optional[str] = None
         if matcher is not None:
             self.attach_matcher(matcher)
         self._t_boot = _time.time()
@@ -287,7 +724,98 @@ class ReporterService:
             threshold = int(os.environ.get("THRESHOLD_SEC", matcher.cfg.threshold_sec))
         self.threshold_sec = int(threshold)
         self.matcher = matcher
-        self.batcher = MicroBatcher(matcher, **self._batch_params)
+        self.batcher = self._make_batcher(matcher)
+
+    def _make_batcher(self, matcher: SegmentMatcher) -> MicroBatcher:
+        return MicroBatcher(
+            matcher, **self._batch_params, **self._robust_params,
+            on_wedged=self._enter_degraded, on_crashed=self._note_crash)
+
+    # -- fault domains: degraded mode + re-attach --------------------------
+
+    def _note_crash(self, who: str, e: BaseException) -> None:
+        """MicroBatcher loop-thread crash: flip /health unhealthy so the
+        orchestrator restarts this replica (a crashed batcher is a bug,
+        not a device fault — no CPU fallback, fail loud)."""
+        self.unhealthy_reason = "batcher %s thread died: %s" % (who, e)
+
+    def _enter_degraded(self, reason: str) -> None:
+        """Device watchdog trip: detach the engine, serve from the CPU
+        oracle (responses carry ``degraded: true``), and probe for
+        re-attach in the background."""
+        with self._degraded_lock:
+            if self.degraded:
+                return
+            self.degraded = True
+        G_DEGRADED.set(1)
+        obs_log.event(log, "degraded_enter", level=logging.ERROR,
+                      reason=reason)
+        if self._reattach_probe_s > 0:
+            threading.Thread(target=self._probe_loop, daemon=True,
+                             name="reattach-probe").start()
+
+    def _cpu_fallback(self) -> SegmentMatcher:
+        """The degraded-mode engine: the numpy oracle over the SAME graph
+        arrays + UBODT (no rebuild, no device).  Built lazily on first
+        degraded request; serialised by _cpu_lock (the matcher's staging
+        reuse assumes single-threaded dispatch)."""
+        m = self.matcher
+        if m is None or not getattr(m.cfg, "cpu_fallback", True):
+            raise DeviceWedged("device wedged and cpu_fallback disabled")
+        with self._cpu_lock:
+            if self._cpu_matcher is None:
+                self._cpu_matcher = SegmentMatcher(
+                    arrays=m.arrays, ubodt=m.ubodt, config=m.cfg,
+                    backend="cpu")
+            return self._cpu_matcher
+
+    def _probe_loop(self) -> None:
+        """Periodically probe the wedged engine with a dummy dispatch
+        through the real match path; on a healthy answer within the
+        watchdog bound, swap in a fresh MicroBatcher and leave degraded
+        mode (the probe itself re-warms the dispatch path)."""
+        wd = self.batcher.watchdog_s if self.batcher is not None else 120.0
+        timeout = max(1.0, wd if wd > 0 else 120.0)
+        while self.degraded and not self.draining:
+            _time.sleep(self._reattach_probe_s)
+            if not self.degraded or self.draining:
+                return
+            if self._probe_device(timeout):
+                self._reattach()
+                return
+
+    def _probe_device(self, timeout_s: float) -> bool:
+        m = self.matcher
+        if m is None:
+            return False
+        ok: list = []
+        done = threading.Event()
+
+        def _try():
+            try:
+                m.match_many(m.dummy_traces(4, 1))
+                ok.append(True)
+            except Exception as e:  # noqa: BLE001 - probe failure = stay degraded
+                log.info("re-attach probe failed: %s", e)
+            finally:
+                done.set()
+
+        # the probe may hang exactly like the wedged step did: run it on a
+        # disposable daemon thread and give up at the watchdog bound (one
+        # leaked parked thread per failed probe, bounded by probe spacing)
+        threading.Thread(target=_try, daemon=True,
+                         name="reattach-probe-dispatch").start()
+        done.wait(timeout=timeout_s)
+        return bool(ok)
+
+    def _reattach(self) -> None:
+        self.batcher = self._make_batcher(self.matcher)
+        with self._degraded_lock:
+            self.degraded = False
+        G_DEGRADED.set(0)
+        C_REATTACH.inc()
+        obs_log.event(log, "engine_reattach", level=logging.WARNING,
+                      backend=self.matcher.backend)
 
     # -- request handling --------------------------------------------------
 
@@ -312,12 +840,15 @@ class ReporterService:
             return "match_options must include transition_levels array", None, None
         return None, rl, tl
 
-    def handle_report(self, trace: dict, debug: bool = False) -> Tuple[int, dict]:
+    def handle_report(self, trace: dict, debug: bool = False,
+                      deadline: Optional[float] = None) -> Tuple[int, dict]:
         # always-on tracing: the HTTP handler binds a Span carrying the
         # (accepted or generated) trace_id before calling in; embedders
         # that call handle_report(trace) directly get a self-made trace.
         # ?debug=1 only opts the breakdown onto the response — every
         # outcome is offered to the flight recorder regardless.
+        # ``deadline`` is the absolute monotonic bound parsed from
+        # X-Reporter-Deadline-Ms at ingestion (None -> server default).
         span = obs_trace.current_span() or Span("report")
         span.meta.setdefault("endpoint", "report")
         if isinstance(trace, dict) and trace.get("uuid") is not None:
@@ -326,27 +857,49 @@ class ReporterService:
         if batcher is None:
             span.fail("service initialising", status="unavailable")
             obs_flight.record(span)
-            return 503, {"error": "service initialising"}
+            return 503, {"error": "service initialising", "retry_after": 1}
         err, rl, tl = self.validate(trace)
         if err:
             C_REQUESTS.labels("report", "invalid").inc()
             span.fail(err, status="invalid")
             obs_flight.record(span)
             return 400, {"error": err}
+        if self.degraded:
+            return self._finish_report(trace, rl, tl, span, debug,
+                                       degraded=True)
         try:
+            # deadline is forwarded only when the request set one (stub and
+            # embedder batchers keep their two-arg match contract); the
+            # server default is applied inside submit() either way
+            mkw = {} if deadline is None else {"deadline": deadline}
             with obs_trace.bind(span):
-                match = batcher.match(trace, span=span)
-                t_rep = _time.monotonic()
-                data = report_fn(match, trace, self.threshold_sec, rl, tl,
-                                 mode=trace.get("match_options", {}).get("mode", "auto"))
-            span.mark("report_fn_s", _time.monotonic() - t_rep)
-            span.finish()
-            if debug:
-                data["debug"] = span.breakdown()
+                match = batcher.match(trace, span=span, **mkw)
+        except Overloaded as e:
+            span.fail(e, status="shed")
             obs_flight.record(span)
-            self._count(ok=True)
-            C_REQUESTS.labels("report", "ok").inc()
-            return 200, data
+            C_REQUESTS.labels("report", "shed").inc()
+            return 429, {"error": str(e),
+                         "retry_after": batcher.retry_after_s()}
+        except DeadlineExpired as e:
+            span.fail(e, status="expired")
+            obs_flight.record(span)
+            C_REQUESTS.labels("report", "expired").inc()
+            return 504, {"error": str(e)}
+        except TraceQuarantined as e:
+            span.fail(e, status="quarantined")
+            obs_flight.record(span)
+            C_REQUESTS.labels("report", "quarantined").inc()
+            return 422, {"error": str(e)}
+        except (DeviceWedged, BatcherCrashed) as e:
+            if self.degraded:
+                # raced the watchdog trip: answer from the CPU fallback
+                return self._finish_report(trace, rl, tl, span, debug,
+                                           degraded=True)
+            span.fail(e, status="unavailable")
+            obs_flight.record(span)
+            self._count(ok=False)
+            C_REQUESTS.labels("report", "error").inc()
+            return 503, {"error": str(e), "retry_after": 1}
         except Exception as e:
             log.exception("match failed")
             span.fail(e)
@@ -354,6 +907,48 @@ class ReporterService:
             self._count(ok=False)
             C_REQUESTS.labels("report", "error").inc()
             return 500, {"error": str(e)}
+        return self._finish_report(trace, rl, tl, span, debug, match=match)
+
+    def _finish_report(self, trace, rl, tl, span, debug,
+                       match: Optional[dict] = None,
+                       degraded: bool = False) -> Tuple[int, dict]:
+        """Render the report (matching first via the CPU fallback on the
+        degraded path); degraded answers carry ``"degraded": true``."""
+        try:
+            with obs_trace.bind(span):
+                if degraded:
+                    m = self._cpu_fallback()
+                    t_m = _time.monotonic()
+                    with self._cpu_lock:
+                        match = m.match_many([trace])[0]
+                    span.mark("cpu_fallback_s", _time.monotonic() - t_m)
+                t_rep = _time.monotonic()
+                data = report_fn(match, trace, self.threshold_sec, rl, tl,
+                                 mode=trace.get("match_options", {}).get("mode", "auto"))
+            span.mark("report_fn_s", _time.monotonic() - t_rep)
+            span.finish()
+            if degraded:
+                data["degraded"] = True
+                span.meta["degraded"] = True
+                C_DEGRADED_REQ.inc()
+            if debug:
+                data["debug"] = span.breakdown()
+            obs_flight.record(span)
+            self._count(ok=True)
+            C_REQUESTS.labels(
+                "report", "degraded" if degraded else "ok").inc()
+            return 200, data
+        except Exception as e:
+            log.exception("match failed")
+            span.fail(e)
+            obs_flight.record(span)
+            self._count(ok=False)
+            C_REQUESTS.labels("report", "error").inc()
+            code = 503 if isinstance(e, (DeviceWedged, BatcherCrashed)) else 500
+            out = {"error": str(e)}
+            if code == 503:
+                out["retry_after"] = 1
+            return code, out
 
     def _count(self, ok: bool) -> None:
         with self._counter_lock:
@@ -363,10 +958,22 @@ class ReporterService:
 
     def handle_health(self) -> Tuple[int, dict]:
         """Liveness/ops snapshot (additive: the reference exposes no such
-        endpoint, so nothing on the wire contract changes)."""
+        endpoint, so nothing on the wire contract changes).  A crashed
+        batcher thread flips the status to "unhealthy" with a 503 so an
+        orchestrator probe restarts the replica; degraded (CPU fallback)
+        mode stays 200 "ok" — the service IS answering, just slower."""
         m = self.matcher
+        b = self.batcher
+        if self.unhealthy_reason or (b is not None and b._crashed):
+            return 503, {
+                "status": "unhealthy",
+                "reason": self.unhealthy_reason
+                or (b._crash_reason if b is not None else None),
+                "uptime_s": round(_time.time() - self._t_boot, 1),
+            }
         return 200, {
             "status": "ok",
+            "degraded": bool(self.degraded),
             # True while boot-time work is still in flight: backend init +
             # engine build (matcher fields below are null until attached)
             # and the background shape warmup.  The service answers either
@@ -384,7 +991,8 @@ class ReporterService:
             "errors": self._n_errors,
         }
 
-    def handle_batch(self, body: dict) -> Tuple[int, dict]:
+    def handle_batch(self, body: dict,
+                     deadline: Optional[float] = None) -> Tuple[int, dict]:
         # one span for the whole batch request (per-trace fan-out would
         # multiply flight entries); stage marks cover the pooled match and
         # the report loop
@@ -394,7 +1002,7 @@ class ReporterService:
         if batcher is None:
             span.fail("service initialising", status="unavailable")
             obs_flight.record(span)
-            return 503, {"error": "service initialising"}
+            return 503, {"error": "service initialising", "retry_after": 1}
         traces = body.get("traces")
         if not isinstance(traces, list) or not traces:
             span.fail("traces must be a non-empty array", status="invalid")
@@ -413,7 +1021,16 @@ class ReporterService:
         try:
             with obs_trace.bind(span):
                 t0 = _time.monotonic()
-                matches = batcher.match_many([t for t, _, _ in validated])
+                if self.degraded:
+                    m = self._cpu_fallback()
+                    with self._cpu_lock:
+                        matches = m.match_many([t for t, _, _ in validated])
+                    C_DEGRADED_REQ.inc()
+                    span.meta["degraded"] = True
+                else:
+                    mkw = {} if deadline is None else {"deadline": deadline}
+                    matches = batcher.match_many(
+                        [t for t, _, _ in validated], **mkw)
                 span.mark("match_s", _time.monotonic() - t0)
                 t0 = _time.monotonic()
                 results = [
@@ -424,8 +1041,35 @@ class ReporterService:
                 span.mark("report_fn_s", _time.monotonic() - t0)
             obs_flight.record(span)
             self._count(ok=True)
-            C_REQUESTS.labels("trace_attributes_batch", "ok").inc()
-            return 200, {"results": results}
+            out = {"results": results}
+            if span.meta.get("degraded"):
+                out["degraded"] = True
+                C_REQUESTS.labels("trace_attributes_batch", "degraded").inc()
+            else:
+                C_REQUESTS.labels("trace_attributes_batch", "ok").inc()
+            return 200, out
+        except Overloaded as e:
+            span.fail(e, status="shed")
+            obs_flight.record(span)
+            C_REQUESTS.labels("trace_attributes_batch", "shed").inc()
+            return 429, {"error": str(e),
+                         "retry_after": batcher.retry_after_s()}
+        except DeadlineExpired as e:
+            span.fail(e, status="expired")
+            obs_flight.record(span)
+            C_REQUESTS.labels("trace_attributes_batch", "expired").inc()
+            return 504, {"error": str(e)}
+        except TraceQuarantined as e:
+            span.fail(e, status="quarantined")
+            obs_flight.record(span)
+            C_REQUESTS.labels("trace_attributes_batch", "quarantined").inc()
+            return 422, {"error": str(e)}
+        except (DeviceWedged, BatcherCrashed) as e:
+            span.fail(e, status="unavailable")
+            obs_flight.record(span)
+            self._count(ok=False)
+            C_REQUESTS.labels("trace_attributes_batch", "error").inc()
+            return 503, {"error": str(e), "retry_after": 1}
         except Exception as e:
             log.exception("batch failed")
             span.fail(e)
@@ -443,6 +1087,7 @@ class ReporterService:
         from ..obs import attrib as obs_attrib
 
         m = self.matcher
+        b = self.batcher
         return 200, {
             "uptime_s": round(_time.time() - self._t_boot, 1),
             "warming": bool(getattr(self, "warming", False)) or m is None,
@@ -450,6 +1095,21 @@ class ReporterService:
             "viterbi_kernel": getattr(m, "_kernel_mode", None) if m else None,
             "threshold_sec": self.threshold_sec,
             "batch": dict(self._batch_params),
+            # fault-domain state (docs/robustness.md): degraded = CPU
+            # fallback serving after a watchdog trip; wedged/crashed name
+            # the batcher's terminal states; robustness echoes the knobs
+            "degraded": bool(self.degraded),
+            "wedged": bool(b.wedged) if b is not None else None,
+            "crashed": bool(b._crashed) if b is not None else None,
+            "robustness": {
+                "max_queue": b.max_queue,
+                "deadline_ms": round(b.deadline_s * 1000.0, 1),
+                "watchdog_s": b.watchdog_s,
+                "quarantine_after": b.quarantine_after,
+                "quarantine_ttl_s": b.quarantine_ttl_s,
+                "reattach_probe_s": self._reattach_probe_s,
+                "quarantined_uuids": len(b._quarantine),
+            } if b is not None else None,
             "latency_buckets_s": list(obs.LATENCY_BUCKETS_S),
             "batch_fill_buckets": list(obs.BATCH_FILL_BUCKETS),
             "flight": obs_flight.RECORDER.summary(),
@@ -561,6 +1221,16 @@ class ReporterService:
                 self.send_header("Access-Control-Allow-Origin", "*")
                 self.send_header("Content-Type", "application/json;charset=utf-8")
                 self.send_header("Content-Length", str(len(body)))
+                if code in (429, 503):
+                    # shed/unavailable responses carry a backoff hint both
+                    # as a header (RFC 9110, what generic clients read) and
+                    # in the body (docs/http-api.md error semantics)
+                    ra = payload.get("retry_after") if isinstance(payload, dict) else None
+                    try:
+                        ra = max(1, int(ra))
+                    except (TypeError, ValueError):
+                        ra = 1
+                    self.send_header("Retry-After", str(ra))
                 self._echo_trace_header()
                 self.end_headers()
                 self.wfile.write(body)
@@ -684,21 +1354,39 @@ class ReporterService:
                     if not isinstance(payload, dict):
                         code, out = 400, {"error": "request body must be a json object"}
                     else:
+                        # per-request deadline: X-Reporter-Deadline-Ms is
+                        # the client's remaining budget; converted to an
+                        # absolute monotonic bound AT INGESTION so queue
+                        # time counts against it.  Malformed values are
+                        # ignored (server default applies), like a
+                        # malformed trace header.
+                        deadline = None
+                        raw_dl = self.headers.get("X-Reporter-Deadline-Ms")
+                        if raw_dl:
+                            try:
+                                deadline = (_time.monotonic()
+                                            + max(0.0, float(raw_dl)) / 1000.0)
+                            except ValueError:
+                                deadline = None
                         # the request's span: handle_report/handle_batch pick
                         # it up from the context (their own signatures stay
                         # embedder-compatible)
                         span = Span(action, trace_id=self._trace_id)
+                        # kwargs are only passed when set, so embedders
+                        # wrapping handle_report(trace) keep working
+                        kw = {}
+                        if deadline is not None:
+                            kw["deadline"] = deadline
                         with obs_trace.bind(span):
                             if action == "report":
                                 # ?debug=1 opts the breakdown onto the
-                                # response; the kwarg is only passed when set
-                                # so embedders wrapping handle_report(trace)
-                                # keep working
+                                # response
                                 debug = query.get("debug", ["0"])[0] not in ("", "0", "false")
-                                code, out = (service.handle_report(payload, debug=True)
-                                             if debug else service.handle_report(payload))
+                                if debug:
+                                    kw["debug"] = True
+                                code, out = service.handle_report(payload, **kw)
                             else:
-                                code, out = service.handle_batch(payload)
+                                code, out = service.handle_batch(payload, **kw)
                 except Exception as e:  # belt-and-braces: never drop the socket
                     log.exception("unhandled request error")
                     code, out = 500, {"error": str(e)}

@@ -16,17 +16,18 @@ from __future__ import annotations
 
 import json
 import logging
-import time
 import urllib.error
 import urllib.request
 from typing import List, Optional, Sequence
 
+from .. import faults
 from ..obs import trace as obs_trace
+from ..utils import retry
 
 log = logging.getLogger(__name__)
 
-RETRIES = 3
-TIMEOUT_SEC = 10.0
+RETRIES = retry.RETRIES
+TIMEOUT_SEC = retry.BUDGET_S
 
 
 def _post_json(url: str, payload: dict, timeout: float = TIMEOUT_SEC) -> Optional[dict]:
@@ -41,28 +42,36 @@ def _post_json(url: str, payload: dict, timeout: float = TIMEOUT_SEC) -> Optiona
         headers={"Content-Type": "application/json",
                  "X-Reporter-Trace": trace_id},
     )
-    last: Optional[Exception] = None
-    for attempt in range(RETRIES):
-        if attempt:
-            time.sleep(0.2 * attempt)
-        try:
-            with urllib.request.urlopen(req, timeout=timeout) as resp:
-                echoed = resp.headers.get("X-Reporter-Trace")
-                if echoed and echoed != trace_id:
-                    log.debug("matcher echoed foreign trace id %s (sent %s)",
-                              echoed, trace_id)
-                return json.loads(resp.read().decode("utf-8"))
-        except urllib.error.HTTPError as e:
-            if 400 <= e.code < 500:
-                log.error("matcher rejected request (trace %s): %s",
-                          trace_id, e)
-                return None
-            last = e
-        except Exception as e:
-            last = e
-    log.error("matcher unreachable after %d attempts (trace %s): %s",
-              RETRIES, trace_id, last)
-    return None
+
+    def _do():
+        # chaos seam: a connection reset mid-flight, the failure mode a
+        # flaky LB/sidecar hands this client (docs/robustness.md)
+        if faults.fire("client_post") is not None:
+            raise ConnectionResetError("injected connection reset")
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            echoed = resp.headers.get("X-Reporter-Trace")
+            if echoed and echoed != trace_id:
+                log.debug("matcher echoed foreign trace id %s (sent %s)",
+                          echoed, trace_id)
+            return json.loads(resp.read().decode("utf-8"))
+
+    # the reference contract (HttpClient.java:80-88): 3 tries on a ~10 s
+    # total budget, exponential backoff + full jitter, Retry-After honoured
+    # on the serve tier's 429/503 shed responses, 4xx never retried
+    try:
+        return retry.call_with_retries(_do, target="matcher",
+                                       budget_s=timeout)
+    except urllib.error.HTTPError as e:
+        if 400 <= e.code < 500 and e.code != 429:
+            log.error("matcher rejected request (trace %s): %s", trace_id, e)
+        else:
+            log.error("matcher unreachable after %d attempts (trace %s): %s",
+                      RETRIES, trace_id, e)
+        return None
+    except Exception as e:  # noqa: BLE001 - degraded to a dropped response
+        log.error("matcher unreachable after %d attempts (trace %s): %s",
+                  RETRIES, trace_id, e)
+        return None
 
 
 class HttpMatcherClient:
