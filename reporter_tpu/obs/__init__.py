@@ -17,6 +17,15 @@
               (GET /debug/attrib, bench.py's ``attrib`` block, the
               reporter_stage_device_seconds gauges) plus the shared
               roofline/row accounting and last_onchip provenance
+``quantile``  ONE implementation of histogram-quantile math (Prometheus
+              semantics) + the shared SLO_BUCKETS_S log-bucket table —
+              used by the SLO engine, tools/trace_top.py and
+              tools/loadgen.py so every surface computes the same number
+``slo``       server-side SLO engine: declarative objectives over
+              sliding windows, error-budget burn rates with multi-window
+              AND-gated alerting, fed from every terminal request
+              outcome (GET /debug/slo, the /statusz burn line, the
+              reporter_slo_* families)
 """
 
 from .metrics import (  # noqa: F401

@@ -5,6 +5,10 @@ Every finished ``Span`` is offered via ``record()``.  Tail sampling
 decides retention AFTER the outcome is known:
 
   - every errored span is kept (``span.status != "ok"``),
+  - every SLO-violating span is kept (``span.meta["slo_violation"]`` —
+    the serve tier marks budget-burning and tail-contributing requests
+    per obs/slo.py, so a 200 that blew the latency objective is
+    retained even when it sits under the generic slow threshold),
   - every span slower than the slow threshold is kept,
   - 1-in-N of the healthy rest is kept,
   - everything else only increments a counter.
@@ -45,7 +49,7 @@ from .trace import Span
 C_FLIGHT = obs.counter(
     "reporter_flight_traces_total",
     "Flight-recorder tail-sampling decisions "
-    "(error / slow / sampled / dropped)",
+    "(error / slo / slow / sampled / dropped)",
     ("decision",))
 
 
@@ -80,6 +84,8 @@ class FlightRecorder:
             span.finish()
         if span.status != "ok":
             decision = "error"
+        elif span.meta.get("slo_violation"):
+            decision = "slo"
         elif span.total_s * 1000.0 >= self.slow_ms:
             decision = "slow"
         else:

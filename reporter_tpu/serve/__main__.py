@@ -96,6 +96,11 @@ def main(argv):
         # degraded-mode re-attach probing; REPORTER_* env overrides apply
         # on top of the config block
         robustness=conf.get("robustness", {}),
+        # serving objectives (docs/observability.md "The SLO engine"):
+        # availability / latency quantiles / degraded fraction measured
+        # over sliding windows at GET /debug/slo; REPORTER_SLO_* env
+        # knobs tune the defaults when the config has no "slo" block
+        slo=conf.get("slo"),
     )
     httpd = service.make_server(host, int(port))
     logging.info("reporter_tpu service on %s:%s (engine deferred)", host, port)

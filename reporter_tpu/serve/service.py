@@ -42,6 +42,7 @@ from ..matching import MatcherConfig, SegmentMatcher
 from ..obs import flight as obs_flight
 from ..obs import log as obs_log
 from ..obs import metrics as obs
+from ..obs import slo as obs_slo
 from ..obs import trace as obs_trace
 from ..obs.trace import Span
 from ..report import report as report_fn
@@ -50,7 +51,7 @@ from ..tiles.network import RoadNetwork, grid_city
 log = logging.getLogger(__name__)
 
 ACTIONS = {"report", "trace_attributes_batch", "health",
-           "metrics", "statusz", "profile", "traces", "attrib"}
+           "metrics", "statusz", "profile", "traces", "attrib", "slo"}
 
 
 def _env_num(name: str, default: float) -> float:
@@ -665,6 +666,7 @@ class ReporterService:
         max_wait_ms: float = 10.0,
         max_inflight: Optional[int] = None,
         robustness: Optional[dict] = None,
+        slo: Optional[dict] = None,
     ):
         """``matcher=None`` defers the engine: the HTTP socket can bind and
         /health can answer before the accelerator backend is even
@@ -678,7 +680,15 @@ class ReporterService:
         passes the fault-domain knobs through to the MicroBatcher
         (max_queue / deadline_ms / watchdog_s / quarantine_after /
         quarantine_ttl_s) plus the service-level ``reattach_probe_s``;
-        every knob also has a REPORTER_* env override."""
+        every knob also has a REPORTER_* env override.
+
+        ``slo`` (config key "slo", docs/observability.md "The SLO
+        engine") declares the serving objectives — availability,
+        per-route latency quantiles, degraded-mode fraction — the engine
+        measures every terminal outcome against (GET /debug/slo, the
+        /statusz burn-rate line, reporter_slo_* families).  None keeps
+        the env-tuned defaults (REPORTER_SLO_*) without touching an
+        engine another embedder already configured in-process."""
         self._batch_params = dict(max_batch=max_batch, max_wait_ms=max_wait_ms,
                                   max_inflight=max_inflight)
         rb = dict(robustness or {})
@@ -690,6 +700,8 @@ class ReporterService:
                                "quarantine_after", "quarantine_ttl_s")
             if k in rb
         }
+        if slo is not None:
+            obs_slo.configure(slo)
         self._threshold_arg = threshold_sec
         self.matcher = None
         self.batcher = None
@@ -819,6 +831,24 @@ class ReporterService:
 
     # -- request handling --------------------------------------------------
 
+    @staticmethod
+    def _terminal(route: str, code: int, span: Span,
+                  degraded: bool = False) -> None:
+        """EVERY terminal request outcome flows through here: the SLO
+        engine classifies it against-budget or excluded per the
+        documented policy (obs/slo.py), and any violated objective names
+        mark the span BEFORE it is offered to the flight recorder — so
+        an SLO-violating trace_id is retained like an error, even on a
+        200 that merely blew the latency objective."""
+        if "total_s" not in span.timings:
+            span.finish()
+        violated = obs_slo.observe(
+            route, code, span.timings.get("total_s"),
+            degraded=degraded, trace_id=span.trace_id)
+        if violated:
+            span.meta["slo_violation"] = violated
+        obs_flight.record(span)
+
     def validate(self, trace: dict) -> Tuple[Optional[str], Optional[set], Optional[set]]:
         """Returns (error, report_levels, transition_levels)."""
         if trace.get("uuid") is None:
@@ -856,13 +886,13 @@ class ReporterService:
         batcher = self.batcher
         if batcher is None:
             span.fail("service initialising", status="unavailable")
-            obs_flight.record(span)
+            self._terminal("report", 503, span)
             return 503, {"error": "service initialising", "retry_after": 1}
         err, rl, tl = self.validate(trace)
         if err:
             C_REQUESTS.labels("report", "invalid").inc()
             span.fail(err, status="invalid")
-            obs_flight.record(span)
+            self._terminal("report", 400, span)
             return 400, {"error": err}
         if self.degraded:
             return self._finish_report(trace, rl, tl, span, debug,
@@ -876,18 +906,18 @@ class ReporterService:
                 match = batcher.match(trace, span=span, **mkw)
         except Overloaded as e:
             span.fail(e, status="shed")
-            obs_flight.record(span)
+            self._terminal("report", 429, span)
             C_REQUESTS.labels("report", "shed").inc()
             return 429, {"error": str(e),
                          "retry_after": batcher.retry_after_s()}
         except DeadlineExpired as e:
             span.fail(e, status="expired")
-            obs_flight.record(span)
+            self._terminal("report", 504, span)
             C_REQUESTS.labels("report", "expired").inc()
             return 504, {"error": str(e)}
         except TraceQuarantined as e:
             span.fail(e, status="quarantined")
-            obs_flight.record(span)
+            self._terminal("report", 422, span)
             C_REQUESTS.labels("report", "quarantined").inc()
             return 422, {"error": str(e)}
         except (DeviceWedged, BatcherCrashed) as e:
@@ -896,14 +926,14 @@ class ReporterService:
                 return self._finish_report(trace, rl, tl, span, debug,
                                            degraded=True)
             span.fail(e, status="unavailable")
-            obs_flight.record(span)
+            self._terminal("report", 503, span)
             self._count(ok=False)
             C_REQUESTS.labels("report", "error").inc()
             return 503, {"error": str(e), "retry_after": 1}
         except Exception as e:
             log.exception("match failed")
             span.fail(e)
-            obs_flight.record(span)
+            self._terminal("report", 500, span)
             self._count(ok=False)
             C_REQUESTS.labels("report", "error").inc()
             return 500, {"error": str(e)}
@@ -933,7 +963,7 @@ class ReporterService:
                 C_DEGRADED_REQ.inc()
             if debug:
                 data["debug"] = span.breakdown()
-            obs_flight.record(span)
+            self._terminal("report", 200, span, degraded=degraded)
             self._count(ok=True)
             C_REQUESTS.labels(
                 "report", "degraded" if degraded else "ok").inc()
@@ -941,10 +971,10 @@ class ReporterService:
         except Exception as e:
             log.exception("match failed")
             span.fail(e)
-            obs_flight.record(span)
+            code = 503 if isinstance(e, (DeviceWedged, BatcherCrashed)) else 500
+            self._terminal("report", code, span)
             self._count(ok=False)
             C_REQUESTS.labels("report", "error").inc()
-            code = 503 if isinstance(e, (DeviceWedged, BatcherCrashed)) else 500
             out = {"error": str(e)}
             if code == 503:
                 out["retry_after"] = 1
@@ -1001,12 +1031,12 @@ class ReporterService:
         batcher = self.batcher
         if batcher is None:
             span.fail("service initialising", status="unavailable")
-            obs_flight.record(span)
+            self._terminal("trace_attributes_batch", 503, span)
             return 503, {"error": "service initialising", "retry_after": 1}
         traces = body.get("traces")
         if not isinstance(traces, list) or not traces:
             span.fail("traces must be a non-empty array", status="invalid")
-            obs_flight.record(span)
+            self._terminal("trace_attributes_batch", 400, span)
             return 400, {"error": "traces must be a non-empty array"}
         span.meta["n_traces"] = len(traces)
         validated = []
@@ -1015,7 +1045,7 @@ class ReporterService:
             if err:
                 C_REQUESTS.labels("trace_attributes_batch", "invalid").inc()
                 span.fail("trace %d: %s" % (i, err), status="invalid")
-                obs_flight.record(span)
+                self._terminal("trace_attributes_batch", 400, span)
                 return 400, {"error": "trace %d: %s" % (i, err)}
             validated.append((trace, rl, tl))
         try:
@@ -1039,10 +1069,12 @@ class ReporterService:
                     for m, (t, rl, tl) in zip(matches, validated)
                 ]
                 span.mark("report_fn_s", _time.monotonic() - t0)
-            obs_flight.record(span)
+            degraded = bool(span.meta.get("degraded"))
+            self._terminal("trace_attributes_batch", 200, span,
+                           degraded=degraded)
             self._count(ok=True)
             out = {"results": results}
-            if span.meta.get("degraded"):
+            if degraded:
                 out["degraded"] = True
                 C_REQUESTS.labels("trace_attributes_batch", "degraded").inc()
             else:
@@ -1050,30 +1082,30 @@ class ReporterService:
             return 200, out
         except Overloaded as e:
             span.fail(e, status="shed")
-            obs_flight.record(span)
+            self._terminal("trace_attributes_batch", 429, span)
             C_REQUESTS.labels("trace_attributes_batch", "shed").inc()
             return 429, {"error": str(e),
                          "retry_after": batcher.retry_after_s()}
         except DeadlineExpired as e:
             span.fail(e, status="expired")
-            obs_flight.record(span)
+            self._terminal("trace_attributes_batch", 504, span)
             C_REQUESTS.labels("trace_attributes_batch", "expired").inc()
             return 504, {"error": str(e)}
         except TraceQuarantined as e:
             span.fail(e, status="quarantined")
-            obs_flight.record(span)
+            self._terminal("trace_attributes_batch", 422, span)
             C_REQUESTS.labels("trace_attributes_batch", "quarantined").inc()
             return 422, {"error": str(e)}
         except (DeviceWedged, BatcherCrashed) as e:
             span.fail(e, status="unavailable")
-            obs_flight.record(span)
+            self._terminal("trace_attributes_batch", 503, span)
             self._count(ok=False)
             C_REQUESTS.labels("trace_attributes_batch", "error").inc()
             return 503, {"error": str(e), "retry_after": 1}
         except Exception as e:
             log.exception("batch failed")
             span.fail(e)
-            obs_flight.record(span)
+            self._terminal("trace_attributes_batch", 500, span)
             self._count(ok=False)
             C_REQUESTS.labels("trace_attributes_batch", "error").inc()
             return 500, {"error": str(e)}
@@ -1114,6 +1146,9 @@ class ReporterService:
             "batch_fill_buckets": list(obs.BATCH_FILL_BUCKETS),
             "flight": obs_flight.RECORDER.summary(),
             "attrib": obs_attrib.summary(),
+            # the burn-rate line: per-objective value/target/burn/budget
+            # so an on-call eye catches a fast burn without /debug/slo
+            "slo": obs_slo.engine().summary(),
             "metrics": obs.REGISTRY.snapshot(),
         }
 
@@ -1128,6 +1163,22 @@ class ReporterService:
         rec = obs_flight.RECORDER
         n = max(1, min(n, 2 * rec.capacity))
         return 200, {"summary": rec.summary(), "traces": rec.snapshot(n)}
+
+    def handle_slo(self, query: dict) -> Tuple[int, dict]:
+        """GET /debug/slo[?window=S] — the SLO engine's full verdict:
+        every objective's current value vs target, multi-window burn
+        rates, remaining error budget, per-route traffic/quantiles, and
+        the retained SLO-violating trace_ids.  ``window`` narrows the
+        aggregation window (clamped to the engine's maximum) so a load
+        run can ask about exactly its own duration."""
+        window = None
+        raw = query.get("window", [None])[0]
+        if raw is not None:
+            try:
+                window = max(1.0, float(raw))
+            except (TypeError, ValueError):
+                return 400, {"error": "window must be a number (seconds)"}
+        return 200, obs_slo.engine().report(window_s=window)
 
     def handle_profile(self, query: dict) -> Tuple[int, dict]:
         """GET /debug/profile?seconds=N — record a jax.profiler trace to a
@@ -1324,6 +1375,9 @@ class ReporterService:
                     if action == "traces":  # GET /debug/traces?n=K
                         self._drain_body(post)
                         return self._answer(*service.handle_traces(query))
+                    if action == "slo":  # GET /debug/slo?window=S
+                        self._drain_body(post)
+                        return self._answer(*service.handle_slo(query))
                     if post:
                         n = self._content_length()
                         if n is None:  # malformed header: framing unknown

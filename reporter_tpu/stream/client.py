@@ -16,18 +16,37 @@ from __future__ import annotations
 
 import json
 import logging
+import time as _time
 import urllib.error
 import urllib.request
 from typing import List, Optional, Sequence
 
 from .. import faults
+from ..obs import metrics as obs
 from ..obs import trace as obs_trace
+from ..obs.quantile import SLO_BUCKETS_S
 from ..utils import retry
 
 log = logging.getLogger(__name__)
 
 RETRIES = retry.RETRIES
 TIMEOUT_SEC = retry.BUDGET_S
+
+# the CLIENT side of the serving SLO (docs/observability.md "The SLO
+# engine"): what the streaming tier actually experienced per matcher
+# call — whole retry cycle included — on the same shared bucket axis as
+# reporter_slo_latency_seconds, so a server-side p99 that looks healthy
+# while clients burn their retry budgets is visible as the gap between
+# the two families
+H_CLIENT = obs.histogram(
+    "reporter_client_request_seconds",
+    "Stream-client matcher call latency (full retry cycle) per target",
+    ("target",), buckets=SLO_BUCKETS_S)
+C_CLIENT_RESP = obs.counter(
+    "reporter_client_responses_total",
+    "Stream-client matcher call outcomes by target and final status "
+    "(HTTP code, or 'error' for transport failure after retries)",
+    ("target", "status"))
 
 
 def _post_json(url: str, payload: dict, timeout: float = TIMEOUT_SEC) -> Optional[dict]:
@@ -58,10 +77,15 @@ def _post_json(url: str, payload: dict, timeout: float = TIMEOUT_SEC) -> Optiona
     # the reference contract (HttpClient.java:80-88): 3 tries on a ~10 s
     # total budget, exponential backoff + full jitter, Retry-After honoured
     # on the serve tier's 429/503 shed responses, 4xx never retried
+    t0 = _time.monotonic()
+    status = "error"
     try:
-        return retry.call_with_retries(_do, target="matcher",
-                                       budget_s=timeout)
+        out = retry.call_with_retries(_do, target="matcher",
+                                      budget_s=timeout)
+        status = "200"
+        return out
     except urllib.error.HTTPError as e:
+        status = str(e.code)
         if 400 <= e.code < 500 and e.code != 429:
             log.error("matcher rejected request (trace %s): %s", trace_id, e)
         else:
@@ -72,6 +96,10 @@ def _post_json(url: str, payload: dict, timeout: float = TIMEOUT_SEC) -> Optiona
         log.error("matcher unreachable after %d attempts (trace %s): %s",
                   RETRIES, trace_id, e)
         return None
+    finally:
+        H_CLIENT.labels("matcher").observe(
+            _time.monotonic() - t0, exemplar=trace_id)
+        C_CLIENT_RESP.labels("matcher", status).inc()
 
 
 class HttpMatcherClient:

@@ -1,0 +1,148 @@
+#!/usr/bin/env bash
+# SLO gating rehearsal (the CI `slo-rehearsal` leg; runnable locally):
+# tools/loadgen.py drives the REAL served pipeline open-loop against the
+# stated objectives, in two legs:
+#
+#   1. no-fault: a warmed server must MEET the objectives, and the
+#      server's GET /debug/slo verdict must AGREE with loadgen's
+#      client-side verdict (loadgen --server-slo exits nonzero on either
+#      violation or disagreement).  The artifact must also pass
+#      tools/perf_gate.py (schema-complete, like-provenance aware).
+#
+#   2. injected device_hang (faults.py): the SAME load must VIOLATE the
+#      objectives (loadgen rc != 0), and the reported p99 must be
+#      demonstrably degraded vs leg 1 — proving coordinated omission is
+#      not flattening the tail: latencies are measured against the
+#      SCHEDULED send time, so the stall's backlog is in the number even
+#      though each post-stall response returns quickly.
+#
+# Objectives are stated ONCE and identically on both sides: the server
+# config's "slo" block and loadgen's --slo-* flags (availability 0.95,
+# p99 <= 8000 ms — modest CPU-scale targets; the TPU deployment tightens
+# them via the same knobs).
+#
+# Usage: tests/slo_rehearsal.sh [workdir]
+set -euo pipefail
+
+cd "$(dirname "$0")/.."
+export PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}"
+export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+
+WORK="${1:-$(mktemp -d /tmp/reporter-slo.XXXXXX)}"
+mkdir -p "$WORK"
+PORT=18061
+PORT2=18062
+echo "slo rehearsal workdir: $WORK"
+
+# one length bucket (every loadgen window is 16 points) keeps the warmup
+# grid small enough that --warmup boots in CI time
+cat > "$WORK/config.json" <<EOF
+{
+  "network": {"type": "grid", "rows": 8, "cols": 8, "spacing_m": 200},
+  "matcher": {"sigma_z": 4.07, "beta": 3.0, "search_radius": 50.0,
+              "length_buckets": [16]},
+  "backend": "jax",
+  "batch": {"max_batch": 64, "max_wait_ms": 5},
+  "slo": {"window_s": 120, "availability": 0.95,
+          "latency": {"*": {"p99_ms": 8000}}}
+}
+EOF
+
+LOADGEN_ARGS=(
+    --rate 15 --duration 6 --vehicles 12 --points 32 --window 16 --grid 8
+    --seed 7 --concurrency 24 --timeout-s 8
+    --slo-availability 0.95 --slo-p99-ms 8000
+)
+
+wait_up() {
+    local port=$1 tries=$2
+    for _ in $(seq 1 "$tries"); do
+        python - <<EOF && return 0 || sleep 1
+import json, sys, urllib.request
+try:
+    h = json.load(urllib.request.urlopen(
+        "http://127.0.0.1:$port/health", timeout=2))
+except Exception:
+    sys.exit(1)
+sys.exit(0 if h.get("status") == "ok" and h.get("backend") else 1)
+EOF
+    done
+    return 1
+}
+
+# ---- leg 1: no fault — objectives hold, verdicts agree -------------------
+echo "== leg 1: no-fault (warmed serve, verdicts must agree) =="
+python -m reporter_tpu.serve --warmup "$WORK/config.json" "127.0.0.1:$PORT" \
+    > "$WORK/serve_nofault.log" 2>&1 &
+SERVE_PID=$!
+trap 'kill $SERVE_PID 2>/dev/null || true' EXIT
+if ! wait_up "$PORT" 240; then
+    echo "FAIL: no-fault service never came up; tail of serve log:"
+    tail -20 "$WORK/serve_nofault.log"
+    exit 1
+fi
+
+python tools/loadgen.py --url "http://127.0.0.1:$PORT" \
+    "${LOADGEN_ARGS[@]}" --server-slo \
+    --out "$WORK/loadgen_nofault.json"
+echo "no-fault leg: objectives met, client and server verdicts agree"
+
+# the artifact is consumable by the perf gate (schema + provenance rules)
+python tools/perf_gate.py BENCH_r0*.json \
+    --fresh "$WORK/loadgen_nofault.json" \
+    > "$WORK/perf_gate_loadgen.json"
+echo "loadgen artifact accepted by tools/perf_gate.py"
+
+kill "$SERVE_PID" 2>/dev/null || true
+wait "$SERVE_PID" 2>/dev/null || true
+
+# ---- leg 2: device_hang — the tail must show, the gate must trip ---------
+echo "== leg 2: injected device_hang (tail must be visible, SLO must fail) =="
+REPORTER_FAULT_DEVICE_HANG="2.5" \
+python -m reporter_tpu.serve "$WORK/config.json" "127.0.0.1:$PORT2" \
+    > "$WORK/serve_hang.log" 2>&1 &
+SERVE_PID=$!
+if ! wait_up "$PORT2" 240; then
+    echo "FAIL: hang-leg service never came up; tail of serve log:"
+    tail -20 "$WORK/serve_hang.log"
+    exit 1
+fi
+
+set +e
+python tools/loadgen.py --url "http://127.0.0.1:$PORT2" \
+    "${LOADGEN_ARGS[@]}" \
+    --out "$WORK/loadgen_hang.json"
+HANG_RC=$?
+set -e
+if [ "$HANG_RC" -eq 0 ]; then
+    echo "FAIL: loadgen passed the SLO under an injected device hang"
+    exit 1
+fi
+if [ ! -s "$WORK/loadgen_hang.json" ]; then
+    echo "FAIL: hang leg produced no artifact (rc $HANG_RC was not a verdict)"
+    exit 1
+fi
+
+python - "$WORK" <<'EOF'
+# coordinated omission is not hiding the tail: the hang run's
+# scheduled-time p99 carries the injected stalls' backlog
+import json, sys
+
+work = sys.argv[1]
+nofault = json.load(open(work + "/loadgen_nofault.json"))
+hang = json.load(open(work + "/loadgen_hang.json"))
+p99_nofault = nofault["quantiles"]["p99_ms"]
+p99_hang = hang["quantiles"]["p99_ms"]
+gap_p99 = hang["service_time_quantiles"]["p99_ms"]
+assert p99_hang is not None and p99_nofault is not None
+floor = max(2500.0, 1.5 * p99_nofault)
+assert p99_hang >= floor, (
+    "hang p99 %.0f ms below %.0f ms: the injected 2.5 s stalls are not "
+    "in the tail — coordinated omission?" % (p99_hang, floor))
+assert hang["slo"]["client"]["ok"] is False
+print("p99 no-fault %.0f ms -> hang %.0f ms (send-to-response view: "
+      "%.0f ms); SLO verdict: violating, rc nonzero — gate works"
+      % (p99_nofault, p99_hang, gap_p99))
+EOF
+
+echo "slo rehearsal OK (artifacts in $WORK)"

@@ -178,6 +178,14 @@ def test_mesh_devices_validation():
         SegmentMatcher(arrays=arrays, ubodt=ubodt, config=MatcherConfig(devices=3))
 
 
+def _has_shard_map() -> bool:
+    import jax
+
+    return hasattr(jax, "shard_map")
+
+
+@pytest.mark.skipif(not _has_shard_map(),
+                    reason="this jax build lacks jax.shard_map")
 def test_mesh_graph_sharded_product_path(setup, matcher):
     """devices=8, graph_devices=4: the UBODT lives in 1/4 bucket-range
     slices per chip and the product match_many runs under shard_map with

@@ -13,6 +13,19 @@ import socket
 import subprocess
 import sys
 
+import pytest
+
+# capability markers the CHILD processes emit when this jax build cannot
+# run the dryrun at all (e.g. jax 0.4.3x: CPU backend without multiprocess
+# computations, no jax.shard_map): the dryrun is then unrunnable in THIS
+# environment, not broken — skip, the same green-or-skip posture as
+# test_parallel.py's shard_map guard.  Any other failure (wrong result,
+# crash, rendezvous hang) still fails.
+_ENV_UNSUPPORTED_MARKERS = (
+    "Multiprocess computations aren't implemented on the CPU backend",
+    "module 'jax' has no attribute 'shard_map'",
+)
+
 
 def _free_port() -> int:
     s = socket.socket()
@@ -60,6 +73,11 @@ def _run_dryrun_procs(extra_args=()):
                 p.kill()
                 p.communicate(timeout=30)
     for pid, (p, out) in enumerate(zip(procs, outs)):
+        if p.returncode != 0:
+            for marker in _ENV_UNSUPPORTED_MARKERS:
+                if marker in out:
+                    pytest.skip("this jax build cannot run the "
+                                "multi-controller dryrun: %s" % marker)
         assert p.returncode == 0, "process %d failed:\n%s" % (pid, out[-2000:])
     lines = [
         next(ln for ln in out.splitlines() if ln.startswith("multihost dryrun ok"))

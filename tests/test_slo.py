@@ -1,0 +1,465 @@
+"""SLO engine: classification policy, shared quantile math, sliding-window
+burn-rate/error-budget arithmetic (roll-off, exhaustion, multi-window
+AND-gating), and the HTTP surfaces (/debug/slo, /statusz burn line,
+reporter_slo_* families, flight-recorder retention of violating ids)."""
+
+import json
+import random
+import threading
+import urllib.error
+import urllib.request
+
+import pytest
+
+from reporter_tpu.obs import metrics as obs_metrics
+from reporter_tpu.obs import slo
+from reporter_tpu.obs.quantile import (
+    SLO_BUCKETS_S,
+    bucket_index,
+    cumulate,
+    hist_buckets,
+    hist_quantile,
+    log_bucket_bounds,
+    parse_metrics,
+)
+
+
+# -- classification policy (the documented budget table) --------------------
+
+def test_classify_policy_table():
+    # success never burns; degraded stays good for availability
+    assert slo.classify(200) == slo.GOOD
+    assert slo.classify(200, degraded=True) == slo.GOOD
+    # server-attributable failures burn budget — INCLUDING shed 429s
+    # (an SLO that excluded sheds could be met by shedding everything)
+    for code in (429, 500, 503, 504, 502):
+        assert slo.classify(code) == slo.BAD, code
+    # client faults are excluded: they are not the server's to answer for
+    for code in (400, 404, 422):
+        assert slo.classify(code) == slo.EXCLUDED, code
+
+
+# -- shared quantile math (Prometheus semantics, pinned) --------------------
+
+def test_hist_quantile_prometheus_semantics():
+    buckets = [(0.01, 10.0), (0.1, 90.0), (float("inf"), 100.0)]
+    # p50 lands mid second bucket: 0.01 + (50-10)/(90-10)*0.09 = 0.055
+    assert hist_quantile(buckets, 0.50) == pytest.approx(0.055)
+    # +Inf landing clamps to the last finite bound
+    assert hist_quantile(buckets, 0.999) == pytest.approx(0.1)
+    assert hist_quantile([], 0.5) is None
+    assert hist_quantile([(1.0, 0.0), (float("inf"), 0.0)], 0.5) is None
+
+
+def test_log_buckets_and_bucket_index_match_registry_histogram():
+    bounds = log_bucket_bounds(0.001, 100.0, 12)
+    assert bounds == SLO_BUCKETS_S
+    # adjacent ratio is one twelfth of a decade
+    for a, b in zip(bounds, bounds[1:]):
+        assert b / a == pytest.approx(10 ** (1 / 12), rel=1e-6)
+    # bucket_index lands every observation in the SAME slot the registry
+    # Histogram uses (bisect_left: equality lands IN the bound's bucket)
+    h = obs_metrics.Histogram(buckets=bounds)
+    rng = random.Random(7)
+    vals = [rng.uniform(0.0005, 120.0) for _ in range(500)] + [bounds[3]]
+    counts = [0] * (len(bounds) + 1)
+    for v in vals:
+        h.observe(v)
+        counts[bucket_index(bounds, v)] += 1
+    assert counts == h._sample()["counts"]
+    # and cumulate() is exactly the cumulative form hist_quantile eats
+    cum = cumulate(bounds, counts)
+    assert cum[-1] == (float("inf"), len(vals))
+    assert all(b >= a for (_l1, a), (_l2, b) in zip(cum, cum[1:]))
+
+
+def test_one_quantile_implementation_across_surfaces():
+    """The engine's windowed quantile, and a /metrics-scrape-side quantile
+    computed from the rendered text exposition, agree exactly — shared
+    bucket table, shared interpolation rule."""
+    rng = random.Random(3)
+    lats = [rng.expovariate(5.0) + 0.002 for _ in range(400)]
+    eng = slo.SLOEngine([], window_s=600, instrument=False,
+                        clock=lambda: 100.0)
+    reg = obs_metrics.Registry()
+    fam = reg.histogram("t_slo_seconds", "t", ("route",),
+                        buckets=SLO_BUCKETS_S)
+    for v in lats:
+        eng.observe("report", 200, v, now=100.0)
+        fam.labels("report").observe(v)
+    scraped = parse_metrics(reg.render())
+    for q in (0.5, 0.95, 0.99, 0.999):
+        server_side = eng.window(600, now=100.0).quantile(q, "report")
+        scrape_side = hist_quantile(
+            hist_buckets(scraped, "t_slo_seconds", match={"route": "report"}), q)
+        assert server_side == pytest.approx(scrape_side, rel=1e-9)
+
+
+# -- burn-rate / error-budget arithmetic ------------------------------------
+
+def _eng(objectives, **kw):
+    kw.setdefault("instrument", False)
+    return slo.SLOEngine(objectives, **kw)
+
+
+def test_window_roll_off():
+    clock = {"t": 0.0}
+    o = slo.Objective("availability", "availability", 0.9)
+    eng = _eng([o], window_s=60, clock=lambda: clock["t"])
+    for i in range(10):
+        eng.observe("report", 500 if i < 5 else 200, 0.01, now=float(i))
+    assert eng.burn_rate(o, 60, now=10.0) == pytest.approx(5.0)
+    # the bad burst ages out of the trailing window: burn returns to 0
+    clock["t"] = 80.0
+    assert eng.burn_rate(o, 60, now=80.0) == 0.0
+    agg = eng.window(60, now=80.0)
+    assert agg.eligible() == 0
+    # and an idle engine burns nothing (vacuously compliant, ok verdict)
+    rep = eng.report(now=200.0)
+    assert rep["ok"] and rep["objectives"][0]["value"] is None
+
+
+def test_budget_exhaustion_boundary():
+    o = slo.Objective("availability", "availability", 0.99)
+    eng = _eng([o], window_s=300, clock=lambda: 100.0)
+    for i in range(990):
+        eng.observe("report", 200, 0.01, now=100.0)
+    for _ in range(10):
+        eng.observe("report", 504, 0.01, now=100.0)
+    # exactly the budget: burn 1.0, nothing left, still (boundary) ok
+    assert eng.burn_rate(o, 300, now=100.0) == pytest.approx(1.0)
+    st = eng.report(now=100.0)["objectives"][0]
+    assert st["budget_remaining"] == pytest.approx(0.0)
+    assert st["ok"] and st["value"] == pytest.approx(0.99)
+    # one more bad request: over budget, objective violated
+    eng.observe("report", 500, 0.01, now=100.0)
+    st = eng.report(now=100.0)["objectives"][0]
+    assert not st["ok"] and st["budget_remaining"] == 0.0
+
+
+def test_excluded_outcomes_never_burn():
+    o = slo.Objective("availability", "availability", 0.99)
+    eng = _eng([o], window_s=60, clock=lambda: 10.0)
+    eng.observe("report", 200, 0.01, now=10.0)
+    for _ in range(50):
+        eng.observe("report", 400, 0.001, now=10.0)
+        eng.observe("report", 422, 0.001, now=10.0)
+    assert eng.burn_rate(o, 60, now=10.0) == 0.0
+    rep = eng.report(now=10.0)
+    assert rep["ok"]
+    assert rep["routes"]["report"]["excluded"] == 100
+    # excluded latencies never pollute the quantiles (they'd all be 1ms)
+    assert rep["routes"]["report"]["p99_ms"] == pytest.approx(10.0, rel=0.3)
+
+
+def test_multi_window_and_gating():
+    clock = {"t": 0.0}
+    o = slo.Objective("availability", "availability", 0.9)
+    eng = _eng([o], window_s=100, burn_pairs=((10.0, 100.0, 2.0),),
+               clock=lambda: clock["t"])
+
+    def alerting(now):
+        clock["t"] = now
+        return eng.report(now=now)["objectives"][0]["alerting"]
+
+    # 90 s of clean traffic, then a sharp 5-bad burst
+    for t in range(90):
+        eng.observe("report", 200, 0.01, now=float(t))
+    for t in range(90, 95):
+        eng.observe("report", 500, 0.01, now=float(t))
+        eng.observe("report", 200, 0.01, now=float(t))
+    # short window burns hot, long window still inside budget: the AND
+    # gate holds fire (a burst alone must not page)
+    assert eng.burn_rate(o, 10, now=95.0) > 2.0
+    assert eng.burn_rate(o, 100, now=95.0) < 2.0
+    assert not alerting(95.0)
+    # the burn persists: long window crosses the factor too -> page
+    t = 95.0
+    while t < 140.0 and not alerting(t):
+        eng.observe("report", 500, 0.01, now=t)
+        t += 1.0
+    assert alerting(t), "sustained burn never tripped the AND gate"
+    assert eng.burn_rate(o, 100, now=t) > 2.0
+    # problem stops: the short window drains first and the gate re-opens
+    # even while the long window still remembers the incident
+    quiet = t + 12.0
+    clock["t"] = quiet
+    assert eng.burn_rate(o, 10, now=quiet) == 0.0
+    assert eng.burn_rate(o, 100, now=quiet) > 2.0
+    assert not alerting(quiet)
+
+
+def test_burn_budget_invariants_random_traffic():
+    """Property sweep: whatever the traffic mix, burn rates are
+    non-negative, budget remaining stays in [0, 1], availability value
+    stays in [0, 1], and report() always renders."""
+    for seed in range(8):
+        rng = random.Random(seed)
+        objectives = [
+            slo.Objective("availability", "availability",
+                          rng.choice([0.9, 0.99, 0.999])),
+            slo.Objective("p99_latency", "latency",
+                          rng.choice([0.05, 0.5, 2.0]), quantile=0.99),
+            slo.Objective("degraded_fraction", "degraded_fraction",
+                          rng.choice([0.05, 0.25])),
+        ]
+        eng = _eng(objectives, window_s=rng.choice([30, 120]),
+                   clock=lambda: 0.0)
+        t = 0.0
+        for _ in range(rng.randrange(0, 400)):
+            t += rng.expovariate(20.0)
+            code = rng.choice([200, 200, 200, 200, 400, 422, 429, 500,
+                               503, 504])
+            eng.observe("report", code, rng.expovariate(10.0),
+                        degraded=(code == 200 and rng.random() < 0.2),
+                        now=t)
+        rep = eng.report(now=t)
+        for st in rep["objectives"]:
+            assert 0.0 <= st["budget_remaining"] <= 1.0
+            for rate in st["burn"].values():
+                assert rate >= 0.0
+            if st["kind"] == "availability" and st["value"] is not None:
+                assert 0.0 <= st["value"] <= 1.0
+        assert rep["verdict"] in ("ok", "violating")
+        assert rep["ok"] == all(s["ok"] for s in rep["objectives"])
+
+
+def test_latency_objective_and_violating_ring():
+    o = slo.Objective("p99_latency", "latency", 0.1, quantile=0.99)
+    eng = _eng([o], window_s=60, clock=lambda: 5.0, ring=4)
+    for i in range(20):
+        hit = eng.observe("report", 200, 0.01, now=5.0,
+                          trace_id="fast-%d" % i)
+        assert hit == []  # compliant traffic is never retained
+    hit = eng.observe("report", 200, 0.5, now=5.0, trace_id="slow-1")
+    assert hit == ["p99_latency"]  # a tail contributor over the target
+    st = eng.report(now=5.0)["objectives"][0]
+    assert st["value"] > 0.1 and not st["ok"]  # p99 blown by the outlier
+    ring = eng.report(now=5.0)["violating_traces"]
+    assert [v["trace_id"] for v in ring] == ["slow-1"]
+    # the ring is bounded: only the newest `ring` entries survive
+    for i in range(10):
+        eng.observe("report", 200, 0.2, now=5.0, trace_id="bad-%d" % i)
+    ring = eng.report(now=5.0)["violating_traces"]
+    assert len(ring) == 4
+    assert [v["trace_id"] for v in ring] == ["bad-%d" % i for i in range(6, 10)]
+
+
+def test_degraded_fraction_objective():
+    o = slo.Objective("degraded_fraction", "degraded_fraction", 0.25)
+    eng = _eng([o], window_s=60, clock=lambda: 1.0)
+    for i in range(8):
+        eng.observe("report", 200, 0.01, degraded=(i < 2), now=1.0)
+    st = eng.report(now=1.0)["objectives"][0]
+    assert st["value"] == pytest.approx(0.25) and st["ok"]
+    eng.observe("report", 200, 0.01, degraded=True, now=1.0)
+    st = eng.report(now=1.0)["objectives"][0]
+    assert st["value"] > 0.25 and not st["ok"]
+
+
+def test_route_scoped_objective_ignores_other_routes():
+    o = slo.Objective("report_p99", "latency", 0.1, route="report",
+                      quantile=0.99)
+    eng = _eng([o], window_s=60, clock=lambda: 1.0)
+    for _ in range(10):
+        eng.observe("trace_attributes_batch", 200, 5.0, now=1.0)
+        eng.observe("report", 200, 0.01, now=1.0)
+    st = eng.report(now=1.0)["objectives"][0]
+    assert st["ok"] and st["value"] < 0.1
+
+
+# -- spec / env configuration ----------------------------------------------
+
+def test_objectives_from_spec():
+    objs = slo.objectives_from_spec({
+        "availability": 0.999,
+        "latency": {"report": {"p99_ms": 100, "p999_ms": 400},
+                    "*": {"p95_ms": 50}},
+        "degraded_fraction": 0.1,
+    })
+    by_name = {o.name: o for o in objs}
+    assert by_name["availability"].target == 0.999
+    assert by_name["report_p99"].route == "report"
+    assert by_name["report_p99"].target == pytest.approx(0.1)
+    assert by_name["report_p99"].quantile == pytest.approx(0.99)
+    assert by_name["report_p999"].quantile == pytest.approx(0.999)
+    assert by_name["p95_latency"].route is None
+    assert by_name["degraded_fraction"].target == pytest.approx(0.1)
+    with pytest.raises(ValueError, match="p<q>_ms"):
+        slo.objectives_from_spec({"latency": {"report": {"p99": 100}}})
+
+
+def test_default_objectives_env_overrides(monkeypatch):
+    monkeypatch.setenv("REPORTER_SLO_AVAILABILITY", "0")   # dropped
+    monkeypatch.setenv("REPORTER_SLO_P99_MS", "150")
+    monkeypatch.setenv("REPORTER_SLO_P999_MS", "0")        # dropped
+    monkeypatch.setenv("REPORTER_SLO_DEGRADED_FRAC", "0.5")
+    objs = slo.default_objectives()
+    by_name = {o.name: o for o in objs}
+    assert set(by_name) == {"p99_latency", "degraded_fraction"}
+    assert by_name["p99_latency"].target == pytest.approx(0.15)
+    assert by_name["degraded_fraction"].target == pytest.approx(0.5)
+
+
+def test_objective_validation():
+    with pytest.raises(ValueError, match="unknown objective kind"):
+        slo.Objective("x", "throughput", 1.0)
+    with pytest.raises(ValueError, match="quantile"):
+        slo.Objective("x", "latency", 1.0, quantile=1.5)
+
+
+# -- HTTP surfaces ----------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def slo_service():
+    import numpy as np
+
+    from reporter_tpu.matching import MatcherConfig, SegmentMatcher
+    from reporter_tpu.serve import ReporterService
+    from reporter_tpu.tiles.arrays import build_graph_arrays
+    from reporter_tpu.tiles.network import grid_city
+    from reporter_tpu.tiles.ubodt import build_ubodt
+
+    city = grid_city(rows=5, cols=5, spacing_m=150.0)
+    arrays = build_graph_arrays(city, cell_size=100.0)
+    ubodt = build_ubodt(arrays, delta=2000.0)
+    matcher = SegmentMatcher(arrays=arrays, ubodt=ubodt,
+                             config=MatcherConfig())
+    # generous objectives: the no-fault requests must pass them on any
+    # CI machine; a later test tightens the engine via configure()
+    service = ReporterService(matcher, max_wait_ms=5.0, slo={
+        "window_s": 120, "availability": 0.5,
+        "latency": {"*": {"p99_ms": 60000}},
+    })
+    httpd = service.make_server("127.0.0.1", 0)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+
+    def trace(row=2, n=8, t0=1000):
+        nodes = [row * 5 + c for c in range(5)]
+        t = np.linspace(0.05, 0.9, n)
+        xs = np.interp(t, np.linspace(0, 1, 5), arrays.node_x[nodes])
+        ys = np.interp(t, np.linspace(0, 1, 5), arrays.node_y[nodes])
+        lat, lon = arrays.proj.to_latlon(xs, ys)
+        return {
+            "uuid": "veh-slo",
+            "trace": [{"lat": float(a), "lon": float(o), "time": t0 + 15 * i}
+                      for i, (a, o) in enumerate(zip(lat, lon))],
+            "match_options": {"mode": "auto", "report_levels": [0, 1],
+                              "transition_levels": [0, 1]},
+        }
+
+    yield "http://127.0.0.1:%d" % httpd.server_port, trace
+    httpd.shutdown()
+    slo.configure(None)  # restore the env-default engine for other tests
+
+
+def _get(url):
+    try:
+        with urllib.request.urlopen(url, timeout=30) as r:
+            return r.status, json.loads(r.read().decode())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read().decode())
+
+
+def _post(url, payload):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=60) as r:
+            return r.status, json.loads(r.read().decode())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read().decode())
+
+
+def test_debug_slo_endpoint_counts_terminal_outcomes(slo_service):
+    url, trace = slo_service
+    code, _ = _post(url + "/report", trace())
+    assert code == 200
+    code, _ = _post(url + "/report", {"trace": []})  # invalid: excluded
+    assert code == 400
+    code, rep = _get(url + "/debug/slo")
+    assert code == 200
+    assert rep["verdict"] == "ok" and rep["ok"]
+    r = rep["routes"]["report"]
+    assert r["good"] >= 1 and r["excluded"] >= 1 and r["bad"] == 0
+    assert r["p99_ms"] is not None and r["p99_ms"] > 0
+    names = {o["name"] for o in rep["objectives"]}
+    assert names == {"availability", "p99_latency"}
+    for o in rep["objectives"]:
+        assert "burn" in o and "budget_remaining" in o
+    # window clamp + validation
+    code, rep2 = _get(url + "/debug/slo?window=30")
+    assert code == 200 and rep2["window_s"] == 30.0
+    code, err = _get(url + "/debug/slo?window=bogus")
+    assert code == 400
+
+
+def test_statusz_burn_line_and_slo_metric_families(slo_service):
+    url, trace = slo_service
+    _post(url + "/report", trace())
+    code, z = _get(url + "/statusz")
+    assert code == 200
+    line = z["slo"]
+    assert line["ok"] is True
+    assert set(line["objectives"]) == {"availability", "p99_latency"}
+    for st in line["objectives"].values():
+        assert "burn" in st and "budget_remaining" in st
+    with urllib.request.urlopen(url + "/metrics", timeout=30) as r:
+        text = r.read().decode()
+    m = parse_metrics(text)
+    assert m["reporter_slo_ok"][()] == 1.0
+    assert m["reporter_slo_requests_total"][
+        (("route", "report"), ("slo_class", "good"))] >= 1
+    assert any(k == (("route", "report"),)
+               for k in m.get("reporter_slo_latency_seconds_count", {}))
+    assert (("objective", "availability"),) in m["reporter_slo_error_budget_remaining"]
+    assert any(dict(k).get("objective") == "p99_latency"
+               for k in m["reporter_slo_burn_rate"])
+
+
+def test_slo_violation_retained_in_flight_recorder(slo_service):
+    url, trace = slo_service
+    # tighten the LIVE engine: a 1 us p99 target makes every 200 a tail
+    # contributor, so the span must be kept by the flight recorder with
+    # the "slo" decision and its id must land in the violating ring
+    slo.configure({"window_s": 120, "latency": {"*": {"p99_ms": 0.001}}})
+    try:
+        code, _ = _post(url + "/report?debug=1", trace())
+        assert code == 200
+        code, rep = _get(url + "/debug/slo")
+        assert rep["verdict"] == "violating"
+        ring = rep["violating_traces"]
+        assert ring and ring[-1]["objectives"] == ["p99_latency"]
+        tid = ring[-1]["trace_id"]
+        code, traces = _get(url + "/debug/traces?n=50")
+        kept = {t["trace_id"]: t for t in traces["traces"]}
+        assert tid in kept and kept[tid]["retained"] == "slo"
+        with urllib.request.urlopen(url + "/metrics", timeout=30) as r:
+            m = parse_metrics(r.read().decode())
+        assert m["reporter_flight_traces_total"][
+            (("decision", "slo"),)] >= 1
+        assert m["reporter_slo_ok"][()] == 0.0
+    finally:
+        slo.configure({"window_s": 120, "availability": 0.5,
+                       "latency": {"*": {"p99_ms": 60000}}})
+
+
+def test_bad_outcomes_burn_on_the_http_surface(slo_service):
+    url, trace = slo_service
+    before = slo.engine().window(120).n(slo.BAD, "report")
+    # an unknown uuid-less body is 400 (excluded); force a real bad via
+    # the batch route's initialising path instead: not available here, so
+    # use a malformed-but-parsed body that fails in report (500) — a
+    # missing trace time blows up the matcher's validation downstream
+    t = trace()
+    t["trace"] = [{"lat": 0.0, "lon": 0.0}, {"lat": 0.0, "lon": 0.0}]
+    code, _ = _post(url + "/report", t)
+    if code == 200:  # matcher tolerated it: nothing to assert against
+        pytest.skip("matcher tolerated the malformed trace")
+    assert code in (400, 500)
+    after = slo.engine().window(120).n(slo.BAD, "report")
+    if code == 500:
+        assert after == before + 1
+    else:
+        assert after == before  # excluded, not burned

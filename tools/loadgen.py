@@ -1,0 +1,547 @@
+#!/usr/bin/env python3
+"""Coordinated-omission-free open-loop traffic-replay load generator.
+
+Drives the real HTTP matching service the way a probe firehose does —
+requests arrive on a SCHEDULE (Poisson by default), not when the previous
+response happens to return — and measures every latency against the
+*scheduled* send time.  That is the whole point: a closed-loop client
+that waits for responses before sending again silently stops load exactly
+when the server stalls, so a wedged device step (``faults.py``
+device_hang) barely moves its "p99".  Here a stall backs the schedule up,
+and every delayed request records the backlog it actually suffered
+(tests/test_loadgen.py pins this against an injected hang).
+
+Traffic is per-vehicle sessions with uuid affinity: a synthesized fleet
+(``--vehicles/--points/--window``, no accelerator needed) or a
+``make_requests.py``-style probe archive (``--archive``, same column
+flags) grouped by uuid, windowed in timestamp order, optionally
+replayed on its own recorded timeline compressed ``--time-warp``-fold.
+
+Verdicts come from the SAME implementation the server uses: the
+client-side samples feed a ``reporter_tpu.obs.slo.SLOEngine`` (shared
+classification policy, shared log-bucket quantile math), and with
+``--server-slo`` the server's ``GET /debug/slo`` verdict is fetched and
+must AGREE with the client's — exiting nonzero on violation or
+disagreement, which is what makes the CI ``slo-rehearsal`` leg gating.
+
+One JSON artifact (stdout or ``--out``): schedule + achieved rate,
+status breakdown, p50/p95/p99/p99.9, per-step ramp table and knee,
+client + server SLO verdicts.  Schema-complete for tools/perf_gate.py
+(metric/value/unit/platform + attrib/last_onchip keys).
+
+Usage (synth fleet, 30 req/s for 10 s):
+    python tools/loadgen.py --url http://localhost:8002 \
+        --rate 30 --duration 10 --vehicles 16 --points 24 --window 8 \
+        --slo-availability 0.99 --slo-p99-ms 2500 --server-slo
+
+Ramp to find the knee (5 steps, 10 -> 200 req/s):
+    python tools/loadgen.py --url ... --ramp 10:200:5 --duration 5
+
+Exit codes: 0 = objectives met (and server agrees, with --server-slo),
+1 = SLO violated or verdicts disagree, 2 = setup/infra error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+from typing import Dict, List, Optional, Tuple
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from reporter_tpu.obs.quantile import SLO_BUCKETS_S, bucket_index, cumulate, hist_quantile  # noqa: E402
+from reporter_tpu.obs.slo import Objective, SLOEngine  # noqa: E402
+
+MATCH_OPTIONS = {"mode": "auto", "report_levels": [0, 1],
+                 "transition_levels": [0, 1]}
+
+
+# -- request corpus ---------------------------------------------------------
+
+def synth_sessions(vehicles: int, points: int, window: int, grid: int,
+                   seed: int) -> List[Tuple[str, List[dict]]]:
+    """Per-vehicle sessions from the in-repo synthesizer (numpy only — no
+    accelerator): each vehicle is one route walk, windowed into
+    ``window``-point /report bodies in drive order."""
+    from reporter_tpu.synth import TraceSynthesizer
+    from reporter_tpu.tiles.arrays import build_graph_arrays
+    from reporter_tpu.tiles.network import grid_city
+
+    city = grid_city(rows=grid, cols=grid, spacing_m=200.0)
+    arrays = build_graph_arrays(city, cell_size=100.0)
+    synth = TraceSynthesizer(arrays, seed=seed)
+    sessions = []
+    for i, s in enumerate(synth.batch(vehicles, points, dt=5.0, sigma=5.0)):
+        uuid = "loadgen-veh-%04d" % i
+        pts = s.trace["trace"]
+        reqs = []
+        for j in range(0, len(pts), window):
+            chunk = pts[j:j + window]
+            if len(chunk) < 2:
+                break
+            reqs.append({"uuid": uuid, "trace": chunk,
+                         "match_options": dict(MATCH_OPTIONS)})
+        if reqs:
+            sessions.append((uuid, reqs))
+    return sessions
+
+
+def archive_sessions(src: str, sep: str, uuid_col: int, time_col: int,
+                     lat_col: int, lon_col: int, window: int,
+                     limit: int = 0) -> List[Tuple[str, List[dict]]]:
+    """make_requests.py-style probe rows -> per-uuid sessions in timestamp
+    order, windowed into /report bodies.  Each request carries ``_t0``:
+    the window's first original epoch, the replay-timeline anchor
+    ``--time-warp`` scales."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "make_requests", os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                      "make_requests.py"))
+    mr = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mr)
+
+    by_uuid: Dict[str, List[Tuple[float, float, float]]] = {}
+    n = 0
+    for line in mr.iter_lines(src):
+        cols = line.split(sep)
+        try:
+            t = float(cols[time_col])
+            lat = float(cols[lat_col])
+            lon = float(cols[lon_col])
+            uuid = cols[uuid_col]
+        except (IndexError, ValueError):
+            continue
+        by_uuid.setdefault(uuid, []).append((t, lat, lon))
+        n += 1
+        if limit and n >= limit:
+            break
+    sessions = []
+    for uuid in sorted(by_uuid):
+        rows = sorted(by_uuid[uuid])
+        reqs = []
+        for j in range(0, len(rows), window):
+            chunk = rows[j:j + window]
+            if len(chunk) < 2:
+                break
+            reqs.append({
+                "uuid": uuid,
+                "trace": [{"lat": la, "lon": lo, "time": int(t), "accuracy": 5}
+                          for t, la, lo in chunk],
+                "match_options": dict(MATCH_OPTIONS),
+                "_t0": chunk[0][0],
+            })
+        if reqs:
+            sessions.append((uuid, reqs))
+    return sessions
+
+
+def interleave(sessions: List[Tuple[str, List[dict]]]) -> List[dict]:
+    """Round-robin across vehicles, preserving each vehicle's window
+    order (uuid affinity: window k+1 never precedes window k)."""
+    out = []
+    k = 0
+    while True:
+        layer = [reqs[k] for _u, reqs in sessions if k < len(reqs)]
+        if not layer:
+            return out
+        out.extend(layer)
+        k += 1
+
+
+# -- schedule ---------------------------------------------------------------
+
+def build_schedule(n: int, rate: float, arrival: str,
+                   rng: random.Random) -> List[float]:
+    """Offsets (seconds from t0) for ``n`` arrivals at ``rate``/s.
+    "poisson" = exponential inter-arrivals (the open-loop firehose
+    model); "uniform" = a metronome."""
+    if rate <= 0:
+        raise ValueError("rate must be > 0")
+    out, t = [], 0.0
+    for _ in range(n):
+        t += rng.expovariate(rate) if arrival == "poisson" else 1.0 / rate
+        out.append(t)
+    return out
+
+
+def timeline_schedule(requests: List[dict], warp: float) -> List[float]:
+    """Replay the archive's own recorded timeline, compressed
+    ``warp``-fold (the time-warp rate scaling path)."""
+    t0s = [r.get("_t0") for r in requests]
+    if any(t is None for t in t0s):
+        raise ValueError("timeline replay needs archive requests (_t0)")
+    base = min(t0s)
+    sched = [(t - base) / max(warp, 1e-9) for t in t0s]
+    order = sorted(range(len(requests)), key=lambda i: sched[i])
+    requests[:] = [requests[i] for i in order]
+    return sorted(sched)
+
+
+# -- the open-loop run ------------------------------------------------------
+
+class Sample:
+    __slots__ = ("sched", "sent", "done", "code", "degraded")
+
+    def __init__(self, sched, sent, done, code, degraded):
+        self.sched = sched
+        self.sent = sent
+        self.done = done
+        self.code = code
+        self.degraded = degraded
+
+    @property
+    def latency_s(self) -> float:
+        """Against the SCHEDULED send time — the coordinated-omission-free
+        number (a late send records the backlog it suffered)."""
+        return self.done - self.sched
+
+    @property
+    def service_s(self) -> float:
+        """Send-to-response only — the flattering number a closed-loop
+        client would report; kept so the regression test can PROVE the
+        two diverge under a stall."""
+        return self.done - self.sent
+
+
+def _post(url: str, body: bytes, timeout: float) -> Tuple[int, bool]:
+    req = urllib.request.Request(
+        url, data=body, method="POST",
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            try:
+                degraded = bool(json.loads(resp.read().decode()).get("degraded"))
+            except (ValueError, UnicodeDecodeError):
+                degraded = False
+            return resp.status, degraded
+    except urllib.error.HTTPError as e:
+        e.read()
+        return e.code, False
+    except Exception:  # noqa: BLE001 - timeout/reset: code 0, still counted
+        return 0, False
+
+
+def run_load(url: str, requests: List[dict], schedule: List[float],
+             concurrency: int = 32, timeout_s: float = 10.0) -> List[Sample]:
+    """Send every request at its scheduled offset (or as soon after as a
+    worker frees up — the backlog then SHOWS in the recorded latency).
+    The whole schedule is always drained: a hung server cannot make the
+    tail disappear by never being measured."""
+    bodies = [json.dumps(r, separators=(",", ":")).encode() for r in requests]
+    samples: List[Optional[Sample]] = [None] * len(requests)
+    it = {"i": 0}
+    lock = threading.Lock()
+    t0 = time.monotonic() + 0.05  # everyone references the same epoch
+
+    def worker():
+        while True:
+            with lock:
+                i = it["i"]
+                if i >= len(bodies):
+                    return
+                it["i"] = i + 1
+            sched = t0 + schedule[i]
+            delay = sched - time.monotonic()
+            if delay > 0:
+                time.sleep(delay)
+            sent = time.monotonic()
+            code, degraded = _post(url, bodies[i], timeout_s)
+            done = time.monotonic()
+            samples[i] = Sample(sched - t0, sent - t0, done - t0,
+                                code, degraded)
+
+    threads = [threading.Thread(target=worker, daemon=True)
+               for _ in range(max(1, concurrency))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return [s for s in samples if s is not None]
+
+
+# -- evaluation -------------------------------------------------------------
+
+def quantiles_ms(lats: List[float]) -> Dict[str, Optional[float]]:
+    """Quantiles via the SHARED log-bucket table + interpolation rule —
+    the same arithmetic the server's /debug/slo runs, so the two sides
+    can only disagree about traffic, never about math."""
+    counts = [0] * (len(SLO_BUCKETS_S) + 1)
+    for v in lats:
+        counts[bucket_index(SLO_BUCKETS_S, v)] += 1
+    cum = cumulate(SLO_BUCKETS_S, counts)
+    out = {}
+    for q, key in ((0.50, "p50_ms"), (0.95, "p95_ms"),
+                   (0.99, "p99_ms"), (0.999, "p999_ms")):
+        v = hist_quantile(cum, q)
+        out[key] = round(v * 1000.0, 1) if v is not None else None
+    return out
+
+
+def objectives_from_args(args) -> List[Objective]:
+    out = []
+    if args.slo_availability > 0:
+        out.append(Objective("availability", "availability",
+                             args.slo_availability))
+    if args.slo_p99_ms > 0:
+        out.append(Objective("p99_latency", "latency",
+                             args.slo_p99_ms / 1000.0, quantile=0.99))
+    if args.slo_p999_ms > 0:
+        out.append(Objective("p999_latency", "latency",
+                             args.slo_p999_ms / 1000.0, quantile=0.999))
+    if args.slo_degraded_frac > 0:
+        out.append(Objective("degraded_fraction", "degraded_fraction",
+                             args.slo_degraded_frac))
+    return out
+
+
+def evaluate(samples: List[Sample], objectives: List[Objective],
+             window_s: float) -> dict:
+    """Client-side verdict through the REAL SLOEngine (no re-implemented
+    budget math): every sample is observed at its completion offset on
+    an injected clock, then report() renders the same objective states
+    the server would."""
+    clock = {"t": 0.0}
+    eng = SLOEngine(objectives, window_s=window_s, instrument=False,
+                    clock=lambda: clock["t"])
+    for s in sorted(samples, key=lambda x: x.done):
+        clock["t"] = s.done
+        eng.observe("report", s.code if s.code else 503, s.latency_s,
+                    degraded=s.degraded)
+    clock["t"] = max((s.done for s in samples), default=0.0)
+    return eng.report()
+
+
+def step_stats(samples: List[Sample], offered_rate: float) -> dict:
+    lats = [s.latency_s for s in samples]
+    span = (max(s.done for s in samples) - min(s.sched for s in samples)
+            if samples else 0.0)
+    codes: Dict[str, int] = {}
+    for s in samples:
+        k = str(s.code) if s.code else "timeout"
+        codes[k] = codes.get(k, 0) + 1
+    return {
+        "n": len(samples),
+        "offered_rps": round(offered_rate, 3),
+        "achieved_rps": round(len(samples) / span, 3) if span > 0 else None,
+        "status": dict(sorted(codes.items())),
+        "degraded": sum(1 for s in samples if s.degraded),
+        "quantiles": quantiles_ms(lats),
+        # the flattering closed-loop number, kept ONLY so coordinated
+        # omission is falsifiable from the artifact itself
+        "service_time_quantiles": quantiles_ms([s.service_s for s in samples]),
+        "max_send_lag_s": round(max((s.sent - s.sched for s in samples),
+                                    default=0.0), 3),
+    }
+
+
+def fetch_json(url: str, timeout: float = 10.0) -> Optional[dict]:
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            return json.loads(r.read().decode())
+    except Exception as e:  # noqa: BLE001 - surfaced in the artifact
+        sys.stderr.write("loadgen: GET %s failed: %s\n" % (url, e))
+        return None
+
+
+# -- main -------------------------------------------------------------------
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description=__doc__.splitlines()[0],
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--url", required=True, help="service base url")
+    ap.add_argument("--rate", type=float, default=20.0,
+                    help="offered request rate /s (ignored with --ramp or "
+                         "--time-warp timeline replay)")
+    ap.add_argument("--duration", type=float, default=10.0,
+                    help="seconds per step (schedule length = rate*duration)")
+    ap.add_argument("--ramp", default=None,
+                    help="r0:r1:steps — ramp the offered rate to find the "
+                         "knee (achieved/offered and SLO per step)")
+    ap.add_argument("--arrival", choices=("poisson", "uniform"),
+                    default="poisson")
+    ap.add_argument("--seed", type=int, default=1)
+    ap.add_argument("--concurrency", type=int, default=32)
+    ap.add_argument("--timeout-s", type=float, default=10.0)
+    # synth fleet
+    ap.add_argument("--vehicles", type=int, default=16)
+    ap.add_argument("--points", type=int, default=24,
+                    help="points per synth vehicle")
+    ap.add_argument("--window", type=int, default=8,
+                    help="points per request window")
+    ap.add_argument("--grid", type=int, default=8,
+                    help="synth grid size (must match the served network "
+                         "for sensible matches)")
+    # archive replay (make_requests.py-style rows)
+    ap.add_argument("--archive", default=None, help="probe dir or glob")
+    ap.add_argument("--sep", default="|")
+    ap.add_argument("--uuid-col", type=int, default=0)
+    ap.add_argument("--time-col", type=int, default=1)
+    ap.add_argument("--lat-col", type=int, default=2)
+    ap.add_argument("--lon-col", type=int, default=3)
+    ap.add_argument("--limit", type=int, default=0,
+                    help="max archive rows to load (0 = all)")
+    ap.add_argument("--time-warp", type=float, default=0.0,
+                    help="replay the archive's own timeline compressed "
+                         "N-fold instead of a fixed --rate")
+    # objectives (<=0 drops one)
+    ap.add_argument("--slo-availability", type=float, default=0.99)
+    ap.add_argument("--slo-p99-ms", type=float, default=2500.0)
+    ap.add_argument("--slo-p999-ms", type=float, default=0.0)
+    ap.add_argument("--slo-degraded-frac", type=float, default=0.0)
+    ap.add_argument("--server-slo", action="store_true",
+                    help="fetch GET /debug/slo after the run and require "
+                         "the server verdict to AGREE with the client's")
+    ap.add_argument("--platform", default="cpu",
+                    help="artifact provenance tag (cpu|tpu)")
+    ap.add_argument("--out", default=None, help="artifact path (default "
+                    "stdout)")
+    args = ap.parse_args(argv)
+
+    rng = random.Random(args.seed)
+    base = args.url.rstrip("/")
+    health = fetch_json(base + "/health") or {}
+
+    # corpus
+    try:
+        if args.archive:
+            sessions = archive_sessions(
+                args.archive, args.sep, args.uuid_col, args.time_col,
+                args.lat_col, args.lon_col, args.window, args.limit)
+        else:
+            sessions = synth_sessions(args.vehicles, args.points,
+                                      args.window, args.grid, args.seed)
+    except Exception as e:  # noqa: BLE001 - setup failure is rc 2
+        sys.stderr.write("loadgen: corpus build failed: %s\n" % (e,))
+        return 2
+    if not sessions:
+        sys.stderr.write("loadgen: empty request corpus\n")
+        return 2
+    corpus = interleave(sessions)
+
+    # rate steps
+    if args.ramp:
+        try:
+            r0, r1, steps = args.ramp.split(":")
+            r0, r1, steps = float(r0), float(r1), int(steps)
+            assert steps >= 1 and r0 > 0 and r1 >= r0
+        except (ValueError, AssertionError):
+            ap.error("--ramp wants r0:r1:steps")
+        rates = [r0 + (r1 - r0) * i / max(1, steps - 1) for i in range(steps)]
+    else:
+        rates = [args.rate]
+
+    objectives = objectives_from_args(args)
+    steps_out = []
+    all_samples: List[Sample] = []
+    knee = None
+    for rate in rates:
+        if args.time_warp > 0 and not args.ramp:
+            reqs = [dict(r) for r in corpus]
+            schedule = timeline_schedule(reqs, args.time_warp)
+            for r in reqs:
+                r.pop("_t0", None)
+            offered = (len(schedule) / schedule[-1]) if schedule and schedule[-1] > 0 else 0.0
+        else:
+            n = max(1, int(rate * args.duration))
+            reqs = [dict(corpus[i % len(corpus)]) for i in range(n)]
+            for r in reqs:
+                r.pop("_t0", None)
+            schedule = build_schedule(n, rate, args.arrival, rng)
+            offered = rate
+        samples = run_load(base + "/report", reqs, schedule,
+                           concurrency=args.concurrency,
+                           timeout_s=args.timeout_s)
+        if not samples:
+            sys.stderr.write("loadgen: no samples recorded\n")
+            return 2
+        st = step_stats(samples, offered)
+        verdict = evaluate(samples, objectives,
+                           window_s=max(60.0, schedule[-1] + 60.0))
+        st["slo_ok"] = verdict["ok"]
+        ach = st["achieved_rps"] or 0.0
+        if verdict["ok"] and ach >= 0.9 * offered:
+            knee = offered
+        steps_out.append(st)
+        all_samples.extend(samples)
+
+    # the headline evaluation covers the WHOLE run (every step's samples)
+    client = evaluate(all_samples, objectives,
+                      window_s=max(60.0, max(s.done for s in all_samples) + 60.0))
+    head = step_stats(all_samples, rates[-1] if not args.ramp else 0.0)
+
+    server_slo = None
+    agree = None
+    if args.server_slo:
+        span_s = max(60.0, max(s.done for s in all_samples) + 30.0)
+        server_slo = fetch_json(base + "/debug/slo?window=%d" % int(span_s))
+        if server_slo is not None:
+            agree = bool(server_slo.get("ok")) == bool(client["ok"])
+
+    artifact = {
+        # perf_gate-consumable header (docs/bench-schema.md shape)
+        "metric": "loadgen_p99_latency",
+        "value": head["quantiles"]["p99_ms"],
+        "unit": "ms",
+        "platform": args.platform,
+        "edges": health.get("edges"),
+        "attrib": None,
+        "attrib_reason": "loadgen artifact (no profiler capture)",
+        "last_onchip": None,
+        # the run itself
+        "url": base,
+        "arrival": args.arrival,
+        "seed": args.seed,
+        "mode": ("archive" if args.archive else "synth"),
+        "time_warp": args.time_warp or None,
+        "sessions": len(sessions),
+        "requests": len(all_samples),
+        "offered_rps": steps_out[-1]["offered_rps"],
+        "achieved_rps": head["achieved_rps"],
+        "status": head["status"],
+        "degraded": head["degraded"],
+        "quantiles": head["quantiles"],
+        "service_time_quantiles": head["service_time_quantiles"],
+        "max_send_lag_s": head["max_send_lag_s"],
+        "slo": {
+            "objectives": [
+                {"name": o.name, "kind": o.kind, "target": o.target,
+                 "quantile": o.quantile if o.kind == "latency" else None}
+                for o in objectives],
+            "client": {"ok": client["ok"], "verdict": client["verdict"],
+                       "objectives": client["objectives"]},
+            "server": server_slo,
+            "agree": agree,
+        },
+        "ramp": steps_out if args.ramp else None,
+        "knee_rps": knee if args.ramp else None,
+    }
+    blob = json.dumps(artifact, indent=1)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(blob + "\n")
+        sys.stderr.write("loadgen: artifact -> %s\n" % args.out)
+    else:
+        print(blob)
+
+    if not client["ok"]:
+        sys.stderr.write("loadgen: SLO VIOLATED (client verdict)\n")
+        return 1
+    if args.server_slo and agree is not True:
+        sys.stderr.write("loadgen: server verdict %s does not agree\n"
+                         % (None if server_slo is None
+                            else server_slo.get("verdict")))
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -11,7 +11,15 @@ records outside the bbox, and either produces to Kafka or prints to stdout
     tools/make_requests.py --src ./archive --salt $(date +%s) \
         --bbox 37.7,-122.5,37.8,-122.3 \
         --uuid-col 0 --lat-col 2 --lon-col 3 --sep '|' \
+        [--rate 500] [--limit 100000] \
         [--bootstrap localhost:9092 --topic raw | --dry-run]
+
+``--rate`` paces the output to N records/second (open-loop metronome:
+record i is released at t0 + i/rate, so a slow consumer accumulates
+backlog instead of silently slowing the offered rate) and ``--limit``
+stops after N records — together they turn an archive replay into a
+controlled-rate feed for `python -m reporter_tpu.stream`, Kafka, or
+tools/loadgen.py instead of an as-fast-as-possible dump.
 """
 
 import argparse
@@ -20,6 +28,7 @@ import gzip
 import hashlib
 import os
 import sys
+import time
 
 
 def iter_lines(src):
@@ -38,6 +47,25 @@ def iter_lines(src):
                     yield line
 
 
+def paced(records, rate: float = 0.0, limit: int = 0, clock=time.monotonic,
+          sleep=time.sleep):
+    """Release ``records`` at ``rate``/s (0 = unpaced) stopping after
+    ``limit`` (0 = all).  Open-loop: record i's release time is fixed at
+    t0 + i/rate regardless of how long earlier records took to consume,
+    so downstream slowness shows up as backlog, not as a silently lower
+    offered rate (the same discipline as tools/loadgen.py).  ``clock``/
+    ``sleep`` are injectable for tests."""
+    t0 = clock()
+    for i, rec in enumerate(records):
+        if limit and i >= limit:
+            return
+        if rate > 0:
+            delay = t0 + i / rate - clock()
+            if delay > 0:
+                sleep(delay)
+        yield rec
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--src", required=True, help="archive dir or glob")
@@ -53,6 +81,11 @@ def main(argv=None):
     ap.add_argument("--topic", default="raw")
     ap.add_argument("--dry-run", action="store_true",
                     help="print rewritten records to stdout instead of Kafka")
+    ap.add_argument("--rate", type=float, default=0.0,
+                    help="pace output to N records/sec, open-loop "
+                         "(0 = as fast as possible)")
+    ap.add_argument("--limit", type=int, default=0,
+                    help="stop after N records (0 = all)")
     args = ap.parse_args(argv)
 
     bbox = None
@@ -77,6 +110,7 @@ def main(argv=None):
         return args.sep.join(cols)
 
     out = (rw for rw in (rewrite(l) for l in iter_lines(args.src)) if rw)
+    out = paced(out, rate=args.rate, limit=args.limit)
     n = 0
     if args.dry_run or not args.bootstrap:
         for line in out:

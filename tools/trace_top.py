@@ -15,95 +15,37 @@ Usage:
     python tools/trace_top.py --url http://localhost:8002 [--interval 2]
     python tools/trace_top.py --url http://localhost:8002 --once
 
-Pure stdlib (the container bakes in the jax_graft toolchain only); the
-parsing/quantile helpers are unit-tested in tests/test_trace.py.
+Dependency-free beyond ``reporter_tpu.obs`` (itself pure stdlib); the
+parsing/quantile math lives in ``reporter_tpu/obs/quantile.py`` — ONE
+implementation shared with the SLO engine and tools/loadgen.py, pinned by
+tests/test_slo.py (and exercised here by tests/test_trace.py).
 """
 
 from __future__ import annotations
 
 import argparse
 import json
-import re
+import os
 import sys
 import time
 import urllib.request
-from typing import Dict, List, Optional, Tuple
+from typing import List, Optional, Tuple
 
-_SAMPLE_RE = re.compile(
-    r'^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{(.*)\})?\s+(-?[0-9.eE+-]+|NaN)$')
-_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:\\.|[^"\\])*)"')
-
-
-def parse_metrics(text: str) -> Dict[str, Dict[Tuple[Tuple[str, str], ...], float]]:
-    """Prometheus text exposition -> {name: {labels: value}} with labels a
-    sorted tuple of (k, v) pairs (histogram _bucket/_sum/_count stay
-    separate names, exactly as exposed)."""
-    out: Dict[str, Dict[Tuple[Tuple[str, str], ...], float]] = {}
-    for line in text.splitlines():
-        line = line.strip()
-        if not line or line.startswith("#"):
-            continue
-        m = _SAMPLE_RE.match(line)
-        if not m:
-            continue
-        name, _g, labels_raw, value = m.groups()
-        labels = tuple(sorted(_LABEL_RE.findall(labels_raw or "")))
-        try:
-            out.setdefault(name, {})[labels] = float(value)
-        except ValueError:
-            continue
-    return out
-
-
-def hist_buckets(metrics: dict, family: str) -> List[Tuple[float, float]]:
-    """Sorted (upper_bound, cumulative_count) pairs for an unlabeled
-    histogram family, +Inf included."""
-    rows = []
-    for labels, v in metrics.get(family + "_bucket", {}).items():
-        le = dict(labels).get("le")
-        if le is None:
-            continue
-        rows.append((float("inf") if le == "+Inf" else float(le), v))
-    rows.sort()
-    return rows
-
-
-def delta_buckets(cur: List[Tuple[float, float]],
-                  prev: Optional[List[Tuple[float, float]]]) -> List[Tuple[float, float]]:
-    """Bucket-wise difference (interval histogram); falls back to ``cur``
-    when there is no previous frame or the server restarted (negative
-    deltas)."""
-    if not prev or len(prev) != len(cur):
-        return cur
-    out = []
-    for (le, c), (_ple, p) in zip(cur, prev):
-        d = c - p
-        if d < 0:
-            return cur
-        out.append((le, d))
-    return out
-
-
-def hist_quantile(buckets: List[Tuple[float, float]], q: float) -> Optional[float]:
-    """Quantile from cumulative buckets with linear interpolation inside
-    the landing bucket (Prometheus histogram_quantile semantics); None on
-    an empty histogram.  The +Inf bucket clamps to the last finite bound."""
-    if not buckets:
-        return None
-    total = buckets[-1][1]
-    if total <= 0:
-        return None
-    rank = q * total
-    prev_le, prev_cum = 0.0, 0.0
-    for le, cum in buckets:
-        if cum >= rank:
-            if le == float("inf"):
-                return prev_le
-            if cum == prev_cum:
-                return le
-            return prev_le + (le - prev_le) * (rank - prev_cum) / (cum - prev_cum)
-        prev_le, prev_cum = le, cum
-    return prev_le
+try:
+    from reporter_tpu.obs.quantile import (  # noqa: F401 - re-exported
+        delta_buckets,
+        hist_buckets,
+        hist_quantile,
+        parse_metrics,
+    )
+except ImportError:  # run from anywhere: tools/ sits next to the package
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    from reporter_tpu.obs.quantile import (  # noqa: F401 - re-exported
+        delta_buckets,
+        hist_buckets,
+        hist_quantile,
+        parse_metrics,
+    )
 
 
 def scalar(metrics: dict, name: str, labels: Tuple[Tuple[str, str], ...] = ()) -> float:
