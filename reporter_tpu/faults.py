@@ -27,6 +27,20 @@ Points and spec grammar (value of ``REPORTER_FAULT_<POINT>``):
                 503 or a timeout (N attempts total; default every attempt)
   client_post   "reset[:N]"
                 raise ConnectionResetError inside stream/client.py's POST
+  router_connect
+                "refused[:N]"
+                raise ConnectionRefusedError inside the fleet router's
+                replica dispatch (serve/router.py) — the router→replica
+                connect-refused seam the failover re-dispatch must absorb
+  replica_slow_accept
+                "<seconds>[:N]"
+                sleep <seconds> at the replica's HTTP routing entry — a
+                slow-accepting replica the router's hedging/passive
+                ejection must straggle around
+  health_flap   "N" | "always"
+                make the replica's /health answer 503 "unhealthy" while
+                armed — a flapping health probe the router's streak
+                thresholds must debounce
 
 Counts are consumed per (point, spec) pair, so changing the spec re-arms
 the point and clearing the variable disarms it; ``reset()`` re-arms
@@ -50,7 +64,9 @@ C_INJECTED = obs.counter(
     "docs/robustness.md)",
     ("point",))
 
-POINTS = ("dispatch", "device_hang", "ubodt_probe", "store_put", "client_post")
+POINTS = ("dispatch", "device_hang", "ubodt_probe", "store_put",
+          "client_post", "router_connect", "replica_slow_accept",
+          "health_flap")
 
 _lock = threading.Lock()
 _consumed: dict = {}  # (point, raw_spec) -> times fired
@@ -102,7 +118,7 @@ def fire(point: str, key: Optional[str] = None) -> Optional[str]:
         mode, count = "raise", float("inf")
     elif head.isdigit():
         mode, count = "raise", int(head)
-    elif head in ("5xx", "timeout", "reset"):
+    elif head in ("5xx", "timeout", "reset", "refused"):
         mode = head
         count = (int(parts[1]) if len(parts) > 1 and parts[1].isdigit()
                  else float("inf"))

@@ -1,3 +1,14 @@
 from .service import ReporterService, MicroBatcher, load_service_config
 
-__all__ = ["ReporterService", "MicroBatcher", "load_service_config"]
+__all__ = ["ReporterService", "MicroBatcher", "load_service_config",
+           "FleetRouter"]
+
+
+def __getattr__(name):
+    # lazy: the router pulls in the http pool + retry machinery, which
+    # plain single-replica embedders never need
+    if name == "FleetRouter":
+        from .router import FleetRouter
+
+        return FleetRouter
+    raise AttributeError(name)

@@ -103,23 +103,74 @@ def main(argv):
         slo=conf.get("slo"),
     )
     httpd = service.make_server(host, int(port))
-    logging.info("reporter_tpu service on %s:%s (engine deferred)", host, port)
+    # log the BOUND port: with port 0 the OS picks one, and supervisors /
+    # tests recover it from this line
+    logging.info("reporter_tpu service on %s:%s (engine deferred)",
+                 host, httpd.server_port)
 
-    # containers stop with SIGTERM: stop accepting, let in-flight handlers
-    # finish (non-daemon handler threads + block_on_close make server_close
-    # join them; the per-connection idle timeout set in make_server bounds
-    # how long an idle keep-alive client can hold the join), and exit 0.
-    # The handler disarms after the first signal, so a second SIGTERM
-    # force-terminates rather than unwinding the cleanup; anything wedged
-    # past the container's stop grace period is the runtime's SIGKILL to
-    # take.  serve_forever's select loop (handlers on other threads) is the
-    # one place an async KeyboardInterrupt is safe — the stream CLIs use
-    # the cooperative StopFlag instead (utils/shutdown.py).
-    from ..utils.shutdown import term_to_keyboard_interrupt
+    # containers stop with SIGTERM; the contract is a GRACEFUL DRAIN
+    # (docs/serving-fleet.md): on the first SIGTERM/SIGINT the service
+    # stops admitting (new /report requests answer 503 {"status":
+    # "draining"} + Retry-After; /health flips to 503 "draining" so the
+    # fleet router rotates traffic off), inflight requests run to
+    # completion, then the listener closes, the flight recorder flushes,
+    # and the process exits 0.  The drain window is bounded by
+    # REPORTER_DRAIN_GRACE_S (default 30; keep it under the container
+    # runtime's stop grace period).  The handler disarms after the first
+    # signal, so a second SIGTERM force-terminates rather than unwinding
+    # the cleanup.
+    import signal
+    import threading
+    import time as _time
 
     httpd.daemon_threads = False
     httpd.block_on_close = True
-    term_to_keyboard_interrupt()
+    try:
+        drain_grace = float(os.environ.get("REPORTER_DRAIN_GRACE_S", 30.0))
+    except ValueError:
+        drain_grace = 30.0
+    drained = threading.Event()
+
+    def _drain_then_stop():
+        service.begin_drain()
+        deadline = _time.monotonic() + max(0.0, drain_grace)
+        while _time.monotonic() < deadline:
+            if service.idle():
+                break
+            _time.sleep(0.05)
+        if not service.idle():
+            logging.warning(
+                "drain grace (%.1fs) expired with requests still inflight; "
+                "closing anyway", drain_grace)
+        httpd.shutdown()
+        # a request may have slipped past the last idle() sample while
+        # the accept loop wound down: give it a moment to finish before
+        # cutting sockets (cutting an active one would reset its client)
+        deadline = _time.monotonic() + 2.0
+        while _time.monotonic() < deadline and not service.idle():
+            _time.sleep(0.05)
+        # cut the now-idle keep-alive connections so server_close's
+        # handler join returns promptly instead of waiting out the 30 s
+        # idle timeout (the router holds pooled sockets to every replica)
+        getattr(httpd, "close_lingering", lambda: None)()
+
+    def _on_stop_signal(signum, frame):
+        # only spawn a thread from the handler: the drain loop must not
+        # run in signal context.  Disarm so the SECOND signal kills.
+        try:
+            signal.signal(signum, signal.SIG_DFL)
+        except (ValueError, OSError):  # pragma: no cover
+            pass
+        if not drained.is_set():
+            drained.set()
+            threading.Thread(target=_drain_then_stop, daemon=True,
+                             name="drain").start()
+
+    for _sig in (signal.SIGTERM, signal.SIGINT):
+        try:
+            signal.signal(_sig, _on_stop_signal)
+        except ValueError:  # pragma: no cover - not the main thread
+            pass
 
     try:
         # build the engine, then pre-compile the hot shapes, all BEHIND
@@ -194,7 +245,19 @@ def main(argv):
             target=_warm, daemon=True, name="warmup")
         warm_thread.start()
         httpd.serve_forever()
-        if service.batcher is None:
+        if drained.is_set():
+            logging.info("drained (signal); shutting down")
+            # let the in-flight engine build / warmup compile finish
+            # before tearing down the runtime under it (bounded: anything
+            # longer is the container's SIGKILL to take)
+            stop_warm.set()
+            warm_thread.join(timeout=120.0)
+            # flush the flight recorder on the way out — the drain
+            # window's own traces (refusals, last completions) included
+            from ..utils.shutdown import run_shutdown_hooks
+
+            run_shutdown_hooks()
+        elif service.batcher is None:
             # serve loop ended with no engine: the build failed — dump the
             # flight recorder like any other fatal exit before bailing
             from ..utils.shutdown import run_shutdown_hooks
@@ -202,14 +265,10 @@ def main(argv):
             run_shutdown_hooks()
             return 1
     except KeyboardInterrupt:
-        logging.info("shutting down (signal)")
-        # flip the drain flag first: handlers close their connection after
-        # the in-flight request, bounding server_close's handler join even
-        # for clients actively streaming keep-alive requests
+        # belt-and-braces: an interrupt that bypassed the drain handler
+        # (e.g. raised before the signal hookup) still exits cleanly
+        logging.info("shutting down (interrupt)")
         service.draining = True
-        # let the in-flight engine build / warmup compile finish before
-        # tearing down the runtime under it (bounded: anything longer is
-        # the container's SIGKILL to take)
         stop_warm.set()
         warm_thread.join(timeout=120.0)
     finally:

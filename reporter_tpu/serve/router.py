@@ -1,0 +1,731 @@
+"""Fleet router: the session-affine HTTP front over N serve replicas.
+
+The serving tier used to be ONE process with ONE MicroBatcher — a single
+wedge or restart took the whole ingest path down.  This router makes the
+replicas cattle (the TF-Serving posture, arXiv:1605.08695): the front
+tier owns routing, health, and failover; a replica owns nothing but its
+device.  Topology, knobs, and runbook: docs/serving-fleet.md.
+
+  Affinity    /report requests are routed by RENDEZVOUS HASH (highest
+              random weight) on the vehicle uuid: every vehicle has a
+              stable ranked order of replicas, traffic goes to the
+              highest-ranked AVAILABLE one, and when a replica dies only
+              ITS vehicles remap (everyone else's ranking is untouched —
+              the property that makes carried per-vehicle beam state,
+              ROADMAP item 2, worth pinning).  /trace_attributes_batch
+              routes by its first trace's uuid (bulk clients pre-group).
+
+  Health      an active prober GETs every replica's /health on an interval:
+              200 -> healthy, 503 {"status": "draining"} -> rotate
+              traffic off (deliberate exit, no ejection), anything else
+              counts an unhealthy streak (debounced: one flapped probe
+              never drops a replica).  Passively, consecutive transport
+              errors on live traffic eject a replica outlier-style
+              before the next probe even runs.
+
+  Failover    a failed dispatch re-runs against the next-ranked replica
+              under the SHARED retry budget (utils/retry.py): replica
+              429/503 rotate onward immediately (the Retry-After hint is
+              for THAT replica, not the fleet), transport errors back
+              off with jitter, and non-retryable 4xx plus poison 500s
+              return to the client verbatim — the request reached a
+              replica and failed deterministically; re-dispatching it
+              would just poison the next replica.
+
+  Hedging     optionally (REPORTER_HEDGE_MS) a /report that has not
+              answered within the hedge delay is raced against the
+              second-ranked replica; first success wins, the straggler
+              is abandoned.  Safe because /report is idempotent pure
+              matching.
+
+  Shedding    the router bounds its own inflight (REPORTER_ROUTER_MAX_
+              INFLIGHT); past it, requests shed 429 with Retry-After
+              rather than queueing unboundedly, and a fleet-wide 429
+              (every replica shedding) propagates as a router 429 —
+              backpressure reaches the client, queues stay bounded.
+
+Run standalone:  python -m reporter_tpu.serve.router \
+                     --port 8002 --replicas http://h1:8010,http://h2:8010
+or supervised with the replicas by tools/fleet.py.
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import logging
+import threading
+import time as _time
+import urllib.error
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional, Tuple
+from urllib.parse import parse_qs, urlsplit
+
+from .. import faults
+from ..obs import log as obs_log
+from ..obs import metrics as obs
+from ..obs import trace as obs_trace
+from ..obs.quantile import SLO_BUCKETS_S
+from ..utils import retry
+from ..utils.httppool import HttpPool, raise_for_status
+from .service import _resolve_num
+
+log = logging.getLogger(__name__)
+
+ACTIONS = {"report", "trace_attributes_batch", "health", "metrics", "fleet"}
+
+C_REQS = obs.counter(
+    "reporter_router_requests_total",
+    "Router requests by endpoint and outcome (ok / failover_ok / shed / "
+    "no_replica / saturated / unreachable / invalid / passthrough)",
+    ("endpoint", "outcome"))
+H_LAT = obs.histogram(
+    "reporter_router_request_seconds",
+    "Router end-to-end latency per endpoint (failover + hedging included)",
+    ("endpoint",), buckets=SLO_BUCKETS_S)
+C_BACKEND = obs.counter(
+    "reporter_router_replica_requests_total",
+    "Replica-leg outcomes by replica and status (HTTP code or 'error' "
+    "for a transport failure)",
+    ("replica", "status"))
+C_FAILOVER = obs.counter(
+    "reporter_router_failovers_total",
+    "Re-dispatches to the next rendezvous-ranked replica, by cause "
+    "(network / 5xx / 429)",
+    ("cause",))
+C_HEDGES = obs.counter(
+    "reporter_router_hedges_total",
+    "Hedge requests fired after the primary exceeded REPORTER_HEDGE_MS")
+C_HEDGE_WINS = obs.counter(
+    "reporter_router_hedge_wins_total",
+    "Hedge requests whose response beat the straggling primary")
+G_REPLICAS = obs.gauge(
+    "reporter_router_replicas",
+    "Fleet composition by probe-derived state (healthy / draining / "
+    "unhealthy / init)",
+    ("state",))
+C_PROBE_FAIL = obs.counter(
+    "reporter_router_probe_failures_total",
+    "Active /health probe failures per replica (a streak past the "
+    "debounce threshold marks the replica unhealthy)",
+    ("replica",))
+C_EJECT = obs.counter(
+    "reporter_router_ejections_total",
+    "Replica ejections by replica and cause (passive = consecutive "
+    "transport errors on live traffic, probe = unhealthy streak)",
+    ("replica", "cause"))
+G_INFLIGHT = obs.gauge(
+    "reporter_router_inflight",
+    "Requests currently inside the router's bounded proxy section")
+C_SHED = obs.counter(
+    "reporter_router_shed_total",
+    "Requests shed 429 at the router because the fleet-wide inflight "
+    "bound was reached")
+C_REMAP = obs.counter(
+    "reporter_router_affinity_remaps_total",
+    "Requests routed off their rendezvous-primary replica because it "
+    "was unavailable (the affinity disruption a replica loss causes)")
+
+
+def rendezvous_score(uuid: str, replica_url: str) -> int:
+    """Highest-random-weight hash: each (vehicle, replica) pair gets an
+    independent stable score, so removing a replica never reorders the
+    scores of the surviving ones — a dead replica remaps ONLY its own
+    vehicles."""
+    h = hashlib.blake2b(("%s|%s" % (uuid, replica_url)).encode("utf-8"),
+                        digest_size=8)
+    return int.from_bytes(h.digest(), "big")
+
+
+class Replica:
+    """One backend serve process, as the router sees it."""
+
+    def __init__(self, url: str):
+        self.url = url.rstrip("/")
+        self.id: Optional[str] = None       # learned from X-Reporter-Replica
+        self.state = "init"                  # init|healthy|draining|unhealthy
+        self.probe_fail_streak = 0
+        self.probe_ok_streak = 0
+        self.fail_streak = 0                 # passive transport-error streak
+        self.ejected_until = 0.0             # monotonic; passive ejection
+        self.last_probe: Optional[dict] = None
+
+    @property
+    def label(self) -> str:
+        return self.id or self.url
+
+    def available(self, now: Optional[float] = None) -> bool:
+        if now is None:
+            now = _time.monotonic()
+        return self.state == "healthy" and now >= self.ejected_until
+
+    def snapshot(self) -> dict:
+        now = _time.monotonic()
+        return {
+            "url": self.url, "id": self.id, "state": self.state,
+            "available": self.available(now),
+            "fail_streak": self.fail_streak,
+            "probe_fail_streak": self.probe_fail_streak,
+            "ejected_for_s": round(max(0.0, self.ejected_until - now), 2),
+            "last_probe": self.last_probe,
+        }
+
+
+class FleetRouter:
+    """Owns the replica set, the prober, and the dispatch policy."""
+
+    def __init__(self, replica_urls: List[str],
+                 probe_interval_s: Optional[float] = None,
+                 probe_timeout_s: Optional[float] = None,
+                 unhealthy_after: Optional[int] = None,
+                 healthy_after: Optional[int] = None,
+                 eject_streak: Optional[int] = None,
+                 eject_s: Optional[float] = None,
+                 hedge_ms: Optional[float] = None,
+                 max_inflight: Optional[int] = None,
+                 budget_s: Optional[float] = None,
+                 request_timeout_s: Optional[float] = None,
+                 pool: Optional[HttpPool] = None):
+        if not replica_urls:
+            raise ValueError("router needs at least one replica url")
+        self.replicas = [Replica(u) for u in replica_urls]
+        # knob resolution: env > constructor > default (the service
+        # convention, docs/serving-fleet.md knob table)
+        self.probe_interval_s = _resolve_num(
+            "REPORTER_ROUTER_PROBE_S", probe_interval_s, 1.0)
+        self.probe_timeout_s = _resolve_num(
+            "REPORTER_ROUTER_PROBE_TIMEOUT_S", probe_timeout_s, 2.0)
+        # debounce: one flapped probe must not drop a replica, and one
+        # lucky probe must not resurrect a flapping one
+        self.unhealthy_after = max(1, int(_resolve_num(
+            "REPORTER_ROUTER_UNHEALTHY_AFTER", unhealthy_after, 2)))
+        self.healthy_after = max(1, int(_resolve_num(
+            "REPORTER_ROUTER_HEALTHY_AFTER", healthy_after, 2)))
+        self.eject_streak = max(1, int(_resolve_num(
+            "REPORTER_ROUTER_EJECT_STREAK", eject_streak, 3)))
+        self.eject_s = _resolve_num("REPORTER_ROUTER_EJECT_S", eject_s, 5.0)
+        self.hedge_s = _resolve_num("REPORTER_HEDGE_MS", hedge_ms, 0.0) / 1000.0
+        self.max_inflight = max(1, int(_resolve_num(
+            "REPORTER_ROUTER_MAX_INFLIGHT", max_inflight, 256)))
+        self.budget_s = _resolve_num(
+            "REPORTER_ROUTER_BUDGET_S", budget_s, retry.BUDGET_S)
+        self.request_timeout_s = _resolve_num(
+            "REPORTER_ROUTER_REQUEST_TIMEOUT_S", request_timeout_s, 30.0)
+        self.pool = pool or HttpPool(max_idle_per_host=16)
+        self._gate = threading.BoundedSemaphore(self.max_inflight)
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._prober: Optional[threading.Thread] = None
+        self._t_boot = _time.time()
+
+    # -- health: active probing + passive outlier ejection -----------------
+
+    def start(self) -> None:
+        """Probe every replica once synchronously (routing works from the
+        first request), then keep probing on the interval."""
+        self.probe_all()
+        self._prober = threading.Thread(target=self._probe_loop,
+                                        daemon=True, name="fleet-prober")
+        self._prober.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.pool.close()
+
+    def _probe_loop(self) -> None:
+        while not self._stop.wait(self.probe_interval_s):
+            self.probe_all()
+
+    def probe_all(self) -> None:
+        for r in self.replicas:
+            self._probe_one(r)
+        self._publish_states()
+
+    def _publish_states(self) -> None:
+        counts: Dict[str, int] = {"healthy": 0, "draining": 0,
+                                  "unhealthy": 0, "init": 0}
+        for r in self.replicas:
+            counts[r.state] = counts.get(r.state, 0) + 1
+        for state, n in counts.items():
+            G_REPLICAS.labels(state).set(n)
+
+    def _probe_one(self, r: Replica) -> None:
+        try:
+            status, headers, body = self.pool.request(
+                "GET", r.url + "/health", timeout=self.probe_timeout_s,
+                target="probe")
+            info = json.loads(body.decode("utf-8")) if body else {}
+        except Exception as e:  # noqa: BLE001 - a dead replica is data
+            self._probe_failed(r, "unreachable: %s" % (e,))
+            return
+        rid = headers.get("X-Reporter-Replica") or info.get("replica")
+        if rid:
+            r.id = str(rid)
+        r.last_probe = {"status": status,
+                        "state": info.get("status"),
+                        "t": round(_time.time(), 3)}
+        if status == 200:
+            r.probe_fail_streak = 0
+            r.probe_ok_streak += 1
+            if r.state != "healthy" and (
+                    r.probe_ok_streak >= self.healthy_after
+                    or r.state in ("init", "draining")):
+                # draining -> 200 means a fresh process took the slot
+                # (rolling restart); trust it immediately like a boot
+                if r.state != "init":
+                    obs_log.event(log, "replica_recovered",
+                                  level=logging.WARNING, replica=r.label,
+                                  url=r.url)
+                r.state = "healthy"
+                r.fail_streak = 0
+                r.ejected_until = 0.0
+            elif r.state == "healthy":
+                r.fail_streak = 0
+            return
+        if status == 503 and info.get("status") == "draining":
+            # deliberate exit: rotate traffic off, no ejection bookkeeping
+            if r.state != "draining":
+                obs_log.event(log, "replica_draining", level=logging.WARNING,
+                              replica=r.label, url=r.url)
+            r.state = "draining"
+            r.probe_ok_streak = 0
+            return
+        self._probe_failed(r, "status %s (%s)" % (status, info.get("status")))
+
+    def _probe_failed(self, r: Replica, why: str) -> None:
+        C_PROBE_FAIL.labels(r.label).inc()
+        r.probe_ok_streak = 0
+        r.probe_fail_streak += 1
+        if r.probe_fail_streak >= self.unhealthy_after \
+                and r.state != "unhealthy":
+            C_EJECT.labels(r.label, "probe").inc()
+            obs_log.event(log, "replica_unhealthy", level=logging.ERROR,
+                          replica=r.label, url=r.url, reason=why,
+                          streak=r.probe_fail_streak)
+            r.state = "unhealthy"
+
+    def _note_transport_failure(self, r: Replica) -> None:
+        """Passive outlier ejection: consecutive transport errors on live
+        traffic take a replica out of rotation before the next probe."""
+        with self._lock:
+            r.fail_streak += 1
+            if r.fail_streak >= self.eject_streak:
+                r.fail_streak = 0
+                r.ejected_until = _time.monotonic() + self.eject_s
+                C_EJECT.labels(r.label, "passive").inc()
+                obs_log.event(log, "replica_ejected", level=logging.ERROR,
+                              replica=r.label, url=r.url,
+                              eject_s=self.eject_s)
+
+    # -- routing ------------------------------------------------------------
+
+    def ranked(self, uuid: str) -> List[Replica]:
+        return sorted(self.replicas,
+                      key=lambda r: rendezvous_score(uuid, r.url),
+                      reverse=True)
+
+    def route_order(self, uuid: str) -> Tuple[List[Replica], bool]:
+        """(available replicas in rendezvous order, remapped?) — remapped
+        is True when the vehicle's true primary is out and its traffic is
+        landing elsewhere (the affinity disruption the remap counter and
+        the chaos suite measure)."""
+        ranked = self.ranked(uuid)
+        now = _time.monotonic()
+        order = [r for r in ranked if r.available(now)]
+        remapped = bool(order) and order[0] is not ranked[0]
+        return order, remapped
+
+    def _one(self, r: Replica, path: str, body: bytes,
+             headers: dict) -> Tuple[int, object, bytes, Replica]:
+        """One replica leg.  Returns pass-through responses (2xx, plain
+        4xx, 500, 504) and RAISES what the failover policy rotates on:
+        transport errors, 429 (replica shedding), 503 (draining /
+        unattached / wedged)."""
+        if faults.fire("router_connect") is not None:
+            self._note_transport_failure(r)
+            C_BACKEND.labels(r.label, "error").inc()
+            raise ConnectionRefusedError(
+                "injected router->replica connect refusal")
+        try:
+            status, rhdrs, rbody = self.pool.request(
+                "POST", r.url + path, body=body, headers=headers,
+                timeout=self.request_timeout_s, target="replica")
+        except Exception:
+            self._note_transport_failure(r)
+            C_BACKEND.labels(r.label, "error").inc()
+            raise
+        with self._lock:
+            r.fail_streak = 0
+        rid = rhdrs.get("X-Reporter-Replica")
+        if rid:
+            r.id = str(rid)
+        C_BACKEND.labels(r.label, str(status)).inc()
+        if status in (429, 503):
+            # retryable on ANOTHER replica: hand the error to the shared
+            # retry policy (Retry-After and cause classification ride the
+            # HTTPError); the final one, if every replica sheds, becomes
+            # the router's own 429/503
+            raise_for_status(r.url + path, status, rhdrs, rbody)
+        return status, rhdrs, rbody, r
+
+    def _hedged(self, first: Replica, second: Replica, path: str,
+                body: bytes, headers: dict):
+        """Race the primary against the next-ranked replica after the
+        hedge delay; first SUCCESS wins, a lone failure waits for its
+        peer, two failures re-raise the primary's."""
+        cond = threading.Condition()
+        results: List[Tuple[Replica, object, bool]] = []
+
+        def run(r: Replica, is_hedge: bool):
+            try:
+                out = self._one(r, path, body, headers)
+            except BaseException as e:  # noqa: BLE001 - collected below
+                out = e
+            with cond:
+                results.append((r, out, is_hedge))
+                cond.notify_all()
+
+        threading.Thread(target=run, args=(first, False), daemon=True,
+                         name="hedge-primary").start()
+        with cond:
+            cond.wait_for(lambda: results, timeout=self.hedge_s)
+            if not results:
+                C_HEDGES.inc()
+                threading.Thread(target=run, args=(second, True),
+                                 daemon=True, name="hedge-second").start()
+                hedged = True
+            else:
+                hedged = False
+            deadline = _time.monotonic() + self.request_timeout_s
+            want = 2 if hedged else 1
+            while True:
+                done = len(results)
+                ok = [o for o in results if not isinstance(o[1], BaseException)]
+                if ok:
+                    winner = ok[0]
+                    if winner[2]:
+                        C_HEDGE_WINS.inc()
+                    return winner[1]
+                if done >= want:
+                    break
+                remaining = deadline - _time.monotonic()
+                if remaining <= 0 or not cond.wait(timeout=remaining):
+                    break
+        # no success: surface the primary's failure (hedge failures are
+        # secondary evidence; the retry loop rotates onward either way)
+        for r, out, is_hedge in results:
+            if not is_hedge and isinstance(out, BaseException):
+                raise out
+        for _r, out, _h in results:
+            if isinstance(out, BaseException):
+                raise out
+        raise TimeoutError("hedged request: no replica answered in time")
+
+    def dispatch(self, endpoint: str, body: bytes, uuid: str,
+                 fwd_headers: dict):
+        """Route one request: rendezvous order, failover under the shared
+        retry budget, optional hedging.  Returns (status, headers, body,
+        outcome) — outcome feeds the router request counter."""
+        order, remapped = self.route_order(uuid)
+        if not order:
+            return (503, None,
+                    json.dumps({"error": "no replica available",
+                                "retry_after": 1}).encode("utf-8"),
+                    "no_replica")
+        if remapped:
+            C_REMAP.inc()
+        path = "/" + endpoint
+        hedge = (self.hedge_s > 0 and len(order) > 1
+                 and endpoint == "report")
+        attempts = {"n": 0}
+
+        def attempt(i: int) -> Tuple[int, object, bytes, Replica]:
+            attempts["n"] = i + 1
+            r = order[i % len(order)]
+            if i == 0 and hedge:
+                return self._hedged(order[0], order[1], path, body,
+                                    fwd_headers)
+            return self._one(r, path, body, fwd_headers)
+
+        # wrap to count failover causes without re-implementing the policy
+        def attempt_counted(i: int):
+            try:
+                return attempt(i)
+            except urllib.error.HTTPError as e:
+                if i + 1 < max(2, len(order)) + 1:
+                    C_FAILOVER.labels(
+                        "429" if e.code == 429 else "5xx").inc()
+                raise
+            except Exception:
+                if i + 1 < max(2, len(order)) + 1:
+                    C_FAILOVER.labels("network").inc()
+                raise
+
+        try:
+            status, rhdrs, rbody, r = retry.call_with_failover(
+                attempt_counted, target="router",
+                retries=max(2, len(order)) + 1,
+                budget_s=self.budget_s, hold_429=False)
+        except urllib.error.HTTPError as e:
+            # every tried replica shed (429) or refused (503): propagate
+            # the backpressure with the replica's own Retry-After hint
+            hint = retry._retry_after_s(e)
+            payload = {"error": ("fleet saturated" if e.code == 429
+                                 else "no replica accepted the request"),
+                       "retry_after": max(1, int(hint or 1))}
+            return (e.code, getattr(e, "headers", None),
+                    json.dumps(payload).encode("utf-8"), "saturated")
+        except Exception as e:  # noqa: BLE001 - transport-level exhaustion
+            return (503, None,
+                    json.dumps({"error": "fleet unreachable: %s" % (e,),
+                                "retry_after": 1}).encode("utf-8"),
+                    "unreachable")
+        outcome = "ok" if attempts["n"] <= 1 else "failover_ok"
+        if status >= 400:
+            outcome = "passthrough"
+        return status, rhdrs, rbody, outcome
+
+    # -- surfaces ------------------------------------------------------------
+
+    def health(self) -> Tuple[int, dict]:
+        states = {r.url: r.snapshot() for r in self.replicas}
+        n_avail = sum(1 for r in self.replicas if r.available())
+        code = 200 if n_avail else 503
+        return code, {
+            "status": "ok" if n_avail else "unavailable",
+            "role": "router",
+            "available": n_avail,
+            "replicas": {u: {"id": s["id"], "state": s["state"],
+                             "available": s["available"]}
+                         for u, s in states.items()},
+            "uptime_s": round(_time.time() - self._t_boot, 1),
+        }
+
+    def fleet(self) -> Tuple[int, dict]:
+        return 200, {
+            "replicas": [r.snapshot() for r in self.replicas],
+            "knobs": {
+                "probe_interval_s": self.probe_interval_s,
+                "probe_timeout_s": self.probe_timeout_s,
+                "unhealthy_after": self.unhealthy_after,
+                "healthy_after": self.healthy_after,
+                "eject_streak": self.eject_streak,
+                "eject_s": self.eject_s,
+                "hedge_ms": round(self.hedge_s * 1000.0, 1),
+                "max_inflight": self.max_inflight,
+                "budget_s": self.budget_s,
+                "request_timeout_s": self.request_timeout_s,
+            },
+        }
+
+    # -- HTTP front ----------------------------------------------------------
+
+    def make_server(self, host: str = "0.0.0.0",
+                    port: int = 8002) -> ThreadingHTTPServer:
+        router = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+            timeout = 30
+
+            def _answer(self, code: int, payload: dict,
+                        replica_hdrs=None):
+                body = json.dumps(
+                    payload, separators=(",", ":")).encode("utf-8")
+                self._answer_bytes(code, body, replica_hdrs,
+                                   "application/json;charset=utf-8")
+
+            def _answer_bytes(self, code: int, body: bytes, replica_hdrs,
+                              ctype: str):
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                if replica_hdrs is not None:
+                    # the winning replica's identity rides through the
+                    # hop — loadgen's distribution and the affinity
+                    # assertions key on it
+                    rid = replica_hdrs.get("X-Reporter-Replica")
+                    if rid:
+                        self.send_header("X-Reporter-Replica", rid)
+                if code in (429, 503):
+                    ra = 1
+                    if replica_hdrs is not None:
+                        try:
+                            ra = max(1, int(float(
+                                replica_hdrs.get("Retry-After") or 1)))
+                        except (TypeError, ValueError):
+                            ra = 1
+                    self.send_header("Retry-After", str(ra))
+                tid = getattr(self, "_trace_id", None)
+                if tid:
+                    self.send_header("X-Reporter-Trace", tid)
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _content_length(self):
+                try:
+                    n = int(self.headers.get("Content-Length", "0"))
+                except (TypeError, ValueError):
+                    self.close_connection = True
+                    return None
+                if n < 0:
+                    self.close_connection = True
+                    return None
+                return n
+
+            def _proxy(self, endpoint: str, payload_bytes: bytes,
+                       uuid: str):
+                t0 = _time.monotonic()
+                if not router._gate.acquire(blocking=False):
+                    C_SHED.inc()
+                    C_REQS.labels(endpoint, "shed").inc()
+                    return self._answer(
+                        429, {"error": "router saturated (%d inflight)"
+                              % router.max_inflight, "retry_after": 1})
+                G_INFLIGHT.inc()
+                try:
+                    fwd = {"Content-Type": "application/json",
+                           "X-Reporter-Trace": self._trace_id}
+                    dl = self.headers.get("X-Reporter-Deadline-Ms")
+                    if dl:
+                        fwd["X-Reporter-Deadline-Ms"] = dl
+                    status, rhdrs, rbody, outcome = router.dispatch(
+                        endpoint, payload_bytes, uuid, fwd)
+                    C_REQS.labels(endpoint, outcome).inc()
+                    self._answer_bytes(status, rbody, rhdrs,
+                                       "application/json;charset=utf-8")
+                finally:
+                    G_INFLIGHT.dec()
+                    router._gate.release()
+                    H_LAT.labels(endpoint).observe(
+                        _time.monotonic() - t0, exemplar=self._trace_id)
+
+            def _route(self, post: bool):
+                self._trace_id = (
+                    obs_trace.accept_trace_id(
+                        self.headers.get("X-Reporter-Trace"))
+                    or obs_trace.new_trace_id())
+                try:
+                    split = urlsplit(self.path)
+                    action = split.path.split("/")[-1]
+                    query = parse_qs(split.query)
+                    if action not in ACTIONS:
+                        return self._answer(
+                            400, {"error": "Try a valid action: %s"
+                                  % sorted(ACTIONS)})
+                    if action == "health":
+                        return self._answer(*router.health())
+                    if action == "fleet":
+                        return self._answer(*router.fleet())
+                    if action == "metrics":
+                        return self._answer_bytes(
+                            200, obs.REGISTRY.render().encode("utf-8"),
+                            None,
+                            "text/plain; version=0.0.4; charset=utf-8")
+                    if post:
+                        n = self._content_length()
+                        if n is None:
+                            return self._answer(
+                                400, {"error": "invalid Content-Length"})
+                        raw = self.rfile.read(n)
+                    else:
+                        if "json" not in query:
+                            return self._answer(
+                                400, {"error": "No json provided"})
+                        raw = query["json"][0].encode("utf-8")
+                    payload = json.loads(raw.decode("utf-8"))
+                except OSError as e:
+                    self.close_connection = True
+                    try:
+                        return self._answer(400, {"error": str(e)})
+                    except OSError:
+                        return None
+                except Exception as e:
+                    return self._answer(400, {"error": str(e)})
+                try:
+                    if not isinstance(payload, dict):
+                        return self._answer(
+                            400,
+                            {"error": "request body must be a json object"})
+                    # affinity key: the vehicle uuid ( batch requests
+                    # route by their first trace's uuid — bulk clients
+                    # pre-group by vehicle)
+                    if action == "report":
+                        uuid = str(payload.get("uuid") or "")
+                    else:
+                        traces = payload.get("traces") or [{}]
+                        uuid = str((traces[0] or {}).get("uuid") or "") \
+                            if isinstance(traces, list) else ""
+                    self._proxy(action, raw, uuid)
+                except Exception as e:  # noqa: BLE001 - never drop the socket
+                    log.exception("unhandled router error")
+                    self._answer(500, {"error": str(e)})
+
+            def do_GET(self):
+                self._route(post=False)
+
+            def do_POST(self):
+                self._route(post=True)
+
+            def log_request(self, code="-", size="-"):
+                obs_log.event(
+                    log, "router_request", level=logging.DEBUG,
+                    method=self.command, path=self.path,
+                    status=int(code) if isinstance(code, int) else str(code),
+                    trace_id=getattr(self, "_trace_id", None))
+
+            def log_message(self, fmt, *args):
+                log.debug("router http: " + fmt, *args)
+
+        class Server(ThreadingHTTPServer):
+            request_queue_size = 128
+
+        return Server((host, port), Handler)
+
+
+def main(argv=None) -> int:
+    obs_log.configure()
+    ap = argparse.ArgumentParser(description="fleet router "
+                                 "(docs/serving-fleet.md)")
+    ap.add_argument("--port", type=int, default=8002)
+    ap.add_argument("--host", default="0.0.0.0")
+    ap.add_argument("--replicas", required=True,
+                    help="comma-separated replica base urls")
+    ap.add_argument("--hedge-ms", type=float, default=None,
+                    help="hedge delay for straggling /report primaries "
+                         "(0/unset = off; REPORTER_HEDGE_MS overrides)")
+    args = ap.parse_args(argv)
+    urls = [u.strip() for u in args.replicas.split(",") if u.strip()]
+    router = FleetRouter(urls, hedge_ms=args.hedge_ms)
+    router.start()
+    httpd = router.make_server(args.host, args.port)
+    log.info("fleet router on %s:%d over %d replicas",
+             args.host, args.port, len(urls))
+
+    import signal
+
+    def _stop(signum, frame):
+        try:
+            signal.signal(signum, signal.SIG_DFL)
+        except (ValueError, OSError):  # pragma: no cover
+            pass
+        threading.Thread(target=httpd.shutdown, daemon=True).start()
+
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        try:
+            signal.signal(sig, _stop)
+        except ValueError:  # pragma: no cover
+            pass
+    try:
+        httpd.serve_forever()
+    finally:
+        router.stop()
+        httpd.server_close()
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

@@ -31,6 +31,7 @@ import json
 import logging
 import os
 import queue
+import socket as _socket
 import threading
 import time as _time
 from concurrent.futures import Future
@@ -38,6 +39,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import List, Optional, Tuple
 from urllib.parse import parse_qs, urlsplit
 
+from .. import faults
 from ..matching import MatcherConfig, SegmentMatcher
 from ..obs import flight as obs_flight
 from ..obs import log as obs_log
@@ -136,6 +138,16 @@ C_REATTACH = obs.counter(
     "reporter_engine_reattach_total",
     "Successful engine re-attach events after degraded-mode probes found "
     "the device healthy again")
+# graceful-drain surfaces (docs/serving-fleet.md): the router reads the
+# drain off /health; these make the same lifecycle visible on /metrics
+G_DRAINING = obs.gauge(
+    "reporter_draining",
+    "1 from SIGTERM (drain start) until the process exits: new work is "
+    "refused 503 \"draining\" while inflight requests finish")
+C_DRAIN_REFUSED = obs.counter(
+    "reporter_drain_refused_total",
+    "Requests refused 503 \"draining\" after drain start (retryable: the "
+    "router re-dispatches them to a live replica)")
 
 
 class Overloaded(RuntimeError):
@@ -720,11 +732,55 @@ class ReporterService:
         self._counter_lock = threading.Lock()
         self._n_requests = 0
         self._n_errors = 0
-        # graceful-shutdown drain: once True, every handler closes its
-        # connection after the in-flight request, so server_close's join
-        # of non-daemon handler threads is bounded by one request even for
-        # clients actively streaming keep-alive requests (ADVICE r04)
+        # graceful-shutdown drain (docs/serving-fleet.md): once True, new
+        # /report and /trace_attributes_batch requests are refused 503
+        # {"status": "draining"} (+Retry-After — the router re-dispatches
+        # them), /health answers 503 "draining" so the router rotates
+        # traffic off, inflight requests run to completion, and every
+        # handler closes its connection after its in-flight request so
+        # server_close's join of non-daemon handler threads stays bounded
+        # even for clients actively streaming keep-alive requests
         self.draining = False
+        # inflight /report + /trace_attributes_batch handler count: the
+        # drain loop in serve/__main__ waits on this before shutting the
+        # listener down, which is what "finish inflight batches" means
+        self._active_lock = threading.Lock()
+        self._n_active = 0
+        # stable replica identity, echoed as X-Reporter-Replica on EVERY
+        # response: the router's affinity bookkeeping and loadgen's
+        # per-replica distribution both key on it.  REPORTER_REPLICA_ID
+        # pins it (tools/fleet.py does); the default is unique per process
+        # and stable for its lifetime.
+        self.replica_id = (
+            os.environ.get("REPORTER_REPLICA_ID", "").strip()
+            or "%s-%d" % (_socket.gethostname()[:32], os.getpid()))
+
+    def begin_drain(self) -> None:
+        """Enter graceful drain (idempotent): refuse new matching work,
+        flip /health to 503 "draining", keep finishing inflight requests.
+        serve/__main__ calls this on the first SIGTERM."""
+        if self.draining:
+            return
+        self.draining = True
+        G_DRAINING.set(1)
+        obs_log.event(log, "drain_begin", level=logging.WARNING,
+                      replica=self.replica_id)
+
+    @contextlib.contextmanager
+    def _track_active(self):
+        with self._active_lock:
+            self._n_active += 1
+        try:
+            yield
+        finally:
+            with self._active_lock:
+                self._n_active -= 1
+
+    def idle(self) -> bool:
+        """No /report or /trace_attributes_batch handler is inflight (the
+        drain loop's exit condition; admission is already closed)."""
+        with self._active_lock:
+            return self._n_active == 0
 
     def attach_matcher(self, matcher: SegmentMatcher) -> None:
         """Bring a deferred service live: resolve the report threshold and
@@ -883,6 +939,12 @@ class ReporterService:
         span.meta.setdefault("endpoint", "report")
         if isinstance(trace, dict) and trace.get("uuid") is not None:
             span.meta.setdefault("uuid", str(trace["uuid"])[:64])
+        if self.draining:
+            C_DRAIN_REFUSED.inc()
+            span.fail("draining", status="draining")
+            self._terminal("report", 503, span)
+            return 503, {"error": "draining", "status": "draining",
+                         "retry_after": 1}
         batcher = self.batcher
         if batcher is None:
             span.fail("service initialising", status="unavailable")
@@ -999,10 +1061,34 @@ class ReporterService:
                 "status": "unhealthy",
                 "reason": self.unhealthy_reason
                 or (b._crash_reason if b is not None else None),
+                "replica": self.replica_id,
+                "uptime_s": round(_time.time() - self._t_boot, 1),
+            }
+        # chaos seam: a flapping health probe (docs/serving-fleet.md) —
+        # the router's streak thresholds must debounce it
+        if faults.fire("health_flap") is not None:
+            return 503, {
+                "status": "unhealthy",
+                "reason": "injected health flap",
+                "replica": self.replica_id,
+                "uptime_s": round(_time.time() - self._t_boot, 1),
+            }
+        if self.draining:
+            # SAME code as unhealthy (a generic orchestrator needs only
+            # the 503), DIFFERENT status: the router treats draining as
+            # "rotate traffic off, the exit is deliberate" — no passive
+            # ejection, no restart
+            with self._active_lock:
+                inflight = self._n_active
+            return 503, {
+                "status": "draining",
+                "replica": self.replica_id,
+                "inflight": inflight,
                 "uptime_s": round(_time.time() - self._t_boot, 1),
             }
         return 200, {
             "status": "ok",
+            "replica": self.replica_id,
             "degraded": bool(self.degraded),
             # True while boot-time work is still in flight: backend init +
             # engine build (matcher fields below are null until attached)
@@ -1028,6 +1114,12 @@ class ReporterService:
         # the report loop
         span = obs_trace.current_span() or Span("trace_attributes_batch")
         span.meta.setdefault("endpoint", "trace_attributes_batch")
+        if self.draining:
+            C_DRAIN_REFUSED.inc()
+            span.fail("draining", status="draining")
+            self._terminal("trace_attributes_batch", 503, span)
+            return 503, {"error": "draining", "status": "draining",
+                         "retry_after": 1}
         batcher = self.batcher
         if batcher is None:
             span.fail("service initialising", status="unavailable")
@@ -1122,6 +1214,8 @@ class ReporterService:
         b = self.batcher
         return 200, {
             "uptime_s": round(_time.time() - self._t_boot, 1),
+            "replica": self.replica_id,
+            "draining": bool(self.draining),
             "warming": bool(getattr(self, "warming", False)) or m is None,
             "backend": m.backend if m else None,
             "viterbi_kernel": getattr(m, "_kernel_mode", None) if m else None,
@@ -1283,6 +1377,11 @@ class ReporterService:
                         ra = 1
                     self.send_header("Retry-After", str(ra))
                 self._echo_trace_header()
+                # the stable replica id rides EVERY response (including
+                # errors and drain refusals): the router's affinity
+                # bookkeeping and loadgen's per-replica distribution key
+                # on it (docs/serving-fleet.md)
+                self.send_header("X-Reporter-Replica", service.replica_id)
                 self.end_headers()
                 self.wfile.write(body)
 
@@ -1294,6 +1393,7 @@ class ReporterService:
                     "Content-Type", "text/plain; version=0.0.4; charset=utf-8")
                 self.send_header("Content-Length", str(len(body)))
                 self._echo_trace_header()
+                self.send_header("X-Reporter-Replica", service.replica_id)
                 self.end_headers()
                 self.wfile.write(body)
 
@@ -1351,6 +1451,12 @@ class ReporterService:
                         return self._answer(
                             400, {"error": "Try a valid action: %s" % sorted(ACTIONS)}
                         )
+                    if action in ("report", "trace_attributes_batch"):
+                        # chaos seam: a slow-ACCEPTING replica — matching
+                        # work stalls at the door while /health stays
+                        # snappy, exactly the straggler shape the
+                        # router's hedging races (docs/serving-fleet.md)
+                        faults.hang("replica_slow_accept")
                     if action == "health":  # no payload required
                         self._drain_body(post)
                         return self._answer(*service.handle_health())
@@ -1431,7 +1537,10 @@ class ReporterService:
                         kw = {}
                         if deadline is not None:
                             kw["deadline"] = deadline
-                        with obs_trace.bind(span):
+                        # _track_active: the drain loop (serve/__main__)
+                        # waits for this count to reach zero before the
+                        # listener closes — inflight work always finishes
+                        with service._track_active(), obs_trace.bind(span):
                             if action == "report":
                                 # ?debug=1 opts the breakdown onto the
                                 # response
@@ -1445,6 +1554,14 @@ class ReporterService:
                     log.exception("unhandled request error")
                     code, out = 500, {"error": str(e)}
                 self._answer(code, out)
+
+            def setup(self):
+                super().setup()
+                self.server._track(self.connection)
+
+            def finish(self):
+                self.server._untrack(self.connection)
+                super().finish()
 
             def do_GET(self):
                 if gate is None:
@@ -1477,6 +1594,34 @@ class ReporterService:
             # concurrent clients (the micro-batcher's whole operating
             # point) overflows it and the kernel RSTs the excess connects
             request_queue_size = 128
+
+            def __init__(self, *a, **kw):
+                super().__init__(*a, **kw)
+                self._conn_lock = threading.Lock()
+                self._conns: set = set()
+
+            def _track(self, sock) -> None:
+                with self._conn_lock:
+                    self._conns.add(sock)
+
+            def _untrack(self, sock) -> None:
+                with self._conn_lock:
+                    self._conns.discard(sock)
+
+            def close_lingering(self) -> None:
+                """Half-close every tracked connection: a graceful drain
+                must not wait out the 30 s idle timeout of keep-alive
+                clients (the router's pooled sockets!) before
+                server_close's non-daemon handler join can return.
+                Called AFTER the inflight count drains to zero, so only
+                idle connections are left to cut."""
+                with self._conn_lock:
+                    conns = list(self._conns)
+                for sock in conns:
+                    try:
+                        sock.shutdown(_socket.SHUT_RDWR)
+                    except OSError:
+                        pass
 
         return Server((host, port), Handler)
 

@@ -18,7 +18,6 @@ import json
 import logging
 import time as _time
 import urllib.error
-import urllib.request
 from typing import List, Optional, Sequence
 
 from .. import faults
@@ -26,6 +25,7 @@ from ..obs import metrics as obs
 from ..obs import trace as obs_trace
 from ..obs.quantile import SLO_BUCKETS_S
 from ..utils import retry
+from ..utils.httppool import POOL, raise_for_status
 
 log = logging.getLogger(__name__)
 
@@ -56,23 +56,26 @@ def _post_json(url: str, payload: dict, timeout: float = TIMEOUT_SEC) -> Optiona
     # echoes it, so a failed or slow request is findable in the server's
     # flight recorder (GET /debug/traces) from the client log alone
     trace_id = obs_trace.current_trace_id() or obs_trace.new_trace_id()
-    req = urllib.request.Request(
-        url, data=body, method="POST",
-        headers={"Content-Type": "application/json",
-                 "X-Reporter-Trace": trace_id},
-    )
+    headers = {"Content-Type": "application/json",
+               "X-Reporter-Trace": trace_id}
 
     def _do():
         # chaos seam: a connection reset mid-flight, the failure mode a
         # flaky LB/sidecar hands this client (docs/robustness.md)
         if faults.fire("client_post") is not None:
             raise ConnectionResetError("injected connection reset")
-        with urllib.request.urlopen(req, timeout=timeout) as resp:
-            echoed = resp.headers.get("X-Reporter-Trace")
-            if echoed and echoed != trace_id:
-                log.debug("matcher echoed foreign trace id %s (sent %s)",
-                          echoed, trace_id)
-            return json.loads(resp.read().decode("utf-8"))
+        # keep-alive pool (utils/httppool.py): the stream tier POSTs every
+        # flush window to the same matcher — a fresh TCP connect per
+        # request was pure overhead; reuse is counted per target
+        status, rhdrs, rbody = POOL.request(
+            "POST", url, body=body, headers=headers, timeout=timeout,
+            target="matcher")
+        raise_for_status(url, status, rhdrs, rbody)
+        echoed = rhdrs.get("X-Reporter-Trace")
+        if echoed and echoed != trace_id:
+            log.debug("matcher echoed foreign trace id %s (sent %s)",
+                      echoed, trace_id)
+        return json.loads(rbody.decode("utf-8"))
 
     # the reference contract (HttpClient.java:80-88): 3 tries on a ~10 s
     # total budget, exponential backoff + full jitter, Retry-After honoured

@@ -63,13 +63,21 @@ def _retry_after_s(e: urllib.error.HTTPError) -> Optional[float]:
         return None
 
 
-def call_with_retries(do: Callable, target: str, retries: int = RETRIES,
-                      budget_s: float = BUDGET_S,
-                      base_s: Optional[float] = None):
-    """Run ``do()`` under the retry contract above; returns its value or
-    re-raises the last failure once attempts or the budget are exhausted
-    (callers keep their own error semantics — log-and-None for the matcher
-    client, raise-RuntimeError for the tile store)."""
+def call_with_failover(do: Callable[[int], object], target: str,
+                       retries: int = RETRIES, budget_s: float = BUDGET_S,
+                       base_s: Optional[float] = None,
+                       hold_429: bool = True):
+    """The retry contract above, with the attempt NUMBER passed to ``do``
+    so the caller can rotate endpoints between attempts — the serving
+    router's failover re-dispatch (serve/router.py) runs each attempt
+    against the next rendezvous-ranked replica under the same total
+    budget/backoff/Retry-After policy as a single-endpoint retry.
+
+    ``hold_429=False`` skips the backoff sleep on a 429/503 with a
+    Retry-After hint: the next attempt lands on a DIFFERENT endpoint, so
+    one replica's load hint must not stall the failover (the hint is
+    still surfaced to the caller via the final raised error when every
+    endpoint sheds)."""
     if base_s is None:
         try:
             base_s = float(os.environ.get("REPORTER_RETRY_BASE_S", BASE_S))
@@ -80,7 +88,7 @@ def call_with_retries(do: Callable, target: str, retries: int = RETRIES,
     cause = "network"
     for attempt in range(max(1, retries)):
         try:
-            return do()
+            return do(attempt)
         except urllib.error.HTTPError as e:
             if 400 <= e.code < 500 and e.code != 429:
                 C_GIVEUPS.labels(target, "4xx").inc()
@@ -88,6 +96,8 @@ def call_with_retries(do: Callable, target: str, retries: int = RETRIES,
             last = e
             cause = "429" if e.code == 429 else "5xx"
             hinted = _retry_after_s(e)
+            if not hold_429:
+                hinted = None
         except Exception as e:  # URLError, timeouts, resets
             last = e
             cause = "network"
@@ -105,3 +115,15 @@ def call_with_retries(do: Callable, target: str, retries: int = RETRIES,
     C_GIVEUPS.labels(target, cause).inc()
     assert last is not None
     raise last
+
+
+def call_with_retries(do: Callable, target: str, retries: int = RETRIES,
+                      budget_s: float = BUDGET_S,
+                      base_s: Optional[float] = None):
+    """Run ``do()`` under the retry contract above; returns its value or
+    re-raises the last failure once attempts or the budget are exhausted
+    (callers keep their own error semantics — log-and-None for the matcher
+    client, raise-RuntimeError for the tile store)."""
+    return call_with_failover(lambda _attempt: do(), target,
+                              retries=retries, budget_s=budget_s,
+                              base_s=base_s)

@@ -67,7 +67,19 @@ EOF
 python -m reporter_tpu.serve "$WORK/config.json" "127.0.0.1:$PORT" \
     > "$WORK/serve.log" 2>&1 &
 SERVE_PID=$!
-trap 'kill $SERVE_PID 2>/dev/null || true' EXIT
+# trap-based cleanup on EVERY exit path, with SIGKILL escalation: a
+# failed leg must not strand the listener to poison later CI legs on the
+# same runner
+cleanup() {
+    kill "$SERVE_PID" 2>/dev/null || true
+    for _ in $(seq 1 20); do
+        kill -0 "$SERVE_PID" 2>/dev/null || break
+        sleep 0.5
+    done
+    kill -9 "$SERVE_PID" 2>/dev/null || true
+    wait "$SERVE_PID" 2>/dev/null || true
+}
+trap cleanup EXIT
 
 UP=0
 for _ in $(seq 1 120); do

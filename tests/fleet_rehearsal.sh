@@ -1,0 +1,228 @@
+#!/usr/bin/env bash
+# Fleet chaos gating rehearsal (the CI `fleet-rehearsal` leg; runnable
+# locally): tools/fleet.py boots 3 serve replicas behind the session-
+# affine router (serve/router.py), tools/loadgen.py replays an open-loop
+# synth fleet against the ROUTER, and mid-replay the fleet is abused the
+# way production abuses it:
+#
+#   t+8s   one replica is SIGKILLed (no drain, no warning) — the
+#          supervisor respawns it, the router fails its traffic over
+#   t+16s  SIGUSR1 triggers a rolling restart (each replica gracefully
+#          drained, respawned, waited healthy, one at a time)
+#
+# and the verdict must still hold:
+#
+#   1. loadgen's SLO verdict passes (rc 0): availability + p99 met over
+#      the WHOLE run, kill and restarts included
+#   2. zero non-shed client errors after the failover window: every
+#      sample outside [kill, kill+2s) is 200/429/503 — a lost replica
+#      may shed, it may NOT surface 5xx/resets/timeouts to clients
+#   3. the affinity remap is confined: between the kill and the rolling
+#      restart, the ONLY vehicles that changed replica are the ones the
+#      dead replica owned (rendezvous hashing's promise, measured from
+#      the X-Reporter-Replica echoes in the per-sample dump)
+#
+# Usage: tests/fleet_rehearsal.sh [workdir]
+set -euo pipefail
+
+cd "$(dirname "$0")/.."
+export PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}"
+export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+# snappy failover in the router's retry loop (the default backoff base is
+# tuned for WAN egress, not a localhost rehearsal)
+export REPORTER_RETRY_BASE_S="${REPORTER_RETRY_BASE_S:-0.05}"
+# replicas 2..N replay replica 1's XLA compiles instead of redoing them
+WORK="${1:-$(mktemp -d /tmp/reporter-fleet.XXXXXX)}"
+mkdir -p "$WORK"
+export REPORTER_XLA_CACHE_DIR="$WORK/xla-cache"
+ROUTER_PORT=18071
+BASE_PORT=18072
+echo "fleet rehearsal workdir: $WORK"
+
+# ---- trap-based cleanup: NO exit path may strand a listener ---------------
+FLEET_PID=""
+cleanup() {
+    if [ -n "$FLEET_PID" ] && kill -0 "$FLEET_PID" 2>/dev/null; then
+        kill "$FLEET_PID" 2>/dev/null || true
+        for _ in $(seq 1 40); do
+            kill -0 "$FLEET_PID" 2>/dev/null || break
+            sleep 0.5
+        done
+        kill -9 "$FLEET_PID" 2>/dev/null || true
+    fi
+    # belt-and-braces: any replica/router pid still in the state file
+    if [ -f "$WORK/fleet.json" ]; then
+        python - "$WORK/fleet.json" <<'EOF' 2>/dev/null || true
+import json, os, signal, sys
+state = json.load(open(sys.argv[1]))
+pids = [state.get("router", {}).get("pid")] + [
+    r.get("pid") for r in state.get("replicas", [])]
+for pid in pids:
+    if pid:
+        try:
+            os.kill(pid, signal.SIGKILL)
+        except OSError:
+            pass
+EOF
+    fi
+}
+trap cleanup EXIT
+
+# ---- config (grid must match loadgen --grid; one length bucket keeps the
+# --warmup grid small enough for CI) ----------------------------------------
+cat > "$WORK/config.json" <<EOF
+{
+  "network": {"type": "grid", "rows": 8, "cols": 8, "spacing_m": 200},
+  "matcher": {"sigma_z": 4.07, "beta": 3.0, "search_radius": 50.0,
+              "length_buckets": [16],
+              "warmup_batch_sizes": [1, 4, 16, 64]},
+  "backend": "jax",
+  "batch": {"max_batch": 64, "max_wait_ms": 5}
+}
+EOF
+
+# ---- boot the fleet -------------------------------------------------------
+python tools/fleet.py --config "$WORK/config.json" --replicas 3 \
+    --base-port "$BASE_PORT" --router-port "$ROUTER_PORT" \
+    --workdir "$WORK" --warmup --cpu-default --drain-grace 20 \
+    > "$WORK/fleet.log" 2>&1 &
+FLEET_PID=$!
+
+if ! python - <<EOF
+import json, sys, time, urllib.request
+
+def up(url, need_backend):
+    try:
+        h = json.load(urllib.request.urlopen(url + "/health", timeout=2))
+    except Exception:
+        return False
+    if need_backend:
+        # deferred boot answers 200 while the engine is still attaching:
+        # readiness for the LOAD run is an attached backend, else the
+        # replay's head just measures "service initialising" 503s
+        return h.get("status") == "ok" and bool(h.get("backend"))
+    return h.get("available") == 3
+
+deadline = time.monotonic() + 600
+replicas = ["http://127.0.0.1:%d" % ($BASE_PORT + i) for i in range(3)]
+while time.monotonic() < deadline:
+    if (all(up(u, True) for u in replicas)
+            and up("http://127.0.0.1:$ROUTER_PORT", False)):
+        sys.exit(0)
+    time.sleep(1)
+sys.exit(1)
+EOF
+then
+    echo "FAIL: fleet never reached 3 available replicas; fleet log tail:"
+    tail -30 "$WORK/fleet.log"
+    for f in "$WORK"/replica-*.log "$WORK"/router.log; do
+        echo "--- $f"; tail -10 "$f" 2>/dev/null || true
+    done
+    exit 1
+fi
+echo "fleet up: 3 replicas behind the router"
+
+# ---- open-loop replay against the ROUTER, chaos mid-load ------------------
+python tools/loadgen.py --url "http://127.0.0.1:$ROUTER_PORT" \
+    --rate 15 --duration 30 --vehicles 24 --points 48 --window 16 --grid 8 \
+    --seed 11 --concurrency 32 --timeout-s 8 \
+    --slo-availability 0.95 --slo-p99-ms 8000 \
+    --dump-samples "$WORK/samples.jsonl" \
+    --out "$WORK/loadgen_fleet.json" &
+LOADGEN_PID=$!
+
+sleep 8
+VICTIM_PID=$(python -c "
+import json; s = json.load(open('$WORK/fleet.json'))
+print(s['replicas'][1]['pid'])")
+KILL_EPOCH=$(python -c "import time; print(time.time())")
+kill -9 "$VICTIM_PID"
+echo "SIGKILLed replica rep-1 (pid $VICTIM_PID) at $KILL_EPOCH"
+
+sleep 8
+RESTART_EPOCH=$(python -c "import time; print(time.time())")
+kill -USR1 "$FLEET_PID"
+echo "rolling restart requested at $RESTART_EPOCH"
+
+set +e
+wait "$LOADGEN_PID"
+LOADGEN_RC=$?
+set -e
+if [ "$LOADGEN_RC" != 0 ]; then
+    echo "FAIL: loadgen rc $LOADGEN_RC — the fleet violated its SLO under"
+    echo "      a SIGKILL + rolling restart (artifact: loadgen_fleet.json)"
+    python -c "
+import json; a = json.load(open('$WORK/loadgen_fleet.json'))
+print(json.dumps({k: a[k] for k in ('status', 'quantiles', 'slo')}, indent=1))" \
+        2>/dev/null || true
+    exit 1
+fi
+echo "loadgen SLO verdict: PASS (rc 0) under kill + rolling restart"
+
+# ---- failover-window errors + affinity confinement ------------------------
+python - "$WORK" "$KILL_EPOCH" "$RESTART_EPOCH" <<'EOF'
+import json, sys
+
+work, kill_epoch, restart_epoch = sys.argv[1], float(sys.argv[2]), float(sys.argv[3])
+FAILOVER_WINDOW_S = 2.0
+rows = [json.loads(l) for l in open(work + "/samples.jsonl")]
+assert rows, "empty sample dump"
+
+# 1. zero non-shed client errors outside the failover window: a request
+# is allowed to be shed (429) or to see a drain/unavailable 503 (the
+# router retries those; a residue is shed-class), NEVER a 5xx/timeout
+allowed = {200, 429, 503}
+bad = [r for r in rows if r["code"] not in allowed
+       and not (kill_epoch <= r["sched_epoch"] < kill_epoch + FAILOVER_WINDOW_S)]
+assert not bad, (
+    "non-shed client errors outside the failover window: %r" % bad[:5])
+
+# 2. affinity remap confined to the SIGKILLed replica's vehicles,
+# measured between the kill (+failover window) and the rolling restart:
+# a vehicle "moved" if ANY of its phase-2 responses came from a replica
+# other than its pre-kill primary (the supervisor respawns the victim
+# fast, so a last-assignment view would under-measure the remap)
+phase1 = {}
+for r in sorted((r for r in rows if r["done_epoch"] < kill_epoch),
+                key=lambda r: r["done_epoch"]):
+    if r["replica"] and r["code"] == 200:
+        phase1[r["uuid"]] = r["replica"]
+phase2_rows = [r for r in rows
+               if kill_epoch + FAILOVER_WINDOW_S <= r["sched_epoch"]
+               and r["done_epoch"] < restart_epoch
+               and r["replica"] and r["code"] == 200]
+assert phase2_rows, "no samples between kill and rolling restart"
+dead = "rep-1"
+dead_vehicles = {u for u, rid in phase1.items() if rid == dead}
+assert dead_vehicles, "the killed replica owned no vehicles pre-kill?"
+moved = {r["uuid"] for r in phase2_rows
+         if r["uuid"] in phase1 and r["replica"] != phase1[r["uuid"]]}
+stray = moved - dead_vehicles
+assert not stray, (
+    "vehicles moved that the dead replica never owned: %r "
+    "(affinity remap not confined)" % sorted(stray)[:10])
+assert moved, ("the dead replica's vehicles never landed elsewhere "
+               "during its downtime — remap not measured")
+
+dist = {}
+for r in rows:
+    if r["replica"]:
+        dist[r["replica"]] = dist.get(r["replica"], 0) + 1
+print("failover window clean; %d/%d of the dead replica's vehicles "
+      "remapped, 0 stray moves; per-replica distribution: %s"
+      % (len(moved), len(dead_vehicles), dict(sorted(dist.items()))))
+EOF
+
+# ---- graceful fleet drain: exit 0, nothing stranded -----------------------
+kill "$FLEET_PID"
+set +e
+wait "$FLEET_PID"
+FLEET_RC=$?
+set -e
+FLEET_PID=""
+if [ "$FLEET_RC" != 0 ]; then
+    echo "FAIL: fleet supervisor exited rc $FLEET_RC on drain; log tail:"
+    tail -30 "$WORK/fleet.log"
+    exit 1
+fi
+echo "fleet rehearsal OK (artifacts in $WORK)"

@@ -34,6 +34,26 @@ PORT=18061
 PORT2=18062
 echo "slo rehearsal workdir: $WORK"
 
+# trap-based cleanup covering EVERY spawned server on EVERY exit path: a
+# failed leg must not strand a listener that poisons later CI legs on the
+# same runner (the old single-variable trap only covered the most recent
+# server, and never escalated past SIGTERM)
+PIDS=()
+cleanup() {
+    for pid in "${PIDS[@]}"; do
+        kill "$pid" 2>/dev/null || true
+    done
+    for pid in "${PIDS[@]}"; do
+        for _ in $(seq 1 20); do
+            kill -0 "$pid" 2>/dev/null || break
+            sleep 0.5
+        done
+        kill -9 "$pid" 2>/dev/null || true
+        wait "$pid" 2>/dev/null || true
+    done
+}
+trap cleanup EXIT
+
 # one length bucket (every loadgen window is 16 points) keeps the warmup
 # grid small enough that --warmup boots in CI time
 cat > "$WORK/config.json" <<EOF
@@ -75,7 +95,7 @@ echo "== leg 1: no-fault (warmed serve, verdicts must agree) =="
 python -m reporter_tpu.serve --warmup "$WORK/config.json" "127.0.0.1:$PORT" \
     > "$WORK/serve_nofault.log" 2>&1 &
 SERVE_PID=$!
-trap 'kill $SERVE_PID 2>/dev/null || true' EXIT
+PIDS+=("$SERVE_PID")
 if ! wait_up "$PORT" 240; then
     echo "FAIL: no-fault service never came up; tail of serve log:"
     tail -20 "$WORK/serve_nofault.log"
@@ -102,6 +122,7 @@ REPORTER_FAULT_DEVICE_HANG="2.5" \
 python -m reporter_tpu.serve "$WORK/config.json" "127.0.0.1:$PORT2" \
     > "$WORK/serve_hang.log" 2>&1 &
 SERVE_PID=$!
+PIDS+=("$SERVE_PID")
 if ! wait_up "$PORT2" 240; then
     echo "FAIL: hang-leg service never came up; tail of serve log:"
     tail -20 "$WORK/serve_hang.log"

@@ -204,8 +204,8 @@ def test_scheduled_time_latency_not_response_gap(loadgen):
     try:
         reqs = [{"uuid": "v", "trace": [], "match_options": {}}] * 10
         sched = [i / 50.0 for i in range(10)]
-        samples = loadgen.run_load(stub.url + "/report", reqs, sched,
-                                   concurrency=1, timeout_s=10.0)
+        samples, _t0 = loadgen.run_load(stub.url + "/report", reqs, sched,
+                                        concurrency=1, timeout_s=10.0)
     finally:
         stub.close()
     assert len(samples) == 10
@@ -268,8 +268,8 @@ def test_loadgen_reports_device_hang_tail(loadgen, monkeypatch):
         faults.reset()
         reqs = [dict(body) for _ in range(15)]
         sched = [i / 30.0 for i in range(15)]
-        samples = loadgen.run_load(url + "/report", reqs, sched,
-                                   concurrency=2, timeout_s=30.0)
+        samples, _t0 = loadgen.run_load(url + "/report", reqs, sched,
+                                        concurrency=2, timeout_s=30.0)
     finally:
         httpd.shutdown()
         monkeypatch.delenv("REPORTER_FAULT_DEVICE_HANG", raising=False)

@@ -1,0 +1,307 @@
+#!/usr/bin/env python3
+"""Fleet supervisor: N serve replicas + the session-affine router, as one
+process tree (docs/serving-fleet.md).
+
+Spawns each replica (`python -m reporter_tpu.serve`) on its own port with
+a pinned `REPORTER_REPLICA_ID`, the router (`python -m
+reporter_tpu.serve.router`) in front of them, monitors every child, and
+restarts any that dies unexpectedly — replicas are cattle; the
+supervisor's restart loop is the herd's continuity.  A state file
+(`<workdir>/fleet.json`) always holds the live pids/urls so an external
+harness (tests/fleet_rehearsal.sh) can SIGKILL a specific replica and
+watch the fleet absorb it.
+
+Lifecycle signals (to THIS process):
+
+  SIGUSR1   rolling restart: each replica in turn is SIGTERM'd (graceful
+            drain — the router rotates traffic off via /health before
+            the process dies), waited to exit 0, respawned, and waited
+            healthy before the next one is touched.  Zero non-shed
+            client errors is the contract the rehearsal gates.
+  SIGTERM / SIGINT
+            drain the whole fleet: router first (stop admitting), then
+            every replica, wait for clean exits, exit 0.
+
+Usage:
+    python tools/fleet.py --config service.json --replicas 3 \
+        --base-port 19010 --router-port 19009 --workdir /tmp/fleet \
+        [--warmup] [--rolling-restart-after 20]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+log = logging.getLogger("fleet")
+
+
+def wait_healthy(url: str, timeout_s: float, want_status: str = "ok") -> bool:
+    """Poll /health until it answers 200 with the wanted status (and, for
+    replicas, an attached backend) or the timeout lapses."""
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        try:
+            with urllib.request.urlopen(url + "/health", timeout=2) as r:
+                h = json.loads(r.read().decode())
+            if h.get("status") == want_status and (
+                    h.get("role") == "router" or h.get("backend")):
+                return True
+        except Exception:  # noqa: BLE001 - not up yet
+            pass
+        time.sleep(0.5)
+    return False
+
+
+class Child:
+    """One supervised process (replica or router)."""
+
+    def __init__(self, name: str, cmd, env: dict, log_path: str, url: str):
+        self.name = name
+        self.cmd = cmd
+        self.env = env
+        self.log_path = log_path
+        self.url = url
+        self.proc: subprocess.Popen = None
+        self.restarts = 0
+        self.expected_exit = False  # set around intentional drains
+
+    def spawn(self) -> None:
+        logf = open(self.log_path, "ab")
+        self.proc = subprocess.Popen(
+            self.cmd, env=self.env, stdout=logf, stderr=subprocess.STDOUT)
+        logf.close()
+        self.expected_exit = False
+        log.info("%s: pid %d on %s", self.name, self.proc.pid, self.url)
+
+    def alive(self) -> bool:
+        return self.proc is not None and self.proc.poll() is None
+
+    def drain(self, grace_s: float) -> int:
+        """SIGTERM and wait for the graceful-drain exit; SIGKILL past the
+        grace.  Returns the exit code."""
+        self.expected_exit = True
+        if not self.alive():
+            return self.proc.returncode if self.proc else 0
+        self.proc.send_signal(signal.SIGTERM)
+        try:
+            return self.proc.wait(timeout=grace_s)
+        except subprocess.TimeoutExpired:
+            log.warning("%s: drain grace %.1fs expired; SIGKILL",
+                        self.name, grace_s)
+            self.proc.kill()
+            return self.proc.wait()
+
+
+class Fleet:
+    def __init__(self, args):
+        self.args = args
+        self.workdir = os.path.abspath(args.workdir)
+        os.makedirs(self.workdir, exist_ok=True)
+        self.host = args.host
+        base = os.environ.copy()
+        if args.cpu_default:
+            base.setdefault("JAX_PLATFORMS", "cpu")
+        self.replicas = []
+        serve_cmd = [sys.executable, "-m", "reporter_tpu.serve"]
+        if args.warmup:
+            serve_cmd.append("--warmup")
+        for i in range(args.replicas):
+            port = args.base_port + i
+            env = dict(base)
+            env["REPORTER_REPLICA_ID"] = "rep-%d" % i
+            self.replicas.append(Child(
+                "rep-%d" % i,
+                serve_cmd + [args.config, "%s:%d" % (self.host, port)],
+                env, os.path.join(self.workdir, "replica-%d.log" % i),
+                "http://%s:%d" % (self.host, port)))
+        urls = ",".join(c.url for c in self.replicas)
+        self.router = Child(
+            "router",
+            [sys.executable, "-m", "reporter_tpu.serve.router",
+             "--host", self.host, "--port", str(args.router_port),
+             "--replicas", urls],
+            dict(base), os.path.join(self.workdir, "router.log"),
+            "http://%s:%d" % (self.host, args.router_port))
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._rolling = threading.Event()
+
+    # -- state file ---------------------------------------------------------
+
+    def write_state(self) -> None:
+        state = {
+            "router": {"url": self.router.url,
+                       "pid": self.router.proc.pid if self.router.proc else None},
+            "replicas": [
+                {"id": "rep-%d" % i, "url": c.url,
+                 "pid": c.proc.pid if c.proc else None,
+                 "restarts": c.restarts, "log": c.log_path}
+                for i, c in enumerate(self.replicas)],
+        }
+        path = os.path.join(self.workdir, "fleet.json")
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(state, f, indent=1)
+        os.replace(tmp, path)
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def boot(self) -> bool:
+        for c in self.replicas:
+            c.spawn()
+        self.router.spawn()
+        self.write_state()
+        for c in self.replicas:
+            if not wait_healthy(c.url, self.args.up_timeout):
+                log.error("%s never became healthy (see %s)",
+                          c.name, c.log_path)
+                return False
+        if not wait_healthy(self.router.url, 30.0):
+            log.error("router never became healthy (see %s)",
+                      self.router.log_path)
+            return False
+        log.info("fleet up: %d replicas behind %s",
+                 len(self.replicas), self.router.url)
+        return True
+
+    def rolling_restart(self) -> bool:
+        """Restart every replica one at a time, gracefully: drain (the
+        router rotates traffic off via the 503-draining /health), wait
+        exit 0, respawn, wait healthy, move on.  The fleet never has
+        more than one replica out at once."""
+        ok = True
+        for c in self.replicas:
+            if self._stop.is_set():
+                break
+            log.info("rolling restart: draining %s", c.name)
+            rc = c.drain(self.args.drain_grace + 10.0)
+            if rc != 0:
+                log.error("%s exited %s during rolling drain", c.name, rc)
+                ok = False
+            with self._lock:
+                c.restarts += 1
+                c.spawn()
+                self.write_state()
+            if not wait_healthy(c.url, self.args.up_timeout):
+                log.error("%s did not come back healthy", c.name)
+                ok = False
+                break
+        log.info("rolling restart %s", "complete" if ok else "FAILED")
+        return ok
+
+    def monitor(self) -> None:
+        """Respawn unexpected deaths (crash-only replicas are the fault
+        posture: the router keeps serving around the hole while the
+        supervisor refills it)."""
+        while not self._stop.wait(0.5):
+            if self._rolling.is_set():
+                continue  # the rolling-restart thread owns lifecycle now
+            with self._lock:
+                for c in self.replicas + [self.router]:
+                    if c.proc is not None and not c.alive() \
+                            and not c.expected_exit:
+                        rc = c.proc.returncode
+                        log.warning("%s died rc=%s; respawning", c.name, rc)
+                        c.restarts += 1
+                        c.spawn()
+                        self.write_state()
+
+    def shutdown(self) -> int:
+        self._stop.set()
+        # router first: stop admitting new traffic, then drain replicas
+        self.router.drain(10.0)
+        rc = 0
+        for c in self.replicas:
+            code = c.drain(self.args.drain_grace + 10.0)
+            if code != 0:
+                log.error("%s exited %s on drain", c.name, code)
+                rc = 1
+        self.write_state()
+        return rc
+
+    def run(self) -> int:
+        # signal handlers BEFORE the (slow: warmup compiles) boot wait — a
+        # SIGUSR1 landing mid-boot must queue a rolling restart, not kill
+        # the supervisor with the default action
+        def _usr1(signum, frame):
+            if not self._rolling.is_set():
+                threading.Thread(target=self._rolling_once,
+                                 daemon=True, name="rolling").start()
+
+        def _term(signum, frame):
+            try:
+                signal.signal(signum, signal.SIG_DFL)
+            except (ValueError, OSError):
+                pass
+            self._stop.set()
+
+        signal.signal(signal.SIGUSR1, _usr1)
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            signal.signal(sig, _term)
+
+        if not self.boot():
+            self.shutdown()
+            return 2
+
+        mon = threading.Thread(target=self.monitor, daemon=True,
+                               name="fleet-monitor")
+        mon.start()
+        if self.args.rolling_restart_after > 0:
+            def _timed():
+                if not self._stop.wait(self.args.rolling_restart_after):
+                    self._rolling_once()
+            threading.Thread(target=_timed, daemon=True,
+                             name="rolling-timer").start()
+        while not self._stop.is_set():
+            time.sleep(0.2)
+        return self.shutdown()
+
+    def _rolling_once(self) -> None:
+        self._rolling.set()
+        try:
+            self.rolling_restart()
+        finally:
+            self._rolling.clear()
+
+
+def main(argv=None) -> int:
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s fleet %(levelname)s %(message)s")
+    ap = argparse.ArgumentParser(
+        description=__doc__.splitlines()[0],
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--config", required=True, help="serve config json")
+    ap.add_argument("--replicas", type=int, default=3)
+    ap.add_argument("--base-port", type=int, default=19010)
+    ap.add_argument("--router-port", type=int, default=19009)
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--workdir", default="/tmp/reporter-fleet")
+    ap.add_argument("--warmup", action="store_true",
+                    help="boot each replica with --warmup (share "
+                         "REPORTER_XLA_CACHE_DIR so replicas 2..N replay "
+                         "replica 1's compiles)")
+    ap.add_argument("--up-timeout", type=float, default=240.0,
+                    help="seconds to wait for a replica to become healthy")
+    ap.add_argument("--drain-grace", type=float, default=30.0,
+                    help="seconds a draining replica gets before SIGKILL")
+    ap.add_argument("--rolling-restart-after", type=float, default=0.0,
+                    help="schedule ONE rolling restart this many seconds "
+                         "after boot (0 = only on SIGUSR1)")
+    ap.add_argument("--cpu-default", action="store_true",
+                    help="default children to JAX_PLATFORMS=cpu when unset")
+    args = ap.parse_args(argv)
+    return Fleet(args).run()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -50,7 +50,6 @@ import random
 import sys
 import threading
 import time
-import urllib.error
 import urllib.request
 from typing import Dict, List, Optional, Tuple
 
@@ -58,6 +57,11 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from reporter_tpu.obs.quantile import SLO_BUCKETS_S, bucket_index, cumulate, hist_quantile  # noqa: E402
 from reporter_tpu.obs.slo import Objective, SLOEngine  # noqa: E402
+from reporter_tpu.utils.httppool import HttpPool  # noqa: E402
+
+# keep-alive pool shared by every worker thread: an open-loop generator
+# that reconnects per request measures TCP handshakes, not the service
+_POOL = HttpPool(max_idle_per_host=64)
 
 MATCH_OPTIONS = {"mode": "auto", "report_levels": [0, 1],
                  "transition_levels": [0, 1]}
@@ -188,14 +192,21 @@ def timeline_schedule(requests: List[dict], warp: float) -> List[float]:
 # -- the open-loop run ------------------------------------------------------
 
 class Sample:
-    __slots__ = ("sched", "sent", "done", "code", "degraded")
+    __slots__ = ("sched", "sent", "done", "code", "degraded",
+                 "replica", "uuid")
 
-    def __init__(self, sched, sent, done, code, degraded):
+    def __init__(self, sched, sent, done, code, degraded,
+                 replica=None, uuid=None):
         self.sched = sched
         self.sent = sent
         self.done = done
         self.code = code
         self.degraded = degraded
+        # the X-Reporter-Replica id the answering replica echoed: the
+        # per-replica distribution and the fleet rehearsal's affinity
+        # assertions (tests/fleet_rehearsal.sh) key on it
+        self.replica = replica
+        self.uuid = uuid
 
     @property
     def latency_s(self) -> float:
@@ -211,35 +222,40 @@ class Sample:
         return self.done - self.sent
 
 
-def _post(url: str, body: bytes, timeout: float) -> Tuple[int, bool]:
-    req = urllib.request.Request(
-        url, data=body, method="POST",
-        headers={"Content-Type": "application/json"})
+def _post(url: str, body: bytes,
+          timeout: float) -> Tuple[int, bool, Optional[str]]:
     try:
-        with urllib.request.urlopen(req, timeout=timeout) as resp:
-            try:
-                degraded = bool(json.loads(resp.read().decode()).get("degraded"))
-            except (ValueError, UnicodeDecodeError):
-                degraded = False
-            return resp.status, degraded
-    except urllib.error.HTTPError as e:
-        e.read()
-        return e.code, False
+        status, hdrs, data = _POOL.request(
+            "POST", url, body=body,
+            headers={"Content-Type": "application/json"},
+            timeout=timeout, target="loadgen")
     except Exception:  # noqa: BLE001 - timeout/reset: code 0, still counted
-        return 0, False
+        return 0, False, None
+    replica = hdrs.get("X-Reporter-Replica")
+    degraded = False
+    if status == 200:
+        try:
+            degraded = bool(json.loads(data.decode()).get("degraded"))
+        except (ValueError, UnicodeDecodeError):
+            degraded = False
+    return status, degraded, replica
 
 
 def run_load(url: str, requests: List[dict], schedule: List[float],
-             concurrency: int = 32, timeout_s: float = 10.0) -> List[Sample]:
+             concurrency: int = 32,
+             timeout_s: float = 10.0) -> Tuple[List[Sample], float]:
     """Send every request at its scheduled offset (or as soon after as a
     worker frees up — the backlog then SHOWS in the recorded latency).
     The whole schedule is always drained: a hung server cannot make the
-    tail disappear by never being measured."""
+    tail disappear by never being measured.  Returns the samples plus the
+    wall-clock epoch of offset 0 (so a rehearsal script can correlate
+    sample offsets with externally-timed kill/restart events)."""
     bodies = [json.dumps(r, separators=(",", ":")).encode() for r in requests]
     samples: List[Optional[Sample]] = [None] * len(requests)
     it = {"i": 0}
     lock = threading.Lock()
     t0 = time.monotonic() + 0.05  # everyone references the same epoch
+    t0_epoch = time.time() + (t0 - time.monotonic())
 
     def worker():
         while True:
@@ -253,10 +269,11 @@ def run_load(url: str, requests: List[dict], schedule: List[float],
             if delay > 0:
                 time.sleep(delay)
             sent = time.monotonic()
-            code, degraded = _post(url, bodies[i], timeout_s)
+            code, degraded, replica = _post(url, bodies[i], timeout_s)
             done = time.monotonic()
             samples[i] = Sample(sched - t0, sent - t0, done - t0,
-                                code, degraded)
+                                code, degraded, replica=replica,
+                                uuid=requests[i].get("uuid"))
 
     threads = [threading.Thread(target=worker, daemon=True)
                for _ in range(max(1, concurrency))]
@@ -264,7 +281,7 @@ def run_load(url: str, requests: List[dict], schedule: List[float],
         t.start()
     for t in threads:
         t.join()
-    return [s for s in samples if s is not None]
+    return [s for s in samples if s is not None], t0_epoch
 
 
 # -- evaluation -------------------------------------------------------------
@@ -324,14 +341,20 @@ def step_stats(samples: List[Sample], offered_rate: float) -> dict:
     span = (max(s.done for s in samples) - min(s.sched for s in samples)
             if samples else 0.0)
     codes: Dict[str, int] = {}
+    replicas: Dict[str, int] = {}
     for s in samples:
         k = str(s.code) if s.code else "timeout"
         codes[k] = codes.get(k, 0) + 1
+        if s.replica:
+            replicas[s.replica] = replicas.get(s.replica, 0) + 1
     return {
         "n": len(samples),
         "offered_rps": round(offered_rate, 3),
         "achieved_rps": round(len(samples) / span, 3) if span > 0 else None,
         "status": dict(sorted(codes.items())),
+        # per-replica request distribution (X-Reporter-Replica echoes):
+        # the fleet rehearsal's affinity and failover assertions read this
+        "replicas": dict(sorted(replicas.items())),
         "degraded": sum(1 for s in samples if s.degraded),
         "quantiles": quantiles_ms(lats),
         # the flattering closed-loop number, kept ONLY so coordinated
@@ -404,6 +427,10 @@ def main(argv=None) -> int:
                     help="artifact provenance tag (cpu|tpu)")
     ap.add_argument("--out", default=None, help="artifact path (default "
                     "stdout)")
+    ap.add_argument("--dump-samples", default=None,
+                    help="write one JSONL row per request (uuid, replica, "
+                         "code, sched/done epoch) — the fleet rehearsal's "
+                         "affinity/failover assertions consume it")
     args = ap.parse_args(argv)
 
     rng = random.Random(args.seed)
@@ -442,6 +469,7 @@ def main(argv=None) -> int:
     objectives = objectives_from_args(args)
     steps_out = []
     all_samples: List[Sample] = []
+    dump_rows: List[dict] = []
     knee = None
     for rate in rates:
         if args.time_warp > 0 and not args.ramp:
@@ -457,12 +485,19 @@ def main(argv=None) -> int:
                 r.pop("_t0", None)
             schedule = build_schedule(n, rate, args.arrival, rng)
             offered = rate
-        samples = run_load(base + "/report", reqs, schedule,
-                           concurrency=args.concurrency,
-                           timeout_s=args.timeout_s)
+        samples, t0_epoch = run_load(base + "/report", reqs, schedule,
+                                     concurrency=args.concurrency,
+                                     timeout_s=args.timeout_s)
         if not samples:
             sys.stderr.write("loadgen: no samples recorded\n")
             return 2
+        if args.dump_samples:
+            dump_rows.extend(
+                {"uuid": s.uuid, "replica": s.replica, "code": s.code,
+                 "sched_epoch": round(t0_epoch + s.sched, 3),
+                 "done_epoch": round(t0_epoch + s.done, 3),
+                 "latency_s": round(s.latency_s, 4)}
+                for s in sorted(samples, key=lambda x: x.sched))
         st = step_stats(samples, offered)
         verdict = evaluate(samples, objectives,
                            window_s=max(60.0, schedule[-1] + 60.0))
@@ -507,6 +542,7 @@ def main(argv=None) -> int:
         "offered_rps": steps_out[-1]["offered_rps"],
         "achieved_rps": head["achieved_rps"],
         "status": head["status"],
+        "replica_distribution": head["replicas"],
         "degraded": head["degraded"],
         "quantiles": head["quantiles"],
         "service_time_quantiles": head["service_time_quantiles"],
@@ -524,6 +560,12 @@ def main(argv=None) -> int:
         "ramp": steps_out if args.ramp else None,
         "knee_rps": knee if args.ramp else None,
     }
+    if args.dump_samples:
+        with open(args.dump_samples, "w") as f:
+            for row in dump_rows:
+                f.write(json.dumps(row, separators=(",", ":")) + "\n")
+        sys.stderr.write("loadgen: %d sample rows -> %s\n"
+                         % (len(dump_rows), args.dump_samples))
     blob = json.dumps(artifact, indent=1)
     if args.out:
         with open(args.out, "w") as f:
