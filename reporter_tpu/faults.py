@@ -41,6 +41,14 @@ Points and spec grammar (value of ``REPORTER_FAULT_<POINT>``):
                 make the replica's /health answer 503 "unhealthy" while
                 armed — a flapping health probe the router's streak
                 thresholds must debounce
+  replica_shed  "N" | "always"
+                shed a /report at the replica's admission with 429 —
+                the canonical failover-MASKED failure: the replica
+                counts it against its own SLO budget while the fleet
+                router re-dispatches and the client sees a clean 200,
+                so the fleet-rehearsal's masking-debt assertion has a
+                deterministic fleet-good/replica-bad request
+                (docs/observability.md "Fleet observability")
 
 Counts are consumed per (point, spec) pair, so changing the spec re-arms
 the point and clearing the variable disarms it; ``reset()`` re-arms
@@ -66,7 +74,7 @@ C_INJECTED = obs.counter(
 
 POINTS = ("dispatch", "device_hang", "ubodt_probe", "store_put",
           "client_post", "router_connect", "replica_slow_accept",
-          "health_flap")
+          "health_flap", "replica_shed")
 
 _lock = threading.Lock()
 _consumed: dict = {}  # (point, raw_spec) -> times fired

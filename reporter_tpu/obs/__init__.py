@@ -26,6 +26,12 @@
               AND-gated alerting, fed from every terminal request
               outcome (GET /debug/slo, the /statusz burn line, the
               reporter_slo_* families)
+``federation``fleet metrics federation: per-replica snapshot pulls with
+              stale-labeled retention, the replica-labeled federated
+              Prometheus render (router GET /metrics), the client-truth
+              reporter_fleet_slo_* family bundle, and the masking-debt
+              gauge billing failover-hidden replica burn
+              (docs/observability.md "Fleet observability")
 """
 
 from .metrics import (  # noqa: F401

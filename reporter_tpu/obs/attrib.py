@@ -642,16 +642,22 @@ def last_onchip(repo: Optional[str] = None, refresh: bool = False):
 
 def archive(block: dict, platform: str, repo: Optional[str] = None) -> Optional[str]:
     """Write an attribution artifact under docs/measurements/ as
-    ``attrib_<platform>_<date>.json`` and return its repo-relative path.
-    The artifact deliberately carries no ``value`` key, so the
-    last_onchip() scan (platform "tpu" AND a headline value) can never
-    mistake it for a bench capture.  Returns None when the measurements
-    bank is absent (installed-package deployments)."""
+    ``attrib_<platform>_<date>[_<replica>].json`` and return its
+    repo-relative path.  The replica tag ($REPORTER_REPLICA_ID, when set)
+    keeps N fleet replicas sharing a checkout from clobbering one
+    another's same-day archives.  The artifact deliberately carries no
+    ``value`` key, so the last_onchip() scan (platform "tpu" AND a
+    headline value) can never mistake it for a bench capture.  Returns
+    None when the measurements bank is absent (installed-package
+    deployments)."""
     repo = repo or repo_root()
     d = os.path.join(repo, "docs", "measurements")
     if not os.path.isdir(d):
         return None
-    name = "attrib_%s_%s.json" % (platform, time.strftime("%Y-%m-%d"))
+    rid = re.sub(r"[^A-Za-z0-9._-]", "_",
+                 os.environ.get("REPORTER_REPLICA_ID", "").strip())
+    name = "attrib_%s_%s%s.json" % (platform, time.strftime("%Y-%m-%d"),
+                                    "_" + rid if rid else "")
     path = os.path.join(d, name)
     try:
         with open(path, "w") as f:

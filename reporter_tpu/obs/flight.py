@@ -9,6 +9,11 @@ decides retention AFTER the outcome is known:
     the serve tier marks budget-burning and tail-contributing requests
     per obs/slo.py, so a 200 that blew the latency objective is
     retained even when it sits under the generic slow threshold),
+  - every explicitly pinned span is kept (``span.meta["flight_keep"]``
+    — the fleet router marks its own multi-attempt/hedged hop spans AND
+    sends ``X-Reporter-Flight-Keep`` on re-dispatched replica legs, so
+    both sides of a failed-over request survive for cross-hop trace
+    stitching, docs/observability.md "Fleet observability"),
   - every span slower than the slow threshold is kept,
   - 1-in-N of the healthy rest is kept,
   - everything else only increments a counter.
@@ -30,14 +35,20 @@ Env knobs (all read at recorder construction):
   REPORTER_FLIGHT_CAPACITY      ring size per class (default 256)
   REPORTER_FLIGHT_SLOW_MS       slow-trace threshold (default 250)
   REPORTER_FLIGHT_SAMPLE_EVERY  keep 1-in-N healthy traces (default 10)
-  REPORTER_FLIGHT_DUMP          dump path ("" disables; default
-                                <tmpdir>/reporter_flight_<pid>.json)
+  REPORTER_FLIGHT_DUMP          dump path ("" disables; a DIRECTORY gets
+                                the default filename inside it — N
+                                replicas on one host can share one dump
+                                dir without clobbering each other).  The
+                                default filename embeds
+                                $REPORTER_REPLICA_ID when set, then the
+                                pid: reporter_flight_<replica>_<pid>.json
 """
 
 from __future__ import annotations
 
 import json
 import os
+import re
 import tempfile
 import threading
 from collections import deque
@@ -49,8 +60,21 @@ from .trace import Span
 C_FLIGHT = obs.counter(
     "reporter_flight_traces_total",
     "Flight-recorder tail-sampling decisions "
-    "(error / slo / slow / sampled / dropped)",
+    "(error / slo / pinned / slow / sampled / dropped)",
     ("decision",))
+
+_FILE_SAFE_RE = re.compile(r"[^A-Za-z0-9._-]")
+
+
+def default_dump_name() -> str:
+    """The per-process dump filename: replica-qualified so N replicas
+    sharing a host (or an explicit shared dump directory) never clobber
+    each other's shutdown dumps (the PR-9 fleet runs one process per
+    replica; pid alone vanishes on respawn, the replica id persists)."""
+    rid = _FILE_SAFE_RE.sub("_", os.environ.get("REPORTER_REPLICA_ID",
+                                                "").strip())
+    tag = ("%s_%d" % (rid, os.getpid())) if rid else str(os.getpid())
+    return "reporter_flight_%s.json" % tag
 
 
 def _env_int(name: str, default: int) -> int:
@@ -86,6 +110,8 @@ class FlightRecorder:
             decision = "error"
         elif span.meta.get("slo_violation"):
             decision = "slo"
+        elif span.meta.get("flight_keep"):
+            decision = "pinned"
         elif span.total_s * 1000.0 >= self.slow_ms:
             decision = "slow"
         else:
@@ -124,6 +150,16 @@ class FlightRecorder:
             merged.sort(key=lambda e: e.get("t_end", 0.0), reverse=True)
         return merged
 
+    def find(self, trace_id: str) -> List[dict]:
+        """Every retained entry for one trace_id, oldest first (the
+        cross-hop stitching read path: the router asks a replica for the
+        spans it retained under the shared id).  Lock-free like the other
+        read paths."""
+        out = [e for e in list(self._keep) + list(self._sampled)
+               if e.get("trace_id") == trace_id]
+        out.sort(key=lambda e: e.get("t_end", 0.0))
+        return out
+
     def summary(self) -> dict:
         return {
             "capacity": self.capacity,
@@ -135,14 +171,17 @@ class FlightRecorder:
 
     def dump(self, path: Optional[str] = None) -> Optional[str]:
         """Write retained traces to disk; returns the path, or None when
-        disabled (REPORTER_FLIGHT_DUMP="") or nothing was retained."""
+        disabled (REPORTER_FLIGHT_DUMP="") or nothing was retained.  A
+        directory path (explicit or via the env knob) gets the
+        replica-qualified default filename inside it."""
         if path is None:
             path = os.environ.get(
                 "REPORTER_FLIGHT_DUMP",
-                os.path.join(tempfile.gettempdir(),
-                             "reporter_flight_%d.json" % os.getpid()))
+                os.path.join(tempfile.gettempdir(), default_dump_name()))
         if not path:
             return None
+        if os.path.isdir(path):
+            path = os.path.join(path, default_dump_name())
         traces = self.snapshot(2 * self.capacity)
         if not traces:
             return None

@@ -98,12 +98,18 @@ def parse_metrics(text: str) -> Dict[str, Dict[Tuple[Tuple[str, str], ...], floa
 
 
 def hist_buckets(metrics: dict, family: str,
-                 match: Optional[dict] = None) -> List[Tuple[float, float]]:
+                 match: Optional[dict] = None,
+                 merge_children: bool = False) -> List[Tuple[float, float]]:
     """Sorted (upper_bound, cumulative_count) pairs for a histogram
     family, +Inf included.  ``match`` filters labeled families: only
-    samples whose label set contains every (k, v) pair in it contribute
-    (samples from several children of one family are NOT merged — pass a
-    match precise enough to select one child)."""
+    samples whose label set contains every (k, v) pair in it contribute.
+    Without ``merge_children``, samples from several children of one
+    family are NOT merged — pass a match precise enough to select one
+    child.  With it, matching children are SUMMED per bucket bound —
+    the fleet view: a replica-labeled federated scrape (or several
+    targets merged by ``merge_parsed``) collapses into one fleet-wide
+    histogram, valid because cumulative bucket counts over identical
+    bounds are additive."""
     rows = []
     for labels, v in metrics.get(family + "_bucket", {}).items():
         d = dict(labels)
@@ -113,8 +119,28 @@ def hist_buckets(metrics: dict, family: str,
         if match and any(d.get(k) != v2 for k, v2 in match.items()):
             continue
         rows.append((float("inf") if le == "+Inf" else float(le), v))
+    if merge_children:
+        summed: Dict[float, float] = {}
+        for le, v in rows:
+            summed[le] = summed.get(le, 0.0) + v
+        rows = list(summed.items())
     rows.sort()
     return rows
+
+
+def merge_parsed(frames: Sequence[dict]) -> Dict[str, Dict[Tuple[Tuple[str, str], ...], float]]:
+    """Merge several ``parse_metrics`` results into one by summing values
+    per (family, label set) — the multi-target path of tools/trace_top.py
+    and tools/fleet_top.py.  Counters and histogram buckets sum exactly;
+    gauges sum too, matching ``obs.metrics.merge``'s cross-process
+    semantics (queue depths and inflight counts aggregate by addition)."""
+    out: Dict[str, Dict[Tuple[Tuple[str, str], ...], float]] = {}
+    for fr in frames:
+        for name, samples in (fr or {}).items():
+            dst = out.setdefault(name, {})
+            for labels, v in samples.items():
+                dst[labels] = dst.get(labels, 0.0) + v
+    return out
 
 
 def delta_buckets(cur: List[Tuple[float, float]],

@@ -100,6 +100,29 @@ G_BUDGET = obs.gauge(
     ("objective",))
 
 
+class SLOFamilies:
+    """The metric families one engine instruments.  The per-replica serve
+    engine pushes the ``reporter_slo_*`` defaults below; the router's
+    client-truth fleet engine passes its own ``reporter_fleet_slo_*``
+    bundle (obs/federation.py) so both verdicts live side by side on one
+    scrape without colliding."""
+
+    __slots__ = ("requests", "latency", "ok", "objective_ok", "burn",
+                 "budget")
+
+    def __init__(self, requests, latency, ok, objective_ok, burn, budget):
+        self.requests = requests
+        self.latency = latency
+        self.ok = ok
+        self.objective_ok = objective_ok
+        self.burn = burn
+        self.budget = budget
+
+
+FAMILIES = SLOFamilies(C_SLO_REQ, H_SLO_LAT, G_SLO_OK, G_OBJ_OK, G_BURN,
+                       G_BUDGET)
+
+
 def classify(code: int, degraded: bool = False) -> str:
     """HTTP status -> budget class, the documented policy
     (docs/observability.md "SLO budget policy"):
@@ -228,7 +251,8 @@ class SLOEngine:
                  window_s: float = 300.0, epoch_s: float = 1.0,
                  burn_pairs: Optional[Sequence[Tuple[float, float, float]]] = None,
                  ring: int = 64, instrument: bool = True,
-                 clock=time.monotonic):
+                 clock=time.monotonic,
+                 families: Optional[SLOFamilies] = None):
         self.objectives: List[Objective] = list(
             default_objectives() if objectives is None else objectives)
         self.window_s = float(window_s)
@@ -247,7 +271,11 @@ class SLOEngine:
             [self.window_s] + [l for _s, l, _f in self.burn_pairs]
             + [s for s, _l, _f in self.burn_pairs])
         self._clock = clock
-        self._instrument = bool(instrument)
+        # which families this engine pushes: explicit bundle > the global
+        # reporter_slo_* defaults (instrument=True) > none (client-side
+        # evaluation, e.g. tools/loadgen.py)
+        self._families = families if families is not None else (
+            FAMILIES if instrument else None)
         self._lock = threading.Lock()
         self._epochs: "OrderedDict[int, _Epoch]" = OrderedDict()
         self.violating: "deque[dict]" = deque(maxlen=max(1, ring))
@@ -280,10 +308,12 @@ class SLOEngine:
                 if h is None:
                     h = ep.hist[route] = [0] * (len(SLO_BUCKETS_S) + 1)
                 h[bucket_index(SLO_BUCKETS_S, latency_s)] += 1
-        if self._instrument:
-            C_SLO_REQ.labels(route, cls).inc()
+        fams = self._families
+        if fams is not None:
+            fams.requests.labels(route, cls).inc()
             if cls != EXCLUDED and latency_s is not None:
-                H_SLO_LAT.labels(route).observe(latency_s, exemplar=trace_id)
+                fams.latency.labels(route).observe(latency_s,
+                                                   exemplar=trace_id)
         violated = self._violations(route, code, cls, latency_s)
         if violated:
             self.violating.append({
@@ -459,19 +489,23 @@ class SLOEngine:
         }
 
     def export_gauges(self) -> None:
-        """Push the verdict/burn gauges (registered as a scrape-time
-        collector for the global engine)."""
+        """Push the verdict/burn gauges into this engine's families
+        (registered as a scrape-time collector for the global engine and
+        for the router's fleet engine)."""
+        fams = self._families
+        if fams is None:
+            return
         try:
             now = self._clock()
             all_ok = True
             for o in self.objectives:
                 st = self._objective_state(o, now)
                 all_ok = all_ok and st["ok"]
-                G_OBJ_OK.labels(o.name).set(1.0 if st["ok"] else 0.0)
-                G_BUDGET.labels(o.name).set(st["budget_remaining"])
+                fams.objective_ok.labels(o.name).set(1.0 if st["ok"] else 0.0)
+                fams.budget.labels(o.name).set(st["budget_remaining"])
                 for win, rate in st["burn"].items():
-                    G_BURN.labels(o.name, win).set(rate)
-            G_SLO_OK.set(1.0 if all_ok else 0.0)
+                    fams.burn.labels(o.name, win).set(rate)
+            fams.ok.set(1.0 if all_ok else 0.0)
         except Exception:  # noqa: BLE001 - a scrape must never fail
             pass
 

@@ -44,6 +44,20 @@ device.  Topology, knobs, and runbook: docs/serving-fleet.md.
               (every replica shedding) propagates as a router 429 —
               backpressure reaches the client, queues stay bounded.
 
+  One pane    the router is ALSO the fleet's observability plane
+              (docs/observability.md "Fleet observability"): GET
+              /metrics serves every replica's snapshot federated under a
+              ``replica`` label (obs/federation.py; a dead replica's
+              last snapshot stays, labeled stale), /statusz compares the
+              replicas side by side, /debug/slo is the CLIENT-TRUTH
+              fleet SLO (a failed-over success is fleet-good; the
+              masking-debt gauge bills what failover hid), /debug/traces
+              records the router's own hop spans — admission, ranking,
+              every dispatch attempt, hedge legs with the loser marked
+              cancelled — and ?id= splices the serving replica's span
+              tree under them, and /debug/attrib + /debug/profile proxy
+              to one replica via ``?replica=<id>``.
+
 Run standalone:  python -m reporter_tpu.serve.router \
                      --port 8002 --replicas http://h1:8010,http://h2:8010
 or supervised with the replicas by tools/fleet.py.
@@ -60,20 +74,30 @@ import time as _time
 import urllib.error
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional, Tuple
-from urllib.parse import parse_qs, urlsplit
+from urllib.parse import parse_qs, urlencode, urlsplit
 
 from .. import faults
+from ..obs import federation as obs_fed
+from ..obs import flight as obs_flight
 from ..obs import log as obs_log
 from ..obs import metrics as obs
+from ..obs import slo as obs_slo
 from ..obs import trace as obs_trace
 from ..obs.quantile import SLO_BUCKETS_S
+from ..obs.trace import Span
 from ..utils import retry
 from ..utils.httppool import HttpPool, raise_for_status
 from .service import _resolve_num
 
 log = logging.getLogger(__name__)
 
-ACTIONS = {"report", "trace_attributes_batch", "health", "metrics", "fleet"}
+ACTIONS = {"report", "trace_attributes_batch", "health", "metrics", "fleet",
+           "statusz", "traces", "slo", "attrib", "profile"}
+
+# the router pins re-dispatched / hedged replica legs with this header so
+# the replica-side flight recorder retains its half of the trace for
+# cross-hop stitching (serve/service.py reads it; docs/http-api.md)
+KEEP_HEADER = "X-Reporter-Flight-Keep"
 
 C_REQS = obs.counter(
     "reporter_router_requests_total",
@@ -218,19 +242,40 @@ class FleetRouter:
         self._stop = threading.Event()
         self._prober: Optional[threading.Thread] = None
         self._t_boot = _time.time()
+        # the fleet observability plane (docs/observability.md "Fleet
+        # observability"): the federator pulls every replica's mergeable
+        # snapshot; the fleet SLO engine classifies the CLIENT-VISIBLE
+        # terminal outcome of every proxied request into the
+        # reporter_fleet_slo_* families (a failed-over success is
+        # fleet-good), and the masking-debt collector bills the delta
+        # between summed replica burn and fleet burn at scrape time
+        self.federator = obs_fed.Federator(
+            [r.url for r in self.replicas], pool=self.pool)
+        self.slo = obs_slo.SLOEngine(
+            window_s=obs_slo._env_float("REPORTER_SLO_WINDOW_S", 300.0),
+            families=obs_fed.FLEET_SLO)
+        obs.REGISTRY.register_collect(self._export_fleet_gauges)
+
+    def _export_fleet_gauges(self) -> None:
+        self.federator.export_gauges()
+        self.slo.export_gauges()
+        self.federator.export_masking_debt(self.slo)
 
     # -- health: active probing + passive outlier ejection -----------------
 
     def start(self) -> None:
         """Probe every replica once synchronously (routing works from the
-        first request), then keep probing on the interval."""
+        first request), then keep probing on the interval; the
+        federation pull loop starts alongside."""
         self.probe_all()
+        self.federator.start()
         self._prober = threading.Thread(target=self._probe_loop,
                                         daemon=True, name="fleet-prober")
         self._prober.start()
 
     def stop(self) -> None:
         self._stop.set()
+        self.federator.stop()
         self.pool.close()
 
     def _probe_loop(self) -> None:
@@ -370,18 +415,41 @@ class FleetRouter:
         return status, rhdrs, rbody, r
 
     def _hedged(self, first: Replica, second: Replica, path: str,
-                body: bytes, headers: dict):
+                body: bytes, headers: dict, note=None):
         """Race the primary against the next-ranked replica after the
         hedge delay; first SUCCESS wins, a lone failure waits for its
-        peer, two failures re-raise the primary's."""
+        peer, two failures re-raise the primary's.  ``note`` (the
+        dispatch span's hop recorder) gets one hop per leg; the losing
+        leg — whichever side it is — is marked cancelled at decision
+        time, exactly once."""
         cond = threading.Condition()
         results: List[Tuple[Replica, object, bool]] = []
+        note_lock = threading.Lock()
+        noted: set = set()  # legs (by is_hedge) whose hop is recorded
+        t_race = _time.monotonic()
+
+        def _note(is_hedge: bool, r: Replica, outcome: str,
+                  cancelled: bool = False) -> None:
+            if note is None:
+                return
+            with note_lock:
+                if is_hedge in noted:
+                    return
+                noted.add(is_hedge)
+            note(span="hedge" if is_hedge else "dispatch", attempt=0,
+                 replica=r.label, outcome=outcome, cancelled=cancelled,
+                 ms=round((_time.monotonic() - t_race) * 1000.0, 1))
 
         def run(r: Replica, is_hedge: bool):
+            hdrs = headers if not is_hedge else dict(
+                headers, **{KEEP_HEADER: "hedge"})
             try:
-                out = self._one(r, path, body, headers)
+                out = self._one(r, path, body, hdrs)
             except BaseException as e:  # noqa: BLE001 - collected below
                 out = e
+            _note(is_hedge, r,
+                  ("error: %s" % out) if isinstance(out, BaseException)
+                  else str(out[0]))
             with cond:
                 results.append((r, out, is_hedge))
                 cond.notify_all()
@@ -406,6 +474,15 @@ class FleetRouter:
                     winner = ok[0]
                     if winner[2]:
                         C_HEDGE_WINS.inc()
+                    if hedged:
+                        # the straggling leg is abandoned: record it as a
+                        # cancelled hop (its thread's own note, if the
+                        # response ever arrives, is suppressed by the
+                        # noted set)
+                        loser_is_hedge = not winner[2]
+                        _note(loser_is_hedge,
+                              second if loser_is_hedge else first,
+                              "cancelled", cancelled=True)
                     return winner[1]
                 if done >= want:
                     break
@@ -423,11 +500,29 @@ class FleetRouter:
         raise TimeoutError("hedged request: no replica answered in time")
 
     def dispatch(self, endpoint: str, body: bytes, uuid: str,
-                 fwd_headers: dict):
+                 fwd_headers: dict, span: Optional[Span] = None):
         """Route one request: rendezvous order, failover under the shared
         retry budget, optional hedging.  Returns (status, headers, body,
-        outcome) — outcome feeds the router request counter."""
+        outcome) — outcome feeds the router request counter.  ``span``
+        (the router's own hop span, recorded into the flight recorder by
+        the HTTP front) collects one hop per dispatch attempt — replica,
+        outcome, duration, hedge/cancelled flags — plus the ranking time,
+        so ``GET /debug/traces?id=`` can show which replicas were tried
+        and why."""
+        t_rank = _time.monotonic()
         order, remapped = self.route_order(uuid)
+        hops: List[dict] = []
+        hop_lock = threading.Lock()
+
+        def note_hop(**kw) -> None:
+            with hop_lock:
+                hops.append(kw)
+
+        if span is not None:
+            span.mark("ranking_s", _time.monotonic() - t_rank)
+            span.meta["hops"] = hops
+            if remapped:
+                span.meta["remapped"] = True
         if not order:
             return (503, None,
                     json.dumps({"error": "no replica available",
@@ -445,8 +540,25 @@ class FleetRouter:
             r = order[i % len(order)]
             if i == 0 and hedge:
                 return self._hedged(order[0], order[1], path, body,
-                                    fwd_headers)
-            return self._one(r, path, body, fwd_headers)
+                                    fwd_headers, note=note_hop)
+            # re-dispatched legs carry the flight-keep hint: the winning
+            # replica must retain ITS spans for the stitched trace
+            hdrs = fwd_headers if i == 0 else dict(
+                fwd_headers, **{KEEP_HEADER: "failover"})
+            t0 = _time.monotonic()
+            try:
+                out = self._one(r, path, body, hdrs)
+            except BaseException as e:
+                note_hop(span="dispatch", attempt=i, replica=r.label,
+                         outcome=("%d" % e.code
+                                  if isinstance(e, urllib.error.HTTPError)
+                                  else "error: %s" % e),
+                         ms=round((_time.monotonic() - t0) * 1000.0, 1))
+                raise
+            note_hop(span="dispatch", attempt=i, replica=r.label,
+                     outcome=str(out[0]),
+                     ms=round((_time.monotonic() - t0) * 1000.0, 1))
+            return out
 
         # wrap to count failover causes without re-implementing the policy
         def attempt_counted(i: int):
@@ -474,9 +586,13 @@ class FleetRouter:
             payload = {"error": ("fleet saturated" if e.code == 429
                                  else "no replica accepted the request"),
                        "retry_after": max(1, int(hint or 1))}
+            if span is not None:
+                span.meta["attempts"] = attempts["n"]
             return (e.code, getattr(e, "headers", None),
                     json.dumps(payload).encode("utf-8"), "saturated")
         except Exception as e:  # noqa: BLE001 - transport-level exhaustion
+            if span is not None:
+                span.meta["attempts"] = attempts["n"]
             return (503, None,
                     json.dumps({"error": "fleet unreachable: %s" % (e,),
                                 "retry_after": 1}).encode("utf-8"),
@@ -484,6 +600,9 @@ class FleetRouter:
         outcome = "ok" if attempts["n"] <= 1 else "failover_ok"
         if status >= 400:
             outcome = "passthrough"
+        if span is not None:
+            span.meta["attempts"] = attempts["n"]
+            span.meta["replica"] = r.label
         return status, rhdrs, rbody, outcome
 
     # -- surfaces ------------------------------------------------------------
@@ -518,6 +637,186 @@ class FleetRouter:
                 "request_timeout_s": self.request_timeout_s,
             },
         }
+
+    # -- the fleet observability plane (docs/observability.md) ---------------
+
+    def render_metrics(self, pull: bool = False) -> str:
+        """Router ``GET /metrics``: the router's own families (incl. the
+        staleness gauges and the reporter_fleet_slo_* verdict, pushed by
+        the scrape-time collector) followed by every replica's federated
+        snapshot under a ``replica`` label.  ``?pull=1`` forces a
+        synchronous federation pull first (rehearsals assert against a
+        point-in-time fleet state)."""
+        if pull:
+            self.federator.pull_all()
+        own = obs.REGISTRY.render()
+        # suppress duplicate # HELP/# TYPE for family names the router's
+        # own registry already rendered (import-time registrations from
+        # serve/service.py exist here too, sample-less)
+        own_names = set(obs.REGISTRY.snapshot())
+        return own + self.federator.render(skip_meta=own_names)
+
+    def _replica_by_id(self, rid: str) -> Optional[Replica]:
+        return next((r for r in self.replicas if r.id == rid), None)
+
+    def fleet_statusz(self) -> Tuple[int, dict]:
+        """One screen for N replicas: per-replica probe state, snapshot
+        age, queue depth, inflight, degraded/draining flags and burn
+        rates side by side, plus the fleet SLO summary, the masking
+        debt, and the router's own metrics snapshot."""
+        feeds = {f.label: f for f in self.federator.feeds()}
+        ages = self.federator.ages()
+        rows = []
+        for r in self.replicas:
+            rid = r.id or r.url
+            feed = feeds.get(rid) or feeds.get(r.url)
+            statusz = feed.statusz if feed is not None else None
+            snap = (statusz or {}).get("metrics") or {}
+            slo_sum = (statusz or {}).get("slo") or {}
+            age = ages.get(rid) or ages.get(r.url) or {}
+            rows.append({
+                "id": r.id,
+                "url": r.url,
+                "state": r.state,
+                "available": r.available(),
+                "snapshot_age_s": age.get("age_s"),
+                "snapshot_stale": age.get("stale", True),
+                "draining": (statusz or {}).get("draining"),
+                "degraded": (statusz or {}).get("degraded"),
+                "warming": (statusz or {}).get("warming"),
+                "queue_depth": obs_fed.snapshot_scalar(
+                    snap, "reporter_microbatch_queue_depth"),
+                "inflight": obs_fed.snapshot_scalar(
+                    snap, "reporter_microbatch_inflight"),
+                "burn": {
+                    name: st.get("burn")
+                    for name, st in (slo_sum.get("objectives")
+                                     or {}).items()},
+            })
+        return 200, {
+            "role": "router",
+            "uptime_s": round(_time.time() - self._t_boot, 1),
+            "fleet": rows,
+            "slo": self.slo.summary(),
+            "masking_debt": self.federator.masking_debt(self.slo),
+            "federation": {
+                "pull_interval_s": self.federator.pull_interval_s,
+                "stale_after_s": self.federator.stale_after_s,
+                "replicas": ages,
+            },
+            "metrics": obs.REGISTRY.snapshot(),
+        }
+
+    def handle_slo(self, query: dict) -> Tuple[int, dict]:
+        """Router ``GET /debug/slo[?window=S]``: the CLIENT-TRUTH fleet
+        verdict (same report shape as a replica's /debug/slo, rendered
+        from the router-side engine) plus the per-objective masking debt
+        — the replica budget failover is spending invisibly."""
+        window = None
+        raw = query.get("window", [None])[0]
+        if raw is not None:
+            try:
+                window = max(1.0, float(raw))
+            except (TypeError, ValueError):
+                return 400, {"error": "window must be a number (seconds)"}
+        out = self.slo.report(window_s=window)
+        out["scope"] = "fleet"
+        out["masking_debt"] = self.federator.masking_debt(self.slo)
+        return 200, out
+
+    def handle_traces(self, query: dict) -> Tuple[int, dict]:
+        """Router ``GET /debug/traces``: ``?n=K`` lists the router's own
+        retained hop spans; ``?id=<trace_id>`` stitches — the router
+        entry's hop spans (admission, ranking, every dispatch attempt)
+        with the serving replica's span tree (fetched live from the
+        replica recorded in ``X-Reporter-Replica``) spliced under them
+        as ``children``."""
+        rec = obs_flight.RECORDER
+        tid = obs_trace.accept_trace_id(query.get("id", [None])[0])
+        if not tid:
+            try:
+                n = int(query.get("n", ["50"])[0])
+            except (TypeError, ValueError):
+                return 400, {"error": "n must be an integer"}
+            n = max(1, min(n, 2 * rec.capacity))
+            return 200, {"summary": rec.summary(), "traces": rec.snapshot(n)}
+        entries = rec.find(tid)
+        if not entries:
+            return 404, {"error": "trace %r not retained at the router"
+                                  % tid, "trace_id": tid}
+        # newest ROUTER hop span for the id (an embedded single-process
+        # fleet shares one recorder, so replica spans can sit alongside)
+        router_entry = next(
+            (e for e in reversed(entries) if e.get("hop") == "router"),
+            entries[-1])
+        rid = router_entry.get("replica")
+        replica_spans: List[dict] = []
+        note = None
+        rep = self._replica_by_id(rid) if rid else None
+        if rep is None:
+            note = ("no serving replica recorded" if not rid
+                    else "replica %r not in the fleet" % rid)
+        else:
+            try:
+                status, _hdrs, body = self.pool.request(
+                    "GET", rep.url + "/debug/traces?id=" + tid,
+                    timeout=self.probe_timeout_s, target="replica")
+                if status == 200:
+                    replica_spans = json.loads(
+                        body.decode("utf-8")).get("traces", [])
+                else:
+                    note = ("replica %s did not retain the trace (%d)"
+                            % (rid, status))
+            except Exception as e:  # noqa: BLE001 - stitch what we have
+                note = "replica fetch failed: %s" % (e,)
+        stitched = dict(router_entry)
+        stitched["children"] = replica_spans
+        out = {
+            "trace_id": tid,
+            "stitched": stitched,
+            "router_entries": entries,
+            "replica": {"id": rid, "spans": replica_spans},
+        }
+        if note:
+            out["replica"]["note"] = note
+        return 200, out
+
+    def proxy_debug(self, action: str, query: dict,
+                    trace_id: Optional[str] = None):
+        """Proxy ``GET /debug/attrib`` / ``GET /debug/profile`` to ONE
+        replica selected by ``?replica=<id>`` (400 without the selector,
+        404 listing the known ids on a bad one).  The replica's answer —
+        including its single-flight 409 with the owning capture's
+        trace_id — passes through verbatim.  Returns (status, headers,
+        body_bytes)."""
+        rid = (query.get("replica") or [None])[0]
+        known = sorted(r.id for r in self.replicas if r.id)
+        if not rid:
+            return (400, None, json.dumps(
+                {"error": "replica query parameter required "
+                          "(profiling targets ONE replica)",
+                 "replicas": known}).encode("utf-8"))
+        rep = self._replica_by_id(rid)
+        if rep is None:
+            return (404, None, json.dumps(
+                {"error": "unknown replica %r" % rid,
+                 "replicas": known}).encode("utf-8"))
+        qs = urlencode({k: v for k, v in query.items()
+                        if k != "replica"}, doseq=True)
+        path = "/debug/%s" % action + ("?" + qs if qs else "")
+        hdrs = {"X-Reporter-Trace": trace_id} if trace_id else {}
+        try:
+            # capture windows run for seconds (profile ?seconds=N is
+            # clamped to 60 replica-side): give the leg room on top of
+            # the normal dispatch timeout
+            status, rhdrs, body = self.pool.request(
+                "GET", rep.url + path, headers=hdrs,
+                timeout=self.request_timeout_s + 90.0, target="replica")
+        except Exception as e:  # noqa: BLE001 - surfaced to the caller
+            return (502, None, json.dumps(
+                {"error": "replica %s unreachable: %s" % (rid, e)}
+            ).encode("utf-8"))
+        return status, rhdrs, body
 
     # -- HTTP front ----------------------------------------------------------
 
@@ -577,22 +876,67 @@ class FleetRouter:
             def _proxy(self, endpoint: str, payload_bytes: bytes,
                        uuid: str):
                 t0 = _time.monotonic()
+                # the router's own hop span: admission, ranking, every
+                # dispatch attempt, total router residency — recorded
+                # into the router-side flight recorder under the SAME
+                # trace_id the replica records its spans under, which is
+                # what GET /debug/traces?id= stitches back together
+                span = Span("router." + endpoint, trace_id=self._trace_id)
+                span.meta["hop"] = "router"
+                span.meta["endpoint"] = endpoint
+                if uuid:
+                    span.meta["uuid"] = uuid[:64]
                 if not router._gate.acquire(blocking=False):
                     C_SHED.inc()
                     C_REQS.labels(endpoint, "shed").inc()
+                    span.fail("router saturated", status="shed")
+                    span.finish()
+                    router.slo.observe(endpoint, 429, span.total_s,
+                                       trace_id=span.trace_id)
+                    obs_flight.record(span)
                     return self._answer(
                         429, {"error": "router saturated (%d inflight)"
                               % router.max_inflight, "retry_after": 1})
                 G_INFLIGHT.inc()
+                span.mark("admission_s", _time.monotonic() - t0)
                 try:
                     fwd = {"Content-Type": "application/json",
                            "X-Reporter-Trace": self._trace_id}
                     dl = self.headers.get("X-Reporter-Deadline-Ms")
                     if dl:
                         fwd["X-Reporter-Deadline-Ms"] = dl
+                    # a client-supplied flight-keep hint pins the request
+                    # END TO END: the router's own span and every replica
+                    # leg (the re-dispatch hint below still overrides on
+                    # retries — "failover" is the more specific story)
+                    fk = obs_trace.accept_trace_id(
+                        self.headers.get(KEEP_HEADER))
+                    if fk:
+                        fwd[KEEP_HEADER] = fk
+                        span.meta["flight_keep"] = fk
                     status, rhdrs, rbody, outcome = router.dispatch(
-                        endpoint, payload_bytes, uuid, fwd)
+                        endpoint, payload_bytes, uuid, fwd, span=span)
                     C_REQS.labels(endpoint, outcome).inc()
+                    span.meta["outcome"] = outcome
+                    if outcome in ("no_replica", "unreachable",
+                                   "saturated"):
+                        span.fail(outcome, status=outcome)
+                    span.finish()
+                    # the CLIENT-TRUTH fleet SLO: classify what the
+                    # client actually received, failover and hedging
+                    # already absorbed (a failed-over 200 is fleet-good).
+                    # degraded rides the replica's own response body.
+                    router.slo.observe(
+                        endpoint, status, span.total_s,
+                        degraded=b'"degraded":true' in (rbody or b""),
+                        trace_id=span.trace_id)
+                    # multi-attempt / hedged spans are pinned: the
+                    # stitched view of a failover must survive sampling
+                    if span.meta.get("attempts", 1) > 1 or any(
+                            h.get("span") == "hedge"
+                            for h in span.meta.get("hops", ())):
+                        span.meta.setdefault("flight_keep", "failover")
+                    obs_flight.record(span)
                     self._answer_bytes(status, rbody, rhdrs,
                                        "application/json;charset=utf-8")
                 finally:
@@ -618,9 +962,24 @@ class FleetRouter:
                         return self._answer(*router.health())
                     if action == "fleet":
                         return self._answer(*router.fleet())
-                    if action == "metrics":
+                    if action == "statusz":
+                        return self._answer(*router.fleet_statusz())
+                    if action == "traces":
+                        return self._answer(*router.handle_traces(query))
+                    if action == "slo":
+                        return self._answer(*router.handle_slo(query))
+                    if action in ("attrib", "profile"):
+                        status, rhdrs, body = router.proxy_debug(
+                            action, query, self._trace_id)
                         return self._answer_bytes(
-                            200, obs.REGISTRY.render().encode("utf-8"),
+                            status, body, rhdrs,
+                            "application/json;charset=utf-8")
+                    if action == "metrics":
+                        pull = query.get("pull", ["0"])[0] \
+                            not in ("", "0", "false")
+                        return self._answer_bytes(
+                            200,
+                            router.render_metrics(pull=pull).encode("utf-8"),
                             None,
                             "text/plain; version=0.0.4; charset=utf-8")
                     if post:
@@ -686,6 +1045,10 @@ class FleetRouter:
 
 def main(argv=None) -> int:
     obs_log.configure()
+    # the router's hop spans dump on SIGTERM/fatal exactly like a
+    # replica's (REPORTER_REPLICA_ID, when the supervisor pins one,
+    # rides the dump filename — obs/flight.py)
+    obs_flight.install_shutdown_dump()
     ap = argparse.ArgumentParser(description="fleet router "
                                  "(docs/serving-fleet.md)")
     ap.add_argument("--port", type=int, default=8002)

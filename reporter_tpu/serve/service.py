@@ -950,6 +950,16 @@ class ReporterService:
             span.fail("service initialising", status="unavailable")
             self._terminal("report", 503, span)
             return 503, {"error": "service initialising", "retry_after": 1}
+        # chaos seam: an injected admission shed — the canonical
+        # failover-MASKED failure (the replica burns its own SLO budget
+        # on the 429 while the router re-dispatches and the client sees
+        # 200; the fleet masking-debt gauge must bill the difference)
+        if faults.fire("replica_shed") is not None:
+            span.fail("injected admission shed", status="shed")
+            self._terminal("report", 429, span)
+            C_REQUESTS.labels("report", "shed").inc()
+            return 429, {"error": "injected admission shed",
+                         "retry_after": 1}
         err, rl, tl = self.validate(trace)
         if err:
             C_REQUESTS.labels("report", "invalid").inc()
@@ -1249,12 +1259,25 @@ class ReporterService:
     def handle_traces(self, query: dict) -> Tuple[int, dict]:
         """GET /debug/traces?n=K — the flight recorder's most recent
         retained traces (errors and over-threshold always present, plus
-        the 1-in-N sample), newest first, with per-stage breakdowns."""
+        the 1-in-N sample), newest first, with per-stage breakdowns.
+        ``?id=<trace_id>`` instead returns every retained entry for that
+        one trace (404 with an empty list when it was not retained) —
+        the fetch the fleet router's cross-hop stitching makes against
+        the replica named in ``X-Reporter-Replica``."""
+        rec = obs_flight.RECORDER
+        tid = obs_trace.accept_trace_id(query.get("id", [None])[0])
+        if tid:
+            entries = rec.find(tid)
+            code = 200 if entries else 404
+            out = {"trace_id": tid, "replica": self.replica_id,
+                   "traces": entries}
+            if not entries:
+                out["error"] = "trace %r not retained" % tid
+            return code, out
         try:
             n = int(query.get("n", ["50"])[0])
         except (TypeError, ValueError):
             return 400, {"error": "n must be an integer"}
-        rec = obs_flight.RECORDER
         n = max(1, min(n, 2 * rec.capacity))
         return 200, {"summary": rec.summary(), "traces": rec.snapshot(n)}
 
@@ -1532,6 +1555,15 @@ class ReporterService:
                         # it up from the context (their own signatures stay
                         # embedder-compatible)
                         span = Span(action, trace_id=self._trace_id)
+                        # the router pins re-dispatched/hedged legs with
+                        # X-Reporter-Flight-Keep so THIS side of a
+                        # failed-over request is guaranteed retained for
+                        # cross-hop stitching (validated like a trace id;
+                        # garbage is ignored, not an error)
+                        fk = obs_trace.accept_trace_id(
+                            self.headers.get("X-Reporter-Flight-Keep"))
+                        if fk:
+                            span.meta["flight_keep"] = fk
                         # kwargs are only passed when set, so embedders
                         # wrapping handle_report(trace) keep working
                         kw = {}

@@ -1,0 +1,607 @@
+"""Fleet observability plane (docs/observability.md "Fleet
+observability"): metrics federation, cross-hop trace stitching, and the
+client-truth fleet SLO, driven through the real router + replica HTTP
+seam:
+
+  (a) the federated render: every replica family re-rendered under a
+      ``replica`` label, parseable by the shared quantile parser, no
+      duplicate # TYPE metadata next to the router's own families
+  (b) a dead replica's LAST snapshot stays in the render, labeled stale
+      with a rising age gauge — never silently dropped
+  (c) client truth: a request that failed over and succeeded is
+      fleet-good at the router while the burned replica's own engine
+      records the bad — and the delta shows up in the masking-debt gauge
+  (d) cross-hop stitching: the router's hop spans (every dispatch
+      attempt, hedge legs with the loser marked cancelled) splice the
+      serving replica's span tree under them at GET /debug/traces?id=
+  (e) /debug/attrib + /debug/profile route through the router with a
+      ?replica=<id> selector (400 without, 404 listing known ids)
+  (f) the federation-consistency invariant over REAL subprocess
+      replicas: per-replica federated counters equal the client-observed
+      per-replica distribution, and shutdown dumps embed each replica's
+      id (no collisions on a shared dump dir)
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from reporter_tpu import faults
+from reporter_tpu.matching import MatcherConfig, SegmentMatcher
+from reporter_tpu.obs import federation as obs_fed
+from reporter_tpu.obs import flight as obs_flight
+from reporter_tpu.obs.quantile import (
+    hist_buckets,
+    hist_quantile,
+    merge_parsed,
+    parse_metrics,
+)
+from reporter_tpu.serve.router import FleetRouter
+from reporter_tpu.serve.service import ReporterService
+from reporter_tpu.tiles.arrays import build_graph_arrays
+from reporter_tpu.tiles.network import grid_city
+from reporter_tpu.tiles.ubodt import build_ubodt
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults(monkeypatch):
+    for p in faults.POINTS:
+        monkeypatch.delenv("REPORTER_FAULT_" + p.upper(), raising=False)
+    faults.reset()
+    yield
+    faults.reset()
+
+
+@pytest.fixture(scope="module")
+def engine():
+    city = grid_city(rows=5, cols=5, spacing_m=150.0)
+    arrays = build_graph_arrays(city, cell_size=100.0)
+    ubodt = build_ubodt(arrays, delta=2000.0)
+    return arrays, ubodt
+
+
+def street_trace(arrays, uuid, row=2, n=8, t0=1000):
+    nodes = [row * 5 + c for c in range(5)]
+    t = np.linspace(0.05, 0.9, n)
+    xs = np.interp(t, np.linspace(0, 1, 5), arrays.node_x[nodes])
+    ys = np.interp(t, np.linspace(0, 1, 5), arrays.node_y[nodes])
+    lat, lon = arrays.proj.to_latlon(xs, ys)
+    return {
+        "uuid": uuid,
+        "trace": [
+            {"lat": float(a), "lon": float(o), "time": t0 + 15 * i}
+            for i, (a, o) in enumerate(zip(lat, lon))
+        ],
+        "match_options": {"mode": "auto", "report_levels": [0, 1],
+                          "transition_levels": [0, 1]},
+    }
+
+
+class _Replica:
+    def __init__(self, arrays, ubodt, rid, port=0, **svc_kw):
+        self.rid = rid
+        prev = os.environ.get("REPORTER_REPLICA_ID")
+        os.environ["REPORTER_REPLICA_ID"] = rid
+        try:
+            matcher = SegmentMatcher(arrays=arrays, ubodt=ubodt,
+                                     config=MatcherConfig(), backend="cpu")
+            self.svc = ReporterService(matcher, max_wait_ms=2.0, **svc_kw)
+        finally:
+            if prev is None:
+                os.environ.pop("REPORTER_REPLICA_ID", None)
+            else:
+                os.environ["REPORTER_REPLICA_ID"] = prev
+        self.httpd = self.svc.make_server("127.0.0.1", port)
+        self.port = self.httpd.server_port
+        threading.Thread(target=self.httpd.serve_forever,
+                         daemon=True).start()
+        self.url = "http://127.0.0.1:%d" % self.port
+
+    def kill(self):
+        self.httpd.shutdown()
+        self.httpd.close_lingering()
+        self.httpd.server_close()
+
+    def close(self):
+        try:
+            self.kill()
+        except Exception:  # noqa: BLE001 - already killed by the test
+            pass
+
+
+class _Fleet:
+    def __init__(self, arrays, ubodt, n=3, router_kw=None, **svc_kw):
+        self.replicas = [
+            _Replica(arrays, ubodt, "fed-rep-%d" % i, **svc_kw)
+            for i in range(n)]
+        self.router = FleetRouter([r.url for r in self.replicas],
+                                  probe_interval_s=0.2,
+                                  **(router_kw or {}))
+        self.router.federator.pull_interval_s = 0.3
+        self.router.federator.stale_after_s = 0.9
+        self.router.start()
+        self.httpd = self.router.make_server("127.0.0.1", 0)
+        threading.Thread(target=self.httpd.serve_forever,
+                         daemon=True).start()
+        self.url = "http://127.0.0.1:%d" % self.httpd.server_port
+
+    def by_id(self, rid):
+        return next(r for r in self.replicas if r.rid == rid)
+
+    def close(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        self.router.stop()
+        for r in self.replicas:
+            r.close()
+
+
+@pytest.fixture
+def fleet_factory(engine):
+    arrays, ubodt = engine
+    fleets = []
+
+    def make(n=3, router_kw=None, **svc_kw):
+        f = _Fleet(arrays, ubodt, n=n, router_kw=router_kw, **svc_kw)
+        fleets.append(f)
+        return f
+
+    yield make
+    for f in fleets:
+        f.close()
+
+
+def post_json(url, payload, headers=None, timeout=30):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers=dict({"Content-Type": "application/json"},
+                     **(headers or {})))
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, dict(r.headers), json.loads(r.read().decode())
+    except urllib.error.HTTPError as e:
+        return e.code, dict(e.headers), json.loads(e.read().decode())
+
+
+def get_raw(url, timeout=30):
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            return r.status, r.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+def get_json(url, timeout=30):
+    st, body = get_raw(url, timeout)
+    return st, json.loads(body)
+
+
+# -- (a) the federated render -------------------------------------------------
+
+
+def test_render_snapshots_unit():
+    snaps = {
+        "rep-a": {
+            "m_total": {"type": "counter", "help": "a counter",
+                        "labelnames": ["endpoint"],
+                        "samples": [[["report"], 3.0]]},
+            "m_lat": {"type": "histogram", "help": "a hist",
+                      "labelnames": [],
+                      "samples": [[[], {"buckets": [0.1, 1.0],
+                                        "counts": [2, 1, 1],
+                                        "sum": 1.5, "count": 4}]]},
+        },
+        'rep-"b"': {  # label escaping must hold
+            "m_total": {"type": "counter", "help": "a counter",
+                        "labelnames": ["endpoint"],
+                        "samples": [[["report"], 5.0]]},
+        },
+    }
+    text = obs_fed.render_snapshots(snaps)
+    m = parse_metrics(text)
+    assert m["m_total"][(("endpoint", "report"),
+                        ("replica", "rep-a"))] == 3.0
+    assert m["m_total"][(("endpoint", "report"),
+                        ("replica", 'rep-\\"b\\"'))] == 5.0
+    # histogram rendered cumulatively with the replica label on every line
+    b = hist_buckets(m, "m_lat", match={"replica": "rep-a"})
+    assert b == [(0.1, 2.0), (1.0, 3.0), (float("inf"), 4.0)]
+    assert m["m_lat_count"][(("replica", "rep-a"),)] == 4.0
+    # skip_meta suppresses duplicated metadata, samples still render
+    text2 = obs_fed.render_snapshots(snaps, skip_meta={"m_total"})
+    assert "# TYPE m_total" not in text2
+    assert 'm_total{replica="rep-a"' in text2
+
+
+def test_merge_parsed_sums_across_targets():
+    a = parse_metrics("x_total 3\n"
+                      'h_bucket{le="0.1"} 1\nh_bucket{le="+Inf"} 2\n')
+    b = parse_metrics("x_total 4\n"
+                      'h_bucket{le="0.1"} 2\nh_bucket{le="+Inf"} 3\n')
+    m = merge_parsed([a, b])
+    assert m["x_total"][()] == 7.0
+    assert hist_buckets(m, "h") == [(0.1, 3.0), (float("inf"), 5.0)]
+    # merge_children collapses several children of one family
+    fed = parse_metrics(
+        'h_bucket{replica="r0",le="0.1"} 1\n'
+        'h_bucket{replica="r0",le="+Inf"} 2\n'
+        'h_bucket{replica="r1",le="0.1"} 3\n'
+        'h_bucket{replica="r1",le="+Inf"} 5\n')
+    assert hist_buckets(fed, "h", merge_children=True) == [
+        (0.1, 4.0), (float("inf"), 7.0)]
+    assert hist_quantile(hist_buckets(fed, "h", merge_children=True),
+                         0.5) is not None
+
+
+def test_router_metrics_federated(engine, fleet_factory):
+    arrays, _ = engine
+    fleet = fleet_factory(n=2)
+    for k in range(6):
+        st, _hd, _b = post_json(fleet.url + "/report",
+                                street_trace(arrays, "veh-%d" % k))
+        assert st == 200
+    st, text = get_raw(fleet.url + "/metrics?pull=1")
+    assert st == 200
+    # the replica label rides every federated family; the router's own
+    # families render exactly once (no duplicated # TYPE metadata)
+    assert 'replica="fed-rep-0"' in text and 'replica="fed-rep-1"' in text
+    tnames = [l.split()[2] for l in text.splitlines()
+              if l.startswith("# TYPE")]
+    assert len(tnames) == len(set(tnames))
+    m = parse_metrics(text)
+    assert "reporter_fleet_slo_requests_total" in m
+    assert "reporter_fleet_slo_masking_debt" in m
+    ages = {dict(lv)["replica"]: v for lv, v in
+            m["reporter_federation_snapshot_age_seconds"].items()}
+    assert set(ages) == {"fed-rep-0", "fed-rep-1"}
+    assert all(v >= 0 for v in ages.values())
+
+
+# -- (b) staleness: the dead replica's last snapshot survives -----------------
+
+
+def test_dead_replica_snapshot_kept_and_labeled_stale(engine, fleet_factory):
+    arrays, _ = engine
+    fleet = fleet_factory(n=2)
+    for k in range(4):
+        st, _hd, _b = post_json(fleet.url + "/report",
+                                street_trace(arrays, "veh-%d" % k))
+        assert st == 200
+    fleet.router.federator.pull_all()
+    victim = fleet.replicas[1]
+    victim.kill()
+    time.sleep(1.0)  # > stale_after_s (0.9), pulls now failing
+    st, text = get_raw(fleet.url + "/metrics?pull=1")
+    m = parse_metrics(text)
+    key = (("replica", victim.rid),)
+    age1 = m["reporter_federation_snapshot_age_seconds"][key]
+    assert m["reporter_federation_snapshot_stale"][key] == 1.0
+    assert age1 > 0.9
+    # the final snapshot is still in the render — dead, not dropped
+    assert any(dict(lv).get("replica") == victim.rid
+               for lv in m.get("reporter_requests_total", {}))
+    time.sleep(0.5)
+    st, text = get_raw(fleet.url + "/metrics?pull=1")
+    m2 = parse_metrics(text)
+    assert m2["reporter_federation_snapshot_age_seconds"][key] > age1
+    # the live replica stays fresh
+    live = (("replica", fleet.replicas[0].rid),)
+    assert m2["reporter_federation_snapshot_stale"][live] == 0.0
+
+
+# -- (c) client truth + masking debt ------------------------------------------
+
+
+def test_failover_masked_request_is_fleet_good_replica_bad(
+        engine, fleet_factory):
+    arrays, ubodt = engine
+    fleet = fleet_factory(n=2)
+    # find a vehicle whose rendezvous primary is replica 0, then drain
+    # that replica: its 503 "draining" burns ITS budget while the router
+    # fails the request over and the CLIENT sees a clean 200
+    uuid = next("veh-m%d" % k for k in range(64)
+                if fleet.router.ranked("veh-m%d" % k)[0].url
+                == fleet.replicas[0].url)
+    st, _hd, _b = post_json(fleet.url + "/report",
+                            street_trace(arrays, uuid))
+    assert st == 200
+    fleet.replicas[0].svc.begin_drain()
+    st, hd, _b = post_json(fleet.url + "/report",
+                           street_trace(arrays, uuid))
+    assert st == 200  # fleet-good: the failover masked the drain refusal
+    assert hd["X-Reporter-Replica"] == fleet.replicas[1].rid
+    fleet.router.federator.pull_all()
+    st, slo = get_json(fleet.url + "/debug/slo")
+    assert st == 200 and slo["scope"] == "fleet"
+    rep = slo["routes"]["report"]
+    assert rep["bad"] == 0 and rep["good"] >= 2
+    # ...but the masking debt bills the replica-side burn the failover hid
+    assert slo["masking_debt"]["availability"] > 0
+    st, statusz = get_json(fleet.url + "/statusz")
+    assert statusz["masking_debt"]["availability"] > 0
+    # and the gauge is on the federated scrape
+    st, text = get_raw(fleet.url + "/metrics")
+    m = parse_metrics(text)
+    assert m["reporter_fleet_slo_masking_debt"][
+        (("objective", "availability"),)] > 0
+
+
+def test_injected_replica_shed_is_masked_and_billed(engine, fleet_factory,
+                                                    monkeypatch):
+    """The deterministic fleet-good/replica-bad fixture the rehearsal
+    leans on: an injected admission shed 429s at ONE replica, the router
+    rotates onward, the client sees 200 — and the debt shows up."""
+    arrays, _ = engine
+    fleet = fleet_factory(n=2)
+    monkeypatch.setenv("REPORTER_FAULT_REPLICA_SHED", "1")
+    faults.reset()
+    st, hd, _b = post_json(fleet.url + "/report",
+                           street_trace(arrays, "veh-shed"))
+    assert st == 200  # masked: the shed never reached the client
+    tid = hd["X-Reporter-Trace"]
+    fleet.router.federator.pull_all()
+    st, slo = get_json(fleet.url + "/debug/slo")
+    assert slo["masking_debt"]["availability"] > 0
+    # and the stitched trace names the shedding hop
+    st, out = get_json(fleet.url + "/debug/traces?id=%s" % tid)
+    assert st == 200
+    hops = out["stitched"]["hops"]
+    assert any(h["outcome"] == "429" for h in hops)
+    assert any(h["outcome"] == "200" for h in hops)
+
+
+# -- (d) cross-hop stitching --------------------------------------------------
+
+
+def test_stitched_trace_for_failed_over_request(engine, fleet_factory,
+                                                monkeypatch):
+    arrays, _ = engine
+    fleet = fleet_factory(n=2)
+    monkeypatch.setenv("REPORTER_FAULT_ROUTER_CONNECT", "refused:1")
+    st, hd, _b = post_json(fleet.url + "/report",
+                           street_trace(arrays, "veh-stitch"))
+    assert st == 200
+    tid = hd["X-Reporter-Trace"]
+    st, out = get_json(fleet.url + "/debug/traces?id=%s" % tid)
+    assert st == 200
+    stitched = out["stitched"]
+    hops = stitched["hops"]
+    # >= 2 dispatch-attempt hop spans: the refused primary + the winner
+    assert len([h for h in hops if h["span"] == "dispatch"]) >= 2
+    assert any("error" in h["outcome"] for h in hops)
+    assert any(h["outcome"] == "200" for h in hops)
+    assert stitched["attempts"] >= 2
+    # the replica's span tree is spliced under the router's (the winning
+    # leg carried X-Reporter-Flight-Keep, so the replica side is pinned
+    # by the flight recorder — retention is guaranteed, not sampled)
+    children = stitched["children"]
+    assert children and any(e.get("endpoint") == "report"
+                            for e in children)
+    assert all(e["trace_id"] == tid for e in children)
+    assert any(e.get("flight_keep") == "failover" for e in children)
+    # router residency + ranking marks ride the router entry
+    assert "total_s" in stitched["timings"]
+    assert "ranking_s" in stitched["timings"]
+
+
+def test_stitched_hedge_marks_cancelled_leg(engine, fleet_factory,
+                                            monkeypatch):
+    arrays, _ = engine
+    fleet = fleet_factory(n=2, router_kw={"hedge_ms": 100.0})
+    monkeypatch.setenv("REPORTER_FAULT_REPLICA_SLOW_ACCEPT", "1.2:1")
+    st, hd, _b = post_json(fleet.url + "/report",
+                           street_trace(arrays, "veh-hedge"))
+    assert st == 200
+    tid = hd["X-Reporter-Trace"]
+    st, out = get_json(fleet.url + "/debug/traces?id=%s" % tid)
+    assert st == 200
+    hops = out["stitched"]["hops"]
+    assert any(h["span"] == "hedge" and h["outcome"] == "200"
+               for h in hops)
+    assert any(h.get("cancelled") for h in hops)
+
+
+def test_trace_by_id_on_replica_and_404(engine, fleet_factory):
+    arrays, _ = engine
+    fleet = fleet_factory(n=2)
+    st, hd, _b = post_json(
+        fleet.url + "/report", street_trace(arrays, "veh-byid"),
+        headers={"X-Reporter-Flight-Keep": "test"})
+    assert st == 200
+    tid = hd["X-Reporter-Trace"]
+    rid = hd["X-Reporter-Replica"]
+    rep = fleet.by_id(rid)
+    st, out = get_json(rep.url + "/debug/traces?id=%s" % tid)
+    assert st == 200 and out["trace_id"] == tid
+    assert out["traces"] and out["traces"][0]["trace_id"] == tid
+    assert out["traces"][0]["flight_keep"] == "test"
+    st, out = get_json(rep.url + "/debug/traces?id=no-such-trace")
+    assert st == 404 and out["traces"] == []
+    st, out = get_json(fleet.url + "/debug/traces?id=no-such-trace")
+    assert st == 404
+
+
+# -- (e) per-replica debug selector -------------------------------------------
+
+
+def test_router_debug_replica_selector(engine, fleet_factory):
+    arrays, _ = engine
+    fleet = fleet_factory(n=2)
+    st, out = get_json(fleet.url + "/debug/attrib")
+    assert st == 400 and set(out["replicas"]) == {"fed-rep-0", "fed-rep-1"}
+    st, out = get_json(fleet.url + "/debug/attrib?replica=nope")
+    assert st == 404 and "fed-rep-0" in out["replicas"]
+    st, out = get_json(fleet.url + "/debug/attrib?replica=fed-rep-0")
+    assert st == 200 and "summary" in out
+    # profile passes the replica's answer through verbatim (cpu backend
+    # answers 501; the single-flight 409 contract rides the same path)
+    st, out = get_json(fleet.url + "/debug/profile?replica=fed-rep-1")
+    assert st == 501 and "jax backend" in out["error"]
+
+
+# -- flight-recorder dump paths (unit half of the collision satellite) --------
+
+
+def test_flight_dump_name_embeds_replica_id(monkeypatch, tmp_path):
+    monkeypatch.setenv("REPORTER_REPLICA_ID", "rep/odd id")
+    name = obs_flight.default_dump_name()
+    assert name.startswith("reporter_flight_rep_odd_id_")
+    monkeypatch.delenv("REPORTER_REPLICA_ID")
+    assert obs_flight.default_dump_name() == \
+        "reporter_flight_%d.json" % os.getpid()
+    # a directory dump path gets the replica-qualified name inside it
+    monkeypatch.setenv("REPORTER_REPLICA_ID", "rep-9")
+    rec = obs_flight.FlightRecorder(capacity=4, slow_ms=0)
+    from reporter_tpu.obs.trace import Span
+
+    span = Span("t")
+    span.finish()
+    rec.record(span)
+    out = rec.dump(str(tmp_path))
+    assert out is not None
+    assert os.path.basename(out).startswith("reporter_flight_rep-9_")
+    assert json.load(open(out))["traces"]
+
+
+def test_pinned_flight_decision():
+    from reporter_tpu.obs.trace import Span
+
+    rec = obs_flight.FlightRecorder(capacity=8, slow_ms=10_000,
+                                    sample_every=1_000_000)
+    span = Span("t")
+    span.meta["flight_keep"] = "failover"
+    span.finish()
+    assert rec.record(span) == "pinned"
+    plain = Span("t2")
+    plain.finish()
+    assert rec.record(plain) == "dropped"
+    assert rec.find(span.trace_id)[0]["flight_keep"] == "failover"
+
+
+# -- (f) consistency invariant + dump collisions over real processes ----------
+
+
+def test_subprocess_fleet_consistency_and_dump_isolation(engine, tmp_path):
+    """Two REAL serve processes behind an in-proc router: (1) the sum of
+    the federated per-replica ``reporter_requests_total`` counters equals
+    the client-observed request count, and the per-replica split matches
+    the X-Reporter-Replica echoes exactly; (2) both processes share ONE
+    dump dir and their SIGTERM flight dumps land in distinct
+    replica-tagged files."""
+    arrays, _ = engine
+    conf = {
+        "network": {"type": "grid", "rows": 5, "cols": 5,
+                    "spacing_m": 150.0},
+        "matcher": {"search_radius": 50.0},
+        "backend": "cpu",
+        "batch": {"max_batch": 64, "max_wait_ms": 2},
+        "warmup": False,
+    }
+    conf_path = tmp_path / "config.json"
+    conf_path.write_text(json.dumps(conf))
+    dump_dir = tmp_path / "dumps"
+    dump_dir.mkdir()
+    procs = []
+    urls = []
+    try:
+        for i in range(2):
+            env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu",
+                       REPORTER_REPLICA_ID="sub-rep-%d" % i,
+                       REPORTER_FLIGHT_DUMP=str(dump_dir),
+                       REPORTER_FLIGHT_SLOW_MS="0",  # retain everything
+                       REPORTER_DRAIN_GRACE_S="10")
+            p = subprocess.Popen(
+                [sys.executable, "-m", "reporter_tpu.serve",
+                 str(conf_path), "127.0.0.1:0"],
+                env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+            procs.append(p)
+        for p in procs:
+            port = None
+            deadline = time.monotonic() + 90
+            while time.monotonic() < deadline and port is None:
+                line = p.stdout.readline()
+                if not line:
+                    time.sleep(0.05)
+                    continue
+                if b"service on 127.0.0.1:" in line:
+                    port = int(line.split(b"127.0.0.1:")[1].split()[0])
+            assert port, "no bind line from replica"
+            urls.append("http://127.0.0.1:%d" % port)
+        for u in urls:
+            deadline = time.monotonic() + 120
+            while time.monotonic() < deadline:
+                try:
+                    st, h = get_json(u + "/health", timeout=2)
+                    if st == 200 and h.get("backend"):
+                        break
+                except Exception:  # noqa: BLE001 - still booting
+                    pass
+                time.sleep(0.25)
+            else:
+                pytest.fail("replica never became healthy")
+
+        router = FleetRouter(urls, probe_interval_s=0.2)
+        router.start()
+        httpd = router.make_server("127.0.0.1", 0)
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        rurl = "http://127.0.0.1:%d" % httpd.server_port
+        try:
+            observed = {}
+            n = 14
+            for k in range(n):
+                st, hd, _b = post_json(
+                    rurl + "/report", street_trace(arrays, "veh-%d" % k))
+                assert st == 200
+                rid = hd["X-Reporter-Replica"]
+                observed[rid] = observed.get(rid, 0) + 1
+            assert set(observed) == {"sub-rep-0", "sub-rep-1"}
+
+            st, text = get_raw(rurl + "/metrics?pull=1")
+            m = parse_metrics(text)
+            federated = {}
+            for lv, v in m["reporter_requests_total"].items():
+                d = dict(lv)
+                # only the replica-labeled federated samples: the router
+                # process's own registry renders this family too (it
+                # imports serve/service.py), sample-bearing here only
+                # because THIS test process ran in-proc fleets earlier
+                if "replica" in d and d.get("endpoint") == "report":
+                    federated[d["replica"]] = \
+                        federated.get(d["replica"], 0) + int(v)
+            shed = m.get("reporter_router_shed_total", {}).get((), 0)
+            # the invariant: nothing counted twice, nothing lost
+            assert sum(federated.values()) + int(shed) == n
+            assert federated == observed
+        finally:
+            httpd.shutdown()
+            httpd.server_close()
+            router.stop()
+
+        # SIGTERM both: the dumps land in the SHARED dir under distinct
+        # replica-tagged names
+        for p in procs:
+            p.send_signal(signal.SIGTERM)
+        for p in procs:
+            assert p.wait(timeout=30) == 0
+        dumps = sorted(f.name for f in dump_dir.iterdir())
+        assert len(dumps) == 2, dumps
+        assert dumps[0].startswith("reporter_flight_sub-rep-0_")
+        assert dumps[1].startswith("reporter_flight_sub-rep-1_")
+        for f in dump_dir.iterdir():
+            assert json.load(open(f))["traces"]
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()

@@ -11,6 +11,15 @@ supervisor's restart loop is the herd's continuity.  A state file
 harness (tests/fleet_rehearsal.sh) can SIGKILL a specific replica and
 watch the fleet absorb it.
 
+The supervisor is ALSO a federation point (obs/federation.py, the same
+machinery the router's `GET /metrics` serves): it pulls every replica's
+mergeable metrics snapshot on an interval and writes
+`<workdir>/federation.json` — per-replica snapshots + ages/staleness +
+the fleet-merged registry — so a harness that cannot scrape HTTP still
+gets the one-pane-of-glass view, and a SIGKILLed replica's final
+snapshot survives in the file, labeled stale (`--federate-every 0`
+disables).
+
 Lifecycle signals (to THIS process):
 
   SIGUSR1   rolling restart: each replica in turn is SIGTERM'd (graceful
@@ -124,16 +133,21 @@ class Fleet:
                 env, os.path.join(self.workdir, "replica-%d.log" % i),
                 "http://%s:%d" % (self.host, port)))
         urls = ",".join(c.url for c in self.replicas)
+        router_env = dict(base)
+        # the router's shutdown dumps (hop spans) get their own tag so
+        # they never collide with a replica's on a shared dump dir
+        router_env.setdefault("REPORTER_REPLICA_ID", "router")
         self.router = Child(
             "router",
             [sys.executable, "-m", "reporter_tpu.serve.router",
              "--host", self.host, "--port", str(args.router_port),
              "--replicas", urls],
-            dict(base), os.path.join(self.workdir, "router.log"),
+            router_env, os.path.join(self.workdir, "router.log"),
             "http://%s:%d" % (self.host, args.router_port))
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._rolling = threading.Event()
+        self._federator = None
 
     # -- state file ---------------------------------------------------------
 
@@ -198,6 +212,31 @@ class Fleet:
         log.info("rolling restart %s", "complete" if ok else "FAILED")
         return ok
 
+    def federate(self) -> None:
+        """Supervisor-side federation loop: pull every replica's snapshot
+        (obs/federation.py Federator — the same machinery the router
+        serves at /metrics) and write <workdir>/federation.json
+        atomically on each tick.  A dead replica's last snapshot stays
+        in the file, labeled stale — the supervisor keeps the herd's
+        numbers even when the router is the thing that died."""
+        try:
+            from reporter_tpu.obs.federation import Federator
+        except ImportError:  # run from anywhere: tools/ sits next to it
+            sys.path.insert(0, os.path.dirname(
+                os.path.dirname(os.path.abspath(__file__))))
+            from reporter_tpu.obs.federation import Federator
+
+        fed = Federator([c.url for c in self.replicas],
+                        pull_interval_s=self.args.federate_every)
+        self._federator = fed
+        path = os.path.join(self.workdir, "federation.json")
+        while not self._stop.wait(fed.pull_interval_s):
+            fed.pull_all()
+            try:
+                fed.dump(path, extra={"router": self.router.url})
+            except OSError as e:
+                log.warning("federation dump failed: %s", e)
+
     def monitor(self) -> None:
         """Respawn unexpected deaths (crash-only replicas are the fault
         posture: the router keeps serving around the hole while the
@@ -255,6 +294,9 @@ class Fleet:
         mon = threading.Thread(target=self.monitor, daemon=True,
                                name="fleet-monitor")
         mon.start()
+        if self.args.federate_every > 0:
+            threading.Thread(target=self.federate, daemon=True,
+                             name="fleet-federation").start()
         if self.args.rolling_restart_after > 0:
             def _timed():
                 if not self._stop.wait(self.args.rolling_restart_after):
@@ -297,6 +339,9 @@ def main(argv=None) -> int:
     ap.add_argument("--rolling-restart-after", type=float, default=0.0,
                     help="schedule ONE rolling restart this many seconds "
                          "after boot (0 = only on SIGUSR1)")
+    ap.add_argument("--federate-every", type=float, default=5.0,
+                    help="seconds between federation pulls written to "
+                         "<workdir>/federation.json (0 disables)")
     ap.add_argument("--cpu-default", action="store_true",
                     help="default children to JAX_PLATFORMS=cpu when unset")
     args = ap.parse_args(argv)

@@ -1,0 +1,172 @@
+#!/usr/bin/env python3
+"""fleet_top: a live terminal view of the WHOLE serving fleet.
+
+One screen for N replicas, fed entirely by the router's federated
+surfaces (obs/federation.py — no per-replica terminals):
+
+  - per-replica rows from the router's ``GET /metrics`` (every replica's
+    snapshot rides there under a ``replica`` label): p50/p95/p99 of the
+    replica's own ``reporter_slo_latency_seconds`` (interval deltas via
+    the shared ``obs/quantile.py`` math — the same arithmetic every
+    other surface runs), queue depth, inflight, and request counts;
+  - per-replica health state, snapshot age/staleness, draining/degraded
+    flags from the router's ``GET /statusz`` fleet rows — a dead
+    replica's last numbers stay on screen, marked STALE, never blanked;
+  - the fleet verdict line: the router's client-truth SLO (objective
+    values, budget remaining) plus the masking-debt gauge — how much
+    replica budget failover is spending invisibly to clients.
+
+Usage:
+    python tools/fleet_top.py --router http://localhost:8002 [--interval 2]
+    python tools/fleet_top.py --router http://localhost:8002 --once
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+import urllib.request
+from typing import Dict, List, Optional
+
+try:
+    from reporter_tpu.obs.quantile import (
+        delta_buckets,
+        hist_buckets,
+        hist_quantile,
+        parse_metrics,
+    )
+except ImportError:  # run from anywhere: tools/ sits next to the package
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    from reporter_tpu.obs.quantile import (
+        delta_buckets,
+        hist_buckets,
+        hist_quantile,
+        parse_metrics,
+    )
+
+
+def _fmt_ms(v: Optional[float]) -> str:
+    return "-" if v is None else "%.0f" % (v * 1000.0)
+
+
+def _fmt(v, fmt="%d") -> str:
+    return "-" if v is None else fmt % v
+
+
+def replica_ids(metrics: dict) -> List[str]:
+    """Every replica id present in the federated scrape (from the
+    staleness gauge, which exists for every feed — alive or not)."""
+    out = set()
+    for labels in metrics.get("reporter_federation_snapshot_age_seconds",
+                              {}):
+        d = dict(labels)
+        if "replica" in d:
+            out.add(d["replica"])
+    return sorted(out)
+
+
+def scalar(metrics: dict, name: str, match: Dict[str, str]) -> Optional[float]:
+    for labels, v in metrics.get(name, {}).items():
+        d = dict(labels)
+        if all(d.get(k) == want for k, want in match.items()):
+            return v
+    return None
+
+
+def render_frame(metrics: dict, prev: Optional[dict], statusz: dict,
+                 interval_s: float) -> str:
+    lines = ["reporter_tpu fleet_top — %s" % time.strftime("%H:%M:%S")]
+    rows = {r.get("id") or r.get("url"): r
+            for r in statusz.get("fleet", [])}
+    lines.append("")
+    lines.append("replica        state      age_s  q  infl  deg  "
+                 "p50ms  p95ms  p99ms  req/s")
+    for rid in replica_ids(metrics) or sorted(rows):
+        row = rows.get(rid, {})
+        sel = {"replica": rid, "route": "report"}
+        cur = hist_buckets(metrics, "reporter_slo_latency_seconds",
+                           match=sel, merge_children=True)
+        prev_b = hist_buckets(prev, "reporter_slo_latency_seconds",
+                              match=sel, merge_children=True) if prev else None
+        d = delta_buckets(cur, prev_b)
+        n_cur = cur[-1][1] if cur else 0.0
+        n_prev = (prev_b[-1][1] if prev_b else 0.0) if prev else 0.0
+        rate = max(0.0, n_cur - n_prev) / interval_s if prev else None
+        age = scalar(metrics, "reporter_federation_snapshot_age_seconds",
+                     {"replica": rid})
+        stale = scalar(metrics, "reporter_federation_snapshot_stale",
+                       {"replica": rid})
+        state = str(row.get("state") or "?")
+        if stale:
+            state += "*"  # * = snapshot stale (last numbers, not live)
+        lines.append("%-14s %-10s %5s %2s %5s %4s %6s %6s %6s %6s" % (
+            rid[:14], state[:10],
+            _fmt(age, "%.1f"),
+            _fmt(row.get("queue_depth")),
+            _fmt(row.get("inflight")),
+            ("y" if row.get("degraded") else
+             "drn" if row.get("draining") else "-"),
+            _fmt_ms(hist_quantile(d, 0.50)),
+            _fmt_ms(hist_quantile(d, 0.95)),
+            _fmt_ms(hist_quantile(d, 0.99)),
+            _fmt(rate, "%.1f") if rate is not None else "-"))
+    lines.append("")
+    slo = statusz.get("slo") or {}
+    verdict = "OK" if slo.get("ok") else "VIOLATING"
+    parts = []
+    for name, st in sorted((slo.get("objectives") or {}).items()):
+        parts.append("%s=%s (budget %.0f%%)" % (
+            name,
+            "-" if st.get("value") is None else "%.4g" % st["value"],
+            100.0 * (st.get("budget_remaining") or 0.0)))
+    lines.append("fleet SLO: %s   %s" % (verdict, "  ".join(parts)))
+    debt = statusz.get("masking_debt") or {}
+    hot = {k: v for k, v in sorted(debt.items()) if v}
+    lines.append("masking debt: %s" % (
+        "  ".join("%s=%.3f" % kv for kv in hot.items()) if hot
+        else "0 (no replica burn hidden by failover)"))
+    lines.append("  (* = stale snapshot: the replica's LAST numbers; "
+                 "deg: y=degraded drn=draining)")
+    return "\n".join(lines)
+
+
+def _fetch(url: str, timeout: float = 5.0) -> bytes:
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.read()
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--router", required=True,
+                    help="fleet router base url, e.g. http://localhost:8002")
+    ap.add_argument("--interval", type=float, default=2.0)
+    ap.add_argument("--once", action="store_true", help="one frame, no clear")
+    args = ap.parse_args(argv)
+
+    base = args.router.rstrip("/")
+    prev = None
+    while True:
+        try:
+            metrics = parse_metrics(_fetch(base + "/metrics").decode())
+            statusz = json.loads(_fetch(base + "/statusz").decode())
+        except Exception as e:  # noqa: BLE001 - keep polling through restarts
+            sys.stderr.write("fleet_top: poll failed: %s\n" % (e,))
+            if args.once:
+                return 1
+            time.sleep(args.interval)
+            continue
+        frame = render_frame(metrics, prev, statusz, args.interval)
+        if args.once:
+            print(frame)
+            return 0
+        sys.stdout.write("\x1b[2J\x1b[H" + frame + "\n")
+        sys.stdout.flush()
+        prev = metrics
+        time.sleep(args.interval)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -515,11 +515,23 @@ def main(argv=None) -> int:
 
     server_slo = None
     agree = None
+    masking_debt = None
     if args.server_slo:
         span_s = max(60.0, max(s.done for s in all_samples) + 30.0)
         server_slo = fetch_json(base + "/debug/slo?window=%d" % int(span_s))
         if server_slo is not None:
             agree = bool(server_slo.get("ok")) == bool(client["ok"])
+            # a fleet router's verdict carries the masking-debt gauge
+            # (obs/federation.py): replica budget failover hid from this
+            # client.  Surfaced loudly — a PASSING run with a fat debt
+            # means a replica is rotting behind successful failovers.
+            masking_debt = server_slo.get("masking_debt")
+            hot = {k: v for k, v in (masking_debt or {}).items() if v}
+            if hot:
+                sys.stderr.write(
+                    "loadgen: fleet masking debt %s — replica-level burn "
+                    "masked by failover (fleet verdict unaffected)\n"
+                    % json.dumps(hot))
 
     artifact = {
         # perf_gate-consumable header (docs/bench-schema.md shape)
@@ -556,6 +568,7 @@ def main(argv=None) -> int:
                        "objectives": client["objectives"]},
             "server": server_slo,
             "agree": agree,
+            "masking_debt": masking_debt,
         },
         "ramp": steps_out if args.ramp else None,
         "knee_rps": knee if args.ramp else None,

@@ -1,7 +1,8 @@
 #!/usr/bin/env python
 """trace_top: a live terminal view of the serving pipeline.
 
-Polls ``GET /metrics`` and ``GET /debug/traces`` and renders, per refresh:
+Polls ``GET /metrics`` and ``GET /debug/traces`` on EVERY target and
+renders, per refresh:
 
   - per-stage p50/p95/p99 (queue wait, device step) computed from the
     histogram bucket deltas over the poll interval (cumulative-since-boot
@@ -11,14 +12,24 @@ Polls ``GET /metrics`` and ``GET /debug/traces`` and renders, per refresh:
     per-stage breakdowns, so a tail-latency spike on the quantile row is
     one glance away from the trace ids that caused it.
 
+Targets: repeat ``--target`` for several replicas (their histograms are
+MERGED bucket-wise via the shared ``obs/quantile.py`` math — one fleet
+quantile, not N per-host ones), or point a single ``--target`` at the
+fleet ROUTER, whose ``GET /metrics`` already serves every replica's
+snapshot federated under a ``replica`` label (obs/federation.py) — both
+roads collapse to the same merged view.  ``--url`` remains as an alias
+for one target.
+
 Usage:
-    python tools/trace_top.py --url http://localhost:8002 [--interval 2]
-    python tools/trace_top.py --url http://localhost:8002 --once
+    python tools/trace_top.py --target http://localhost:8002 [--interval 2]
+    python tools/trace_top.py --target http://h1:8010 --target http://h2:8010
+    python tools/trace_top.py --target http://router:8002 --once
 
 Dependency-free beyond ``reporter_tpu.obs`` (itself pure stdlib); the
-parsing/quantile math lives in ``reporter_tpu/obs/quantile.py`` — ONE
-implementation shared with the SLO engine and tools/loadgen.py, pinned by
-tests/test_slo.py (and exercised here by tests/test_trace.py).
+parsing/quantile/merge math lives in ``reporter_tpu/obs/quantile.py`` —
+ONE implementation shared with the SLO engine and tools/loadgen.py,
+pinned by tests/test_slo.py (and exercised here by tests/test_trace.py +
+tests/test_federation.py).
 """
 
 from __future__ import annotations
@@ -36,6 +47,7 @@ try:
         delta_buckets,
         hist_buckets,
         hist_quantile,
+        merge_parsed,
         parse_metrics,
     )
 except ImportError:  # run from anywhere: tools/ sits next to the package
@@ -44,12 +56,17 @@ except ImportError:  # run from anywhere: tools/ sits next to the package
         delta_buckets,
         hist_buckets,
         hist_quantile,
+        merge_parsed,
         parse_metrics,
     )
 
 
-def scalar(metrics: dict, name: str, labels: Tuple[Tuple[str, str], ...] = ()) -> float:
-    return metrics.get(name, {}).get(labels, 0.0)
+def scalar(metrics: dict, name: str) -> float:
+    """Sum of every sample of a family — with one plain target that is
+    the single unlabeled sample; with several targets (or a federated
+    router scrape's per-replica children) the values aggregate by
+    addition, the same semantics as ``obs.metrics.merge``."""
+    return sum(metrics.get(name, {}).values())
 
 
 def _fmt_ms(v: Optional[float]) -> str:
@@ -57,21 +74,26 @@ def _fmt_ms(v: Optional[float]) -> str:
 
 
 def render_frame(metrics: dict, prev: Optional[dict], traces: List[dict],
-                 interval_s: float) -> str:
-    lines = ["reporter_tpu trace_top — %s" % time.strftime("%H:%M:%S")]
+                 interval_s: float, n_targets: int = 1) -> str:
+    head = "reporter_tpu trace_top — %s" % time.strftime("%H:%M:%S")
+    if n_targets > 1:
+        head += "  (%d targets merged)" % n_targets
+    lines = [head]
     lines.append("")
     lines.append("stage                      p50ms   p95ms   p99ms")
     for label, fam in (("queue wait", "reporter_microbatch_queue_wait_seconds"),
                        ("device step", "reporter_microbatch_device_step_seconds")):
-        cur = hist_buckets(metrics, fam)
-        prev_b = hist_buckets(prev, fam) if prev else None
+        cur = hist_buckets(metrics, fam, merge_children=True)
+        prev_b = hist_buckets(prev, fam, merge_children=True) if prev else None
         d = delta_buckets(cur, prev_b)
         lines.append("%-24s %7s %7s %7s" % (
             label, _fmt_ms(hist_quantile(d, 0.50)),
             _fmt_ms(hist_quantile(d, 0.95)), _fmt_ms(hist_quantile(d, 0.99))))
     fill = delta_buckets(
-        hist_buckets(metrics, "reporter_microbatch_batch_fill"),
-        hist_buckets(prev, "reporter_microbatch_batch_fill") if prev else None)
+        hist_buckets(metrics, "reporter_microbatch_batch_fill",
+                     merge_children=True),
+        hist_buckets(prev, "reporter_microbatch_batch_fill",
+                     merge_children=True) if prev else None)
     n_batches = fill[-1][1] if fill else 0
     fill_sum = scalar(metrics, "reporter_microbatch_batch_fill_sum") - (
         scalar(prev, "reporter_microbatch_batch_fill_sum") if prev else 0.0)
@@ -112,29 +134,58 @@ def _fetch(url: str, timeout: float = 5.0) -> bytes:
         return r.read()
 
 
+def poll_targets(targets: List[str], n_traces: int) -> Tuple[dict, List[dict]]:
+    """One frame's data: every target's /metrics parsed and merged, every
+    target's retained traces concatenated.  A single dead target does
+    not blank the frame — its contribution is just absent this poll."""
+    frames = []
+    traces: List[dict] = []
+    errors = []
+    for base in targets:
+        try:
+            frames.append(parse_metrics(_fetch(base + "/metrics").decode()))
+            traces.extend(json.loads(_fetch(
+                base + "/debug/traces?n=%d" % n_traces
+            ).decode()).get("traces", []))
+        except Exception as e:  # noqa: BLE001 - keep polling the rest
+            errors.append("%s: %s" % (base, e))
+    if not frames:
+        raise RuntimeError("; ".join(errors) or "no targets answered")
+    for msg in errors:
+        sys.stderr.write("trace_top: poll failed: %s\n" % msg)
+    return merge_parsed(frames), traces
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("--url", required=True, help="service base url, e.g. "
-                    "http://localhost:8002")
+    ap.add_argument("--target", action="append", default=[],
+                    help="service base url (repeatable: several replicas "
+                         "are merged; a fleet router target arrives "
+                         "pre-federated)")
+    ap.add_argument("--url", default=None,
+                    help="alias for a single --target (back-compat)")
     ap.add_argument("--interval", type=float, default=2.0)
     ap.add_argument("--n", type=int, default=50, help="traces to fetch")
     ap.add_argument("--once", action="store_true", help="one frame, no clear")
     args = ap.parse_args(argv)
 
-    base = args.url.rstrip("/")
+    targets = [u.rstrip("/") for u in args.target]
+    if args.url:
+        targets.append(args.url.rstrip("/"))
+    if not targets:
+        ap.error("need --target (or --url)")
     prev = None
     while True:
         try:
-            metrics = parse_metrics(_fetch(base + "/metrics").decode())
-            traces = json.loads(_fetch(
-                base + "/debug/traces?n=%d" % args.n).decode()).get("traces", [])
+            metrics, traces = poll_targets(targets, args.n)
         except Exception as e:  # noqa: BLE001 - keep polling through restarts
             sys.stderr.write("trace_top: poll failed: %s\n" % (e,))
             if args.once:
                 return 1
             time.sleep(args.interval)
             continue
-        frame = render_frame(metrics, prev, traces, args.interval)
+        frame = render_frame(metrics, prev, traces, args.interval,
+                             n_targets=len(targets))
         if args.once:
             print(frame)
             return 0
