@@ -478,7 +478,7 @@ def run_device() -> int:
         # the host sync cost.  Sizes come from xin_long, not the enclosing
         # px — later sections rebind px to other cohorts (the profiler
         # section used to crash on exactly that shadowing).
-        host_parts, outs = matcher._dispatch_long_group(
+        host_parts, outs, _aux = matcher._dispatch_long_group(
             xin_long, n_chunks, W, kernel=kernel or primary_kernel)
         if collect:
             # device-side concat -> one fetch (mirrors _fetch_long)

@@ -43,31 +43,40 @@ class BruteForceMatcher:
         self.a = arrays
         self.cfg = cfg
         self._route_cache: Dict[int, Tuple[Dict[int, float], Dict[int, float]]] = {}
+        self._seg_geom = None  # lazy f64 segment geometry (candidates())
 
     # -- exhaustive candidates (float64, no grid) ---------------------------
 
     def candidates(self, x: float, y: float) -> List[Tuple[int, float, float]]:
         """[(edge, offset_m, dist_m)] for EVERY edge within search_radius,
         nearest first.  Distances in float64 against every shape segment of
-        every edge — no spatial index at all."""
+        every edge — no spatial index at all.  The sweep itself is one
+        vectorised numpy pass (bit-identical elementwise f64 math to the
+        scalar loop it replaced; numpy releases the GIL in the array ops,
+        which matters now that obs/quality.py runs this oracle on a
+        background thread next to live serving); only the handful of
+        in-radius segments fall back to a Python reduction."""
         a = self.a
+        if self._seg_geom is None:
+            ax = np.asarray(a.shp_ax, np.float64)
+            ay = np.asarray(a.shp_ay, np.float64)
+            vx = np.asarray(a.shp_bx, np.float64) - ax
+            vy = np.asarray(a.shp_by, np.float64) - ay
+            self._seg_geom = (ax, ay, vx, vy, vx * vx + vy * vy,
+                              np.asarray(a.shp_off, np.float64),
+                              np.asarray(a.shp_len, np.float64))
+        ax, ay, vx, vy, L2, shp_off, shp_len = self._seg_geom
+        safe_l2 = np.where(L2 == 0.0, 1.0, L2)
+        t = ((x - ax) * vx + (y - ay) * vy) / safe_l2
+        t = np.where(L2 == 0.0, 0.0, np.minimum(1.0, np.maximum(0.0, t)))
+        d = np.hypot(x - (ax + t * vx), y - (ay + t * vy))
         best: Dict[int, Tuple[float, float]] = {}  # edge -> (dist, offset)
-        for s in range(len(a.shp_edge)):
+        for s in np.nonzero(d <= float(self.cfg.search_radius))[0]:
             e = int(a.shp_edge[s])
-            ax, ay = float(a.shp_ax[s]), float(a.shp_ay[s])
-            bx, by = float(a.shp_bx[s]), float(a.shp_by[s])
-            vx, vy = bx - ax, by - ay
-            L2 = vx * vx + vy * vy
-            t = 0.0 if L2 == 0.0 else max(
-                0.0, min(1.0, ((x - ax) * vx + (y - ay) * vy) / L2))
-            dx, dy = x - (ax + t * vx), y - (ay + t * vy)
-            d = math.hypot(dx, dy)
-            if d > float(self.cfg.search_radius):
-                continue
-            off = float(a.shp_off[s]) + t * float(a.shp_len[s])
-            if e not in best or d < best[e][0]:
-                best[e] = (d, off)
-        out = [(e, off, d) for e, (d, off) in best.items()]
+            ds = float(d[s])
+            if e not in best or ds < best[e][0]:
+                best[e] = (ds, float(shp_off[s]) + float(t[s]) * float(shp_len[s]))
+        out = [(e, off, dd) for e, (dd, off) in best.items()]
         out.sort(key=lambda c: c[2])
         return out
 

@@ -49,6 +49,16 @@ Points and spec grammar (value of ``REPORTER_FAULT_<POINT>``):
                 so the fleet-rehearsal's masking-debt assertion has a
                 deterministic fleet-good/replica-bad request
                 (docs/observability.md "Fleet observability")
+  quality_skew  "<metres>[:N]"   (decimal form, e.g. "30.0" — a bare
+                integer parses as the raise-N grammar)
+                perturb the device batch's projected coordinates with
+                deterministic <metres>-sigma noise at matcher row-fill —
+                equivalent to corrupting every emission score — so the
+                SERVED match silently degrades while the shadow oracle
+                (which re-matches the ORIGINAL trace, obs/quality.py)
+                sees the truth: the quality drift fixture the agreement
+                burn alert and tools/quality_gate.py must catch
+                (docs/match-quality.md)
 
 Counts are consumed per (point, spec) pair, so changing the spec re-arms
 the point and clearing the variable disarms it; ``reset()`` re-arms
@@ -74,7 +84,7 @@ C_INJECTED = obs.counter(
 
 POINTS = ("dispatch", "device_hang", "ubodt_probe", "store_put",
           "client_post", "router_connect", "replica_slow_accept",
-          "health_flap", "replica_shed")
+          "health_flap", "replica_shed", "quality_skew")
 
 _lock = threading.Lock()
 _consumed: dict = {}  # (point, raw_spec) -> times fired

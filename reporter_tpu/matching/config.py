@@ -61,6 +61,16 @@ class MatcherConfig:
     # recursion to chain; False = the legacy fused per-chunk program.
     # $REPORTER_LONG_PRECOMPUTE=0|1 overrides at runtime.
     long_precompute: bool = True
+    # per-trace kernel confidence diagnostics (docs/match-quality.md):
+    # True routes dispatches through the *_aux packed programs, which
+    # additionally return a [B, 4] confidence block (winner-vs-runner-up
+    # viterbi margins, candidate-pool exhaustion counts) attached to each
+    # match result as "_quality".  Off by default so library callers and
+    # the bit-exact differential suites see byte-identical results; the
+    # serve entrypoint turns it on ($REPORTER_QUALITY_AUX overrides).
+    # Margins carry the kernels' documented float-associativity ULP
+    # wiggle and are diagnostics only.
+    quality_aux: bool = False
     # batch rungs pre-dispatched per length bucket by warmup passes
     # (serve --warmup / batch --warmup); each snaps up to a ladder rung
     warmup_batch_sizes: List[int] = field(default_factory=lambda: [1])

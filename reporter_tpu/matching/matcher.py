@@ -182,6 +182,25 @@ class SegmentMatcher:
             self._long_pre = env_lp not in ("0", "false", "off", "no")
         else:
             self._long_pre = bool(getattr(self.cfg, "long_precompute", True))
+        # kernel confidence diagnostics (docs/match-quality.md): when on,
+        # dispatches route through the *_aux packed programs and every
+        # match result carries a "_quality" block (per-point edges,
+        # winner-vs-runner-up margins, pool-exhaustion fraction).  Off by
+        # default — library callers and the bit-exact differential suites
+        # must see byte-identical results; serve turns it on.
+        env_qa = os.environ.get("REPORTER_QUALITY_AUX", "").strip().lower()
+        if env_qa:
+            self._quality_aux = env_qa not in ("0", "false", "off", "no")
+        else:
+            self._quality_aux = bool(getattr(self.cfg, "quality_aux", False))
+        # per-request MatchParams (ROADMAP open item 4's tuning surface):
+        # the reference wire contract's sigma_z / beta / search_radius /
+        # gps_accuracy ride match_options; MatchParams are traced scalars,
+        # so a custom value is the SAME compiled program with different
+        # inputs — requests group by effective-params key and dispatch as
+        # separate batches, no recompile.  Bounded caches.
+        self._params_cache: Dict[tuple, object] = {}
+        self._cpu_params_cache: Dict[tuple, object] = {}
         # per-(B_pad,...) pinned staging buffers for batch-dimension padding:
         # the dp-remainder and ladder pads run on every dispatch, and a fresh
         # np.concatenate per call reallocated (and re-faulted) the same
@@ -299,24 +318,31 @@ class SegmentMatcher:
         conventions."""
         if kind == "pre":
             kernel = "none"
-        key = (kind, kernel)
+        # the aux (confidence-diagnostics) flag selects program VARIANTS
+        # for the compact/chain kinds, so it is part of the cache key — a
+        # matcher whose flag flips mid-life (quality engine attach) pays
+        # one fresh compile instead of replaying the wrong program
+        qa = self._quality_aux and kind in ("compact", "chain")
+        key = (kind, kernel, qa)
         fn = self._jits.get(key)
         if fn is None:
             if self._n_gp > 1:
                 if kind == "pre":
                     self._jits[key] = self._make_gp_pre_jit()
                 else:
-                    built = self._make_gp_jits(kernel)
+                    built = self._make_gp_jits(kernel, aux=qa)
                     for kd in ("compact", "carry", "chain"):
-                        self._jits[(kd, kernel)] = built[kd]
+                        self._jits[(kd, kernel,
+                                    qa and kd in ("compact", "chain"))] = built[kd]
             else:
                 import functools
 
                 import jax
 
                 from ..ops.viterbi import (
-                    chain_batch_carry_packed, match_batch_carry_packed,
-                    match_batch_compact_packed, precompute_batch_packed,
+                    chain_batch_carry_packed, chain_batch_carry_packed_aux,
+                    match_batch_carry_packed, match_batch_compact_packed,
+                    match_batch_compact_packed_aux, precompute_batch_packed,
                 )
 
                 # in-batch probe dedup applies where the UBODT probe sees a
@@ -331,15 +357,18 @@ class SegmentMatcher:
                             dedup=self._probe_dedup),
                         static_argnums=(4,))
                 elif kind == "compact":
+                    base = (match_batch_compact_packed_aux if qa
+                            else match_batch_compact_packed)
                     self._jits[key] = jax.jit(
                         functools.partial(
-                            match_batch_compact_packed, kernel=kernel,
+                            base, kernel=kernel,
                             dedup=self._probe_dedup),
                         static_argnums=(4,))
                 else:
                     base, k_argnum = {
                         "carry": (match_batch_carry_packed, 4),
-                        "chain": (chain_batch_carry_packed, 5),
+                        "chain": (chain_batch_carry_packed_aux if qa
+                                  else chain_batch_carry_packed, 5),
                     }[kind]
                     self._jits[key] = jax.jit(
                         functools.partial(base, kernel=kernel),
@@ -366,7 +395,111 @@ class SegmentMatcher:
             return self._kernel_mode
         return "assoc" if T >= self._assoc_threshold else "scan"
 
-    def _make_gp_jits(self, kernel: str = "scan"):
+    # -- per-request match parameters (reference wire contract parity) -----
+    #
+    # The reference accepts sigma_z / beta / search_radius / gps_accuracy
+    # per request in match_options (valhalla trace_options).  MatchParams
+    # are traced jnp scalars, so honoring them costs no recompile: traces
+    # group by effective-params key and dispatch as separate batches of
+    # the same compiled programs.  This is the live tuning surface for the
+    # sparse-sampling accuracy chase (ROADMAP open item 4), and quality
+    # samples are labeled with it (obs/quality.py).
+
+    _PARAM_KEYS = ("sigma_z", "beta", "search_radius", "gps_accuracy")
+
+    def effective_match_options(self, match_options) -> dict:
+        """The HMM parameters this matcher would actually use for a
+        request carrying ``match_options`` — overrides applied, invalid
+        values ignored (the service 400s them first; library callers
+        degrade to the config), search_radius clamped to cell_size/2 so
+        the 2x2 quadrant candidate sweep stays exhaustive.  The serve
+        tier echoes this dict in ?debug=1 responses."""
+        mo = match_options if isinstance(match_options, dict) else {}
+
+        def _num(key, default):
+            v = mo.get(key)
+            try:
+                v = float(v)
+            except (TypeError, ValueError):
+                return float(default)
+            return v if v > 0 and np.isfinite(v) else float(default)
+
+        # gps_accuracy is the wire's sigma-like knob: it sets sigma_z only
+        # when sigma_z itself is absent (valhalla precedence)
+        sigma = _num("sigma_z", _num("gps_accuracy", self.cfg.sigma_z))
+        radius = _num("search_radius", self.cfg.search_radius)
+        max_radius = float(self.arrays.cell_size) / 2.0
+        return {
+            "sigma_z": sigma,
+            "beta": _num("beta", self.cfg.beta),
+            "search_radius": min(radius, max_radius),
+            "shape_match": mo.get("shape_match", "map_snap"),
+        }
+
+    def _params_key(self, trace) -> tuple:
+        """Effective-params grouping key for one trace: () = the config
+        defaults (the fast path: no override keys present), else the
+        (sigma_z, beta, search_radius) float triple."""
+        mo = trace.get("match_options") if isinstance(trace, dict) else None
+        if not isinstance(mo, dict) or not any(
+                k in mo for k in self._PARAM_KEYS):
+            return ()
+        eff = self.effective_match_options(mo)
+        key = (eff["sigma_z"], eff["beta"], eff["search_radius"])
+        if key == (float(self.cfg.sigma_z), float(self.cfg.beta),
+                   float(self.cfg.search_radius)):
+            return ()
+        return key
+
+    def _params_for(self, pkey: tuple):
+        """Device MatchParams for a params key (() = the shared default).
+        Cached per key (bounded) and replicated over the mesh like the
+        default params."""
+        if not pkey:
+            return self._params
+        mp = self._params_cache.get(pkey)
+        if mp is None:
+            import dataclasses
+
+            import jax
+
+            from ..ops.viterbi import MatchParams
+
+            if len(self._params_cache) >= 64:
+                self._params_cache.clear()
+            cfg = dataclasses.replace(
+                self.cfg, sigma_z=pkey[0], beta=pkey[1],
+                search_radius=pkey[2])
+            mp = MatchParams.from_config(cfg)
+            if self._mesh is not None:
+                from jax.sharding import NamedSharding, PartitionSpec as P
+
+                mp = jax.device_put(mp, NamedSharding(self._mesh, P()))
+            self._params_cache[pkey] = mp
+        return mp
+
+    def _cpu_for(self, pkey: tuple):
+        """The cpu-backend twin of _params_for: a CPUViterbiMatcher over
+        the SAME arrays + UBODT with the effective params baked into its
+        config (the oracle is config-bound, not traced)."""
+        if not pkey:
+            return self._cpu
+        cpu = self._cpu_params_cache.get(pkey)
+        if cpu is None:
+            import dataclasses
+
+            from ..baseline.cpu_matcher import CPUViterbiMatcher
+
+            if len(self._cpu_params_cache) >= 16:
+                self._cpu_params_cache.clear()
+            cfg = dataclasses.replace(
+                self.cfg, sigma_z=pkey[0], beta=pkey[1],
+                search_radius=pkey[2])
+            cpu = CPUViterbiMatcher(self.arrays, self.ubodt, cfg)
+            self._cpu_params_cache[pkey] = cpu
+        return cpu
+
+    def _make_gp_jits(self, kernel: str = "scan", aux: bool = False):
         """shard_map'd compact/carry jits for the dp×gp mesh: batch arrays
         split over dp, the UBODT's bucket ranges over gp, probes resolved
         with collectives inside (the plain sharded-jit path cannot express
@@ -374,17 +507,27 @@ class SegmentMatcher:
         keeps the (…, params, k[, carry]) calling convention of the plain
         jits so _dispatch_batch/_match_long stay oblivious (both speak the
         packed [4, B, T] -> [3, B, T] transport; the batch axis of a packed
-        array is axis 1)."""
+        array is axis 1).  ``aux`` routes compact/chain through the
+        confidence-diagnostics variants, whose extra [B, 4] output shards
+        over the batch axis like the carry pytree."""
         import jax
         from jax.sharding import PartitionSpec as P
 
-        from ..ops.viterbi import match_batch_carry_packed, match_batch_compact_packed
+        from ..ops.viterbi import (
+            chain_batch_carry_packed, chain_batch_carry_packed_aux,
+            match_batch_carry_packed, match_batch_compact_packed,
+            match_batch_compact_packed_aux,
+        )
         from ..parallel.mesh import BATCH_AXIS, GRAPH_AXIS
 
         k = self.cfg.beam_k
+        compact_fn = (match_batch_compact_packed_aux if aux
+                      else match_batch_compact_packed)
+        chain_fn = (chain_batch_carry_packed_aux if aux
+                    else chain_batch_carry_packed)
 
         def body_compact(dg, du, xin, p):
-            return match_batch_compact_packed(
+            return compact_fn(
                 dg, du.with_shard_axis(GRAPH_AXIS), xin, p, k, kernel)
 
         def body_carry(dg, du, xin, p, carry):
@@ -392,17 +535,16 @@ class SegmentMatcher:
                 dg, du.with_shard_axis(GRAPH_AXIS), xin, p, k, carry, kernel)
 
         def body_chain(dg, du, pre, xin, p, carry):
-            from ..ops.viterbi import chain_batch_carry_packed
-
-            return chain_batch_carry_packed(
+            return chain_fn(
                 dg, du.with_shard_axis(GRAPH_AXIS), pre, xin, p, k, carry,
                 kernel)
 
         bat = P(None, BATCH_AXIS)  # packed arrays: [field, B, T]
+        row = P(BATCH_AXIS)  # carry pytrees / [B, 4] aux blocks
         sm_compact = jax.jit(jax.shard_map(
             body_compact, mesh=self._mesh,
             in_specs=(P(), P(GRAPH_AXIS), bat, P()),
-            out_specs=bat, check_vma=False,
+            out_specs=(bat, row) if aux else bat, check_vma=False,
         ))
         sm_carry = jax.jit(jax.shard_map(
             body_carry, mesh=self._mesh,
@@ -413,7 +555,8 @@ class SegmentMatcher:
             body_chain, mesh=self._mesh,
             in_specs=(P(), P(GRAPH_AXIS), P(BATCH_AXIS), bat, P(),
                       P(BATCH_AXIS)),
-            out_specs=(bat, P(BATCH_AXIS)), check_vma=False,
+            out_specs=(bat, row, P(BATCH_AXIS)) if aux
+            else (bat, P(BATCH_AXIS)), check_vma=False,
         ))
         return {
             "compact": lambda dg, du, xin, p, _k: sm_compact(dg, du, xin, p),
@@ -466,9 +609,13 @@ class SegmentMatcher:
             return jax.device_put(xin, self._batch_sharding)
         return jnp.asarray(xin)
 
-    def _dispatch_batch(self, px: np.ndarray, py: np.ndarray, times: np.ndarray, valid: np.ndarray):
+    def _dispatch_batch(self, px: np.ndarray, py: np.ndarray, times: np.ndarray, valid: np.ndarray,
+                        pkey: tuple = ()):
         """Queue one [B, T] padded batch on the backend without blocking.
-        Returns an opaque handle for _collect_batch."""
+        Returns an opaque handle for _collect_batch.  ``pkey`` selects a
+        per-request effective-params group (see _params_key; () = the
+        config defaults): MatchParams are traced scalars, so a custom
+        group runs the SAME compiled program with different inputs."""
         # chaos seam: a UBODT probe-program failure surfaces mid-call, per
         # chunk, unlike the dispatch point at match_many_async entry
         faults.maybe_raise("ubodt_probe")
@@ -477,6 +624,8 @@ class SegmentMatcher:
 
             B = px.shape[0]
             kernel = self._kernel_for(px.shape[1])
+            qa = self._quality_aux
+            p = self._params_for(pkey)
             fn = self._get_jit("compact", kernel)
             if self._mesh is not None and px.shape[0] % self._n_dp:
                 # dp sharding splits the batch axis evenly across chips
@@ -486,19 +635,23 @@ class SegmentMatcher:
                 )
             xin = self._put_packed(pack_inputs(px, py, times, valid))
             t0 = _time.monotonic()
-            res = fn(self._dg, self._du, xin, self._params, self.cfg.beam_k)
+            res = fn(self._dg, self._du, xin, p, self.cfg.beam_k)
+            aux = None
+            if qa:
+                res, aux = res
             C_DISPATCHES.labels(kernel).inc()
             C_DISPATCH_COHORT.labels("bucketed", "compact").inc()
             self._note_dispatch(
                 px.shape, _time.monotonic() - t0, kernel=kernel, fn=fn,
-                args=(self._dg, self._du, xin, self._params, self.cfg.beam_k))
+                args=(self._dg, self._du, xin, p, self.cfg.beam_k))
             if self._probe_every:
                 self._dispatch_count += 1
                 if self._dispatch_count % self._probe_every == 0:
                     self._record_probe_stats(xin)
             self._start_host_copy(res)
-            return ("jax", B, res)
-        return ("cpu", self._cpu.run_batch(px, py, times, valid))
+            return ("jax", B, res, aux)
+        cpu = self._cpu if not pkey else self._cpu_for(pkey)
+        return ("cpu", cpu.run_batch(px, py, times, valid))
 
     def _note_dispatch(self, shape, dt: float, kind: str = "",
                        kernel: str = "scan", fn=None, args=None) -> None:
@@ -622,15 +775,23 @@ class SegmentMatcher:
     def _collect_batch(self, handle):
         """Block on a _dispatch_batch handle -> (edge, offset, break) numpy.
         One fetch: the device result is a packed [3, B, T] i32 array."""
+        return self._collect_batch_aux(handle)[0]
+
+    def _collect_batch_aux(self, handle):
+        """_collect_batch plus the per-trace confidence block: ((edge,
+        offset, break), aux [B, 4] numpy or None) — None on the cpu
+        backend and whenever quality diagnostics are off."""
         if handle[0] == "jax":
             from ..ops.viterbi import unpack_compact
 
-            _, B, res = handle
+            _, B, res, aux = handle
             if self._probe_pending:
                 self._harvest_probe_stats()
             edge, offset, breaks = unpack_compact(res)
-            return edge[:B], offset[:B], breaks[:B]
-        return handle[1]
+            if aux is not None:
+                aux = np.asarray(aux)[:B]
+            return (edge[:B], offset[:B], breaks[:B]), aux
+        return handle[1], None
 
     def _run_batch(self, px: np.ndarray, py: np.ndarray, times: np.ndarray, valid: np.ndarray):
         """[B, T] padded batch -> per-point (edge, offset, break) numpy arrays."""
@@ -665,30 +826,35 @@ class SegmentMatcher:
             str(t.get("uuid", "")) for t in traces if isinstance(t, dict)))
         results: List[Optional[dict]] = [None] * len(traces)
 
-        # bucket by padded length; traces beyond the largest bucket stream
-        # through fixed windows with carried Viterbi state (jax backend)
-        # instead of compiling ever-larger shapes
-        buckets: Dict[int, List[int]] = {}
-        long_idxs: List[int] = []
+        # bucket by (effective-params group, padded length); traces beyond
+        # the largest bucket stream through fixed windows with carried
+        # Viterbi state (jax backend) instead of compiling ever-larger
+        # shapes.  The params key is () for default-config traffic (the
+        # fast path), so a fleet without per-request overrides batches
+        # exactly as before.
+        buckets: Dict[tuple, List[int]] = {}
+        long_map: Dict[tuple, List[int]] = {}
         max_bucket = self.cfg.length_buckets[-1] if self.cfg.length_buckets else 256
         for i, tr in enumerate(traces):
             n = len(tr["trace"])
             if n == 0:
                 results[i] = {"segments": []}
                 continue
+            pkey = self._params_key(tr)
             if n > max_bucket and self.backend == "jax":
-                long_idxs.append(i)
+                long_map.setdefault(pkey, []).append(i)
                 continue
-            buckets.setdefault(self._bucket_len(n), []).append(i)
+            buckets.setdefault((pkey, self._bucket_len(n)), []).append(i)
 
         # cap the device batch: the kernel materialises [B, T, K, K]
         # transition arrays, so bound B*T (and rows on top); rounded down to a
         # power of two so the pow2 batch padding below cannot overshoot it
         chunks = []
-        for blen, idxs in sorted(buckets.items()):
+        for (pkey, blen), idxs in sorted(buckets.items()):
             cap = self._device_cap(blen)
             chunks.extend(
-                (blen, idxs[i : i + cap]) for i in range(0, len(idxs), cap)
+                (pkey, blen, idxs[i : i + cap])
+                for i in range(0, len(idxs), cap)
             )
         # pipeline: keep a few chunks in flight on the device (jax dispatch
         # is async) so host association of chunk i overlaps device compute of
@@ -701,12 +867,13 @@ class SegmentMatcher:
 
         def drain_one():
             idxs_, handle_, times_ = pending.popleft()
-            edge, offset, breaks = self._collect_batch(handle_)
-            self._associate_and_store(idxs_, edge, offset, breaks, times_, results)
+            res, aux = self._collect_batch_aux(handle_)
+            self._associate_and_store(idxs_, *res, times_, results, aux=aux)
 
-        for blen, idxs in chunks:
+        for pkey, blen, idxs in chunks:
             px, py, tm, valid, times = self._fill_rows(traces, idxs, blen)
-            handle = self._dispatch_batch(*self._pad_batch_staged(px, py, tm, valid))
+            handle = self._dispatch_batch(
+                *self._pad_batch_staged(px, py, tm, valid), pkey=pkey)
             pending.append((idxs, handle, times))
             if len(pending) >= PIPELINE_DEPTH:
                 drain_one()
@@ -717,9 +884,9 @@ class SegmentMatcher:
         # of this call is already queued -- the device never idles behind
         # host association (VERDICT r04 next #2b: device_util 0.45 because
         # long compute serialised after bucketed association).
-        long_handles = (
-            self._dispatch_long(traces, long_idxs) if long_idxs else []
-        )
+        long_handles = []
+        for pkey, lidx in sorted(long_map.items()):
+            long_handles.extend(self._dispatch_long(traces, lidx, pkey=pkey))
 
         def finish() -> List[dict]:
             # chaos seam: a wedged device step (the serve watchdog's prey)
@@ -744,22 +911,25 @@ class SegmentMatcher:
                 # than taxing the streaming latency path with a thread
                 if work:
                     idxs_, handle_, times_ = work[0]
-                    res = self._collect_batch(handle_)
+                    res, aux = self._collect_batch_aux(handle_)
                 else:
-                    idxs_, res, times_ = self._fetch_long(long_handles[0])
-                self._associate_and_store(idxs_, *res, times_, results)
+                    idxs_, res, times_, aux = self._fetch_long_aux(
+                        long_handles[0])
+                self._associate_and_store(idxs_, *res, times_, results,
+                                          aux=aux)
                 return results  # type: ignore[return-value]
             fetched: "_queue.Queue" = _queue.Queue(maxsize=2)
 
             def _fetch_all():
-                # every item is (row_indices, (edge, offset, breaks), times);
-                # None terminates, an exception object relays failure
+                # every item is (row_indices, (edge, offset, breaks),
+                # times, aux); None terminates, an exception object relays
+                # failure
                 try:
                     for idxs_, handle_, times_ in work:
-                        fetched.put(
-                            (idxs_, self._collect_batch(handle_), times_))
+                        res_, aux_ = self._collect_batch_aux(handle_)
+                        fetched.put((idxs_, res_, times_, aux_))
                     for h in long_handles:
-                        fetched.put(self._fetch_long(h))
+                        fetched.put(self._fetch_long_aux(h))
                     fetched.put(None)
                 except BaseException as e:  # noqa: BLE001 - relayed to caller
                     fetched.put(e)
@@ -774,8 +944,9 @@ class SegmentMatcher:
                         break
                     if isinstance(item, BaseException):
                         raise item
-                    idxs_, res, times_ = item
-                    self._associate_and_store(idxs_, *res, times_, results)
+                    idxs_, res, times_, aux = item
+                    self._associate_and_store(idxs_, *res, times_, results,
+                                              aux=aux)
             except BaseException:
                 # unblock the collector (it may be parked on the bounded
                 # queue) and let it run its remaining fetches to completion
@@ -833,6 +1004,21 @@ class SegmentMatcher:
             tm[row, : len(pts)] = np.asarray(ts) - ts[0]
             valid[row, : len(pts)] = True
             times.append(ts)
+        # chaos seam (docs/match-quality.md): an armed quality_skew fault
+        # perturbs the projected coordinates the DEVICE sees — equivalent
+        # to corrupting every emission score — while the shadow oracle
+        # re-matches the original trace.  Deterministic noise so the
+        # injected degradation is reproducible run to run; with the knob
+        # unset this is one dict lookup.
+        tok = faults.fire("quality_skew")
+        if tok is not None:
+            try:
+                mag = float(tok)
+            except ValueError:
+                mag = 25.0  # integer specs parse as the raise-N grammar
+            rng = np.random.default_rng(12345)
+            px = px + rng.normal(0.0, mag, px.shape).astype(np.float32)
+            py = py + rng.normal(0.0, mag, py.shape).astype(np.float32)
         return px, py, tm, valid, times
 
     # batch-dimension padding ladder: the jitted kernels compile once per
@@ -899,9 +1085,15 @@ class SegmentMatcher:
             out.append(buf)
         return tuple(out)
 
-    def _associate_and_store(self, idxs, edge, offset, breaks, times, results):
+    def _associate_and_store(self, idxs, edge, offset, breaks, times, results,
+                             aux=None):
         """Wire-format association for B rows (edge may carry pow2 pad rows;
-        only the first len(idxs) are read).  times: per-row epoch-sec lists."""
+        only the first len(idxs) are read).  times: per-row epoch-sec lists.
+        ``aux``: optional [B, 4] confidence block (see MatchResult.aux);
+        with quality diagnostics on, each result additionally carries a
+        ``"_quality"`` dict (per-point edges, margin stats, pool-exhaustion
+        fraction) the serve tier pops off before rendering the report —
+        it never reaches the wire contract."""
         B = len(idxs)
         T = edge.shape[1]
         abs_tm = np.zeros((B, T), np.float64)
@@ -921,8 +1113,23 @@ class SegmentMatcher:
         C_BREAKS.inc(int(np.count_nonzero((breaks[:B] != 0) & in_trace)))
         for row, i in enumerate(idxs):
             results[i] = {"segments": seg_lists[row]}
+        if not self._quality_aux:
+            return
+        for row, i in enumerate(idxs):
+            n = int(n_pts[row])
+            q: dict = {
+                "edge": [int(e) for e in edge[row, :n]],
+                "n_points": n,
+                "breaks": int(np.count_nonzero(breaks[row, :n])),
+            }
+            if aux is not None:
+                mn, sm, nm, nx = (float(v) for v in aux[row])
+                q["margin_min"] = (round(mn, 4) if nm > 0 else None)
+                q["margin_mean"] = (round(sm / nm, 4) if nm > 0 else None)
+                q["pool_exhausted_frac"] = (round(nx / n, 4) if n else 0.0)
+            results[i]["_quality"] = q
 
-    def _dispatch_long(self, traces, idxs):
+    def _dispatch_long(self, traces, idxs, pkey: tuple = ()):
         """Dispatch carry chains for traces longer than the largest bucket:
         fixed [B, W]-windows with carried Viterbi state (ops/viterbi
         .TraceCarry), one compile set regardless of trace length, no HMM
@@ -933,7 +1140,8 @@ class SegmentMatcher:
         Mid-dispatch wave flushes (the MAX_DEFERRED_CHUNKS device-memory
         bound) still fetch inline; only the final wave stays deferred.
         Per-group program dispatch (hoisted chunk-batched precompute vs the
-        legacy fused per-chunk forward) lives in _dispatch_long_group."""
+        legacy fused per-chunk forward) lives in _dispatch_long_group.
+        ``pkey`` selects the effective-params group like _dispatch_batch."""
         import jax
         import jax.numpy as jnp
 
@@ -951,10 +1159,10 @@ class SegmentMatcher:
             # behaviour had this bound implicitly; fully-async dispatch of
             # many groups would pin every group's inputs + tail at once)
             if len(handles) >= 2:
-                grp, parts, tail, tms = handles[-2]
+                grp, parts, tail, tms, gaux = handles[-2]
                 if tail is not None:
                     parts.append(unpack_compact(tail))
-                    handles[-2] = (grp, parts, None, tms)
+                    handles[-2] = (grp, parts, None, tms, gaux)
             group = order[g : g + cap]
             T_max = max(len(traces[i]["trace"]) for i in group)
             n_chunks = -(-T_max // W)
@@ -966,20 +1174,24 @@ class SegmentMatcher:
                     px, py, tm, valid
                 )
             xin = pack_inputs(px, py, tm, valid)  # [4, B_pad, n_chunks*W]
-            host_parts, outs = self._dispatch_long_group(xin, n_chunks, W)
+            host_parts, outs, aux_dev = self._dispatch_long_group(
+                xin, n_chunks, W, params=self._params_for(pkey))
             dev_tail = None
             if outs:
                 dev_tail = outs[0] if len(outs) == 1 else jnp.concatenate(outs, axis=2)
                 self._start_host_copy(dev_tail)
-            handles.append((group, host_parts, dev_tail, times))
+            handles.append((group, host_parts, dev_tail, times, aux_dev))
         return handles
 
     def _dispatch_long_group(self, xin, n_chunks: int, W: int,
-                             kernel: "str | None" = None):
+                             kernel: "str | None" = None, params=None):
         """Dispatch every device program for ONE padded long-trace group.
         xin: packed [4, B_pad, n_chunks*W] numpy.  Returns (host_parts,
-        outs): already-fetched (edge, offset, breaks) wave tuples and the
-        still-on-device packed chunk outputs, in chunk order.  Everything
+        outs, aux): already-fetched (edge, offset, breaks) wave tuples, the
+        still-on-device packed chunk outputs in chunk order, and the
+        group's on-device [B_pad, 4] confidence block (seam-combined
+        across chunks as the chain advances; None with quality
+        diagnostics off or on the legacy fused path).  Everything
         enqueues asynchronously; bench.py times exactly this entry point so
         the measured programs are the dispatched ones.
 
@@ -1007,6 +1219,7 @@ class SegmentMatcher:
 
         B_pad = xin.shape[1]
         k = self.cfg.beam_k
+        p = self._params if params is None else params
         if kernel is None:
             kernel = self._kernel_for(W)
         carry = initial_carry_batch(B_pad, k)
@@ -1014,6 +1227,20 @@ class SegmentMatcher:
             carry = jax.device_put(carry, self._carry_sharding)
 
         outs, host_parts = [], []
+        # confidence aux rides the hoisted chain programs only (the legacy
+        # fused carry is the bit-exact differential reference and stays
+        # untouched); components combine across seams as min / + / + / +
+        qa = self._quality_aux and self._long_pre
+        aux_acc = None
+
+        def _fold_aux(aux_c):
+            nonlocal aux_acc
+            if aux_acc is None:
+                aux_acc = aux_c
+            else:
+                aux_acc = jnp.concatenate(
+                    [jnp.minimum(aux_acc[:, :1], aux_c[:, :1]),
+                     aux_acc[:, 1:] + aux_c[:, 1:]], axis=1)
 
         def _bank(out):
             outs.append(out)  # device handle; fetch deferred
@@ -1030,7 +1257,7 @@ class SegmentMatcher:
                 out, carry = fn_carry(
                     self._dg, self._du,
                     self._put_packed(xin[:, :, c * W : (c + 1) * W]),
-                    self._params, k, carry,
+                    p, k, carry,
                 )
                 C_DISPATCHES.labels(kernel).inc()
                 C_DISPATCH_COHORT.labels("long", "carry").inc()
@@ -1038,9 +1265,9 @@ class SegmentMatcher:
                     (B_pad, W), _time.monotonic() - t0, kind="carry",
                     kernel=kernel, fn=fn_carry,
                     args=(self._dg, self._du,
-                          xin[:, :, :W], self._params, k, carry))
+                          xin[:, :, :W], p, k, carry))
                 _bank(out)
-            return host_parts, outs
+            return host_parts, outs, None
 
         fn_pre = self._get_jit("pre", "none")
         fn_chain = self._get_jit("chain", kernel)
@@ -1066,38 +1293,48 @@ class SegmentMatcher:
                     [seg, np.zeros((4, rung - rows, W), np.float32)], axis=1)
             t0 = _time.monotonic()
             pre = fn_pre(self._dg, self._du, self._put_packed(seg),
-                         self._params, k)
+                         p, k)
             C_DISPATCH_COHORT.labels("long", "pre").inc()
             self._note_dispatch((rung, W), _time.monotonic() - t0,
                                 kind="pre", kernel="none", fn=fn_pre,
                                 args=(self._dg, self._du, seg,
-                                      self._params, k))
+                                      p, k))
             for i in range(m):
                 c = c0 + i
                 pre_c = jax.tree_util.tree_map(
                     lambda a: a[i * B_pad : (i + 1) * B_pad], pre)
                 t0 = _time.monotonic()
-                out, carry = fn_chain(
+                out = fn_chain(
                     self._dg, self._du, pre_c,
                     self._put_packed(xin[:, :, c * W : (c + 1) * W]),
-                    self._params, k, carry,
+                    p, k, carry,
                 )
+                if qa:
+                    out, aux_c, carry = out
+                    _fold_aux(aux_c)
+                else:
+                    out, carry = out
                 C_DISPATCHES.labels(kernel).inc()
                 C_DISPATCH_COHORT.labels("long", "chain").inc()
                 self._note_dispatch((B_pad, W), _time.monotonic() - t0,
                                     kind="chain", kernel=kernel, fn=fn_chain,
                                     args=(self._dg, self._du, pre_c,
-                                          xin[:, :, :W], self._params, k,
+                                          xin[:, :, :W], p, k,
                                           carry))
                 _bank(out)
-        return host_parts, outs
+        return host_parts, outs, aux_acc
 
     def _fetch_long(self, handle):
         """Block on one _dispatch_long group handle -> (group, (edge,
         offset, break) numpy, times)."""
+        return self._fetch_long_aux(handle)[:3]
+
+    def _fetch_long_aux(self, handle):
+        """_fetch_long plus the group's seam-combined confidence block
+        ([B, 4] numpy or None), trimmed of batch-pad rows."""
         from ..ops.viterbi import unpack_compact
 
-        group, host_parts, dev_tail, times = handle
+        group, host_parts, dev_tail, times, aux_dev = handle
         parts = list(host_parts)
         if dev_tail is not None:
             parts.append(unpack_compact(dev_tail))
@@ -1107,7 +1344,8 @@ class SegmentMatcher:
             edge = np.concatenate([p[0] for p in parts], axis=1)
             offset = np.concatenate([p[1] for p in parts], axis=1)
             breaks = np.concatenate([p[2] for p in parts], axis=1)
-        return group, (edge, offset, breaks), times
+        aux = None if aux_dev is None else np.asarray(aux_dev)[: len(group)]
+        return group, (edge, offset, breaks), times, aux
 
     def warmup(self, lengths: "Sequence[int] | None" = None,
                batch_sizes: "Sequence[int] | None" = None,

@@ -126,6 +126,15 @@ G_MASKING_DEBT = obs.gauge(
     "masked; rising = a replica is rotting behind successful failovers)",
     ("objective",))
 
+G_FLEET_QUALITY = obs.gauge(
+    "reporter_fleet_quality_agreement",
+    "Fleet-wide shadow-oracle agreement aggregated from every replica's "
+    "statusz quality line (docs/match-quality.md): stat=mean is the "
+    "across-replica mean, stat=min the worst replica — a fleet whose min "
+    "diverges from its mean has ONE replica mismatching, not a model "
+    "regression",
+    ("stat",))
+
 
 def _env_num(name: str, default: float) -> float:
     try:
@@ -237,7 +246,8 @@ class Federator:
                  pull_interval_s: Optional[float] = None,
                  timeout_s: Optional[float] = None,
                  stale_after_s: Optional[float] = None,
-                 pool: Optional[HttpPool] = None):
+                 pool: Optional[HttpPool] = None,
+                 fleet_engine: "Optional[obs_slo.SLOEngine]" = None):
         self.pull_interval_s = max(0.05, _env_num(
             "REPORTER_FEDERATION_PULL_S",
             2.0 if pull_interval_s is None else pull_interval_s))
@@ -250,6 +260,12 @@ class Federator:
             else stale_after_s)
         self.pool = pool or HttpPool(max_idle_per_host=4)
         self._own_pool = pool is None
+        # the router's client-truth fleet SLOEngine: each pull feeds every
+        # replica's windowed agreement value into its "agreement" sample
+        # series, so the quality objective federates onto the
+        # reporter_fleet_slo_* plane next to availability/latency
+        # (docs/match-quality.md "Fleet view")
+        self.fleet_engine = fleet_engine
         self._feeds = [ReplicaFeed(u) for u in urls]
         self._lock = threading.Lock()
         self._stop = threading.Event()
@@ -301,6 +317,29 @@ class Federator:
             feed.ok = True
             feed.error = None
         C_PULLS.labels(feed.label, "ok").inc()
+        self._feed_fleet_quality(statusz)
+
+    def _feed_fleet_quality(self, statusz: dict) -> None:
+        """Relay a freshly-pulled replica's windowed agreement value into
+        the fleet SLO engine's sample series (one sample per pull per
+        replica, so the fleet mean weights replicas equally regardless of
+        their sampling cadence).  Ensures the fleet engine carries an
+        agreement objective at the replica's own target."""
+        eng = self.fleet_engine
+        if eng is None:
+            return
+        try:
+            agr = ((statusz.get("slo") or {}).get("objectives")
+                   or {}).get("agreement")
+            if not agr or agr.get("value") is None:
+                return
+            if not any(o.kind == "agreement" for o in eng.objectives):
+                eng.objectives.append(obs_slo.Objective(
+                    "agreement", "agreement",
+                    float(agr.get("target") or 0.9)))
+            eng.observe_sample("agreement", float(agr["value"]))
+        except Exception:  # noqa: BLE001 - a pull must never fail on this
+            pass
 
     # -- read paths ----------------------------------------------------------
 
@@ -383,6 +422,40 @@ class Federator:
         try:
             for name, debt in self.masking_debt(engine).items():
                 G_MASKING_DEBT.labels(name).set(debt)
+        except Exception:  # noqa: BLE001 - a scrape must never fail
+            pass
+
+    def fleet_quality(self) -> dict:
+        """Per-replica windowed agreement (each feed's last statusz
+        quality/slo line — a dead replica's final value stays, like the
+        snapshots) plus the across-replica mean and min.  The min matters
+        operationally: one replica mismatching (bad table shard, stale
+        build) drags min, not mean."""
+        per: Dict[str, Optional[float]] = {}
+        with self._lock:
+            feeds = [(f.label, f.statusz) for f in self._feeds
+                     if f.statusz is not None]
+        for label, statusz in feeds:
+            agr = ((statusz.get("slo") or {}).get("objectives")
+                   or {}).get("agreement") or {}
+            per[label] = agr.get("value")
+        vals = [v for v in per.values() if v is not None]
+        return {
+            "replicas": per,
+            "mean": round(sum(vals) / len(vals), 4) if vals else None,
+            "min": round(min(vals), 4) if vals else None,
+        }
+
+    def export_fleet_quality(self) -> None:
+        """Scrape-time collector for the reporter_fleet_quality_agreement
+        gauge pair (-1 = no replica has reported agreement yet, matching
+        the attrib-age convention for \"no data\")."""
+        try:
+            fq = self.fleet_quality()
+            G_FLEET_QUALITY.labels("mean").set(
+                -1.0 if fq["mean"] is None else fq["mean"])
+            G_FLEET_QUALITY.labels("min").set(
+                -1.0 if fq["min"] is None else fq["min"])
         except Exception:  # noqa: BLE001 - a scrape must never fail
             pass
 

@@ -14,6 +14,10 @@ decides retention AFTER the outcome is known:
     sends ``X-Reporter-Flight-Keep`` on re-dispatched replica legs, so
     both sides of a failed-over request survive for cross-hop trace
     stitching, docs/observability.md "Fleet observability"),
+  - every low-margin span is kept (``span.meta["low_margin"]`` — the
+    serve tier marks traces whose winner-vs-runner-up viterbi margin
+    fell below the keep threshold, docs/match-quality.md: an ambiguous
+    decode is retained like a slow one),
   - every span slower than the slow threshold is kept,
   - 1-in-N of the healthy rest is kept,
   - everything else only increments a counter.
@@ -60,7 +64,7 @@ from .trace import Span
 C_FLIGHT = obs.counter(
     "reporter_flight_traces_total",
     "Flight-recorder tail-sampling decisions "
-    "(error / slo / pinned / slow / sampled / dropped)",
+    "(error / slo / pinned / low_margin / slow / sampled / dropped)",
     ("decision",))
 
 _FILE_SAFE_RE = re.compile(r"[^A-Za-z0-9._-]")
@@ -112,6 +116,12 @@ class FlightRecorder:
             decision = "slo"
         elif span.meta.get("flight_keep"):
             decision = "pinned"
+        elif span.meta.get("low_margin") is not None:
+            # ambiguous decode (winner-vs-runner-up viterbi margin below
+            # the keep threshold, docs/match-quality.md): retained like a
+            # slow trace so the quality plane's suspects are explainable
+            # by trace_id
+            decision = "low_margin"
         elif span.total_s * 1000.0 >= self.slow_ms:
             decision = "slow"
         else:

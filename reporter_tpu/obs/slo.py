@@ -159,6 +159,12 @@ class Objective:
     kind "latency":           quantile(q) of eligible latencies <= target
                               seconds
     kind "degraded_fraction": degraded / (good + bad) <= target
+    kind "agreement":         weighted mean of the "agreement" sample
+                              series (shadow-oracle match agreement fed by
+                              obs/quality.py via ``observe_sample``) >=
+                              target — the match-QUALITY objective: burn
+                              is mean disagreement over the allowed
+                              disagreement budget (1 - target)
     ``route=None`` spans all routes."""
 
     name: str
@@ -168,7 +174,8 @@ class Objective:
     quantile: float = 0.99
 
     def __post_init__(self):
-        if self.kind not in ("availability", "latency", "degraded_fraction"):
+        if self.kind not in ("availability", "latency", "degraded_fraction",
+                             "agreement"):
             raise ValueError("unknown objective kind %r" % (self.kind,))
         if self.kind == "latency" and not (0.0 < self.quantile < 1.0):
             raise ValueError("latency quantile must be in (0, 1)")
@@ -176,7 +183,7 @@ class Objective:
     def budget_fraction(self) -> float:
         """The fraction of eligible traffic this objective allows to be
         non-compliant — the denominator of its burn rate."""
-        if self.kind == "availability":
+        if self.kind in ("availability", "agreement"):
             return max(1e-9, 1.0 - self.target)
         if self.kind == "latency":
             return max(1e-9, 1.0 - self.quantile)
@@ -185,25 +192,28 @@ class Objective:
 
 class _Epoch:
     """One epoch bucket of the sliding window: per-(route, class) counts,
-    per-route degraded counts, and per-route latency bucket counts."""
+    per-route degraded counts, per-route latency bucket counts, and named
+    weighted value series (the quality plane's agreement samples)."""
 
-    __slots__ = ("counts", "degraded", "hist")
+    __slots__ = ("counts", "degraded", "hist", "samples")
 
     def __init__(self):
         self.counts: Dict[Tuple[str, str], int] = {}
         self.degraded: Dict[str, int] = {}
         self.hist: Dict[str, List[int]] = {}
+        self.samples: Dict[str, List[float]] = {}  # name -> [v*w sum, w sum]
 
 
 class _Agg:
     """Window aggregate: the epoch sum ``report``/``burn_rate`` read."""
 
-    __slots__ = ("counts", "degraded", "hist")
+    __slots__ = ("counts", "degraded", "hist", "samples")
 
     def __init__(self):
         self.counts: Dict[Tuple[str, str], int] = {}
         self.degraded: Dict[str, int] = {}
         self.hist: Dict[str, List[int]] = {}
+        self.samples: Dict[str, List[float]] = {}
 
     def _routes(self) -> set:
         return {r for r, _c in self.counts}
@@ -229,6 +239,18 @@ class _Agg:
 
     def quantile(self, q: float, route: Optional[str] = None) -> Optional[float]:
         return hist_quantile(cumulate(SLO_BUCKETS_S, self.hist_sum(route)), q)
+
+    def sample_mean(self, name: str) -> Optional[float]:
+        """Weighted mean of a value series over the window; None with no
+        samples (vacuously compliant, like an idle route)."""
+        vw = self.samples.get(name)
+        if not vw or vw[1] <= 0:
+            return None
+        return vw[0] / vw[1]
+
+    def sample_weight(self, name: str) -> float:
+        vw = self.samples.get(name)
+        return vw[1] if vw else 0.0
 
     def over_target(self, target_s: float, route: Optional[str] = None) -> int:
         """Observations in buckets strictly above the bucket containing
@@ -327,6 +349,29 @@ class SLOEngine:
             })
         return violated
 
+    def observe_sample(self, name: str, value: float, weight: float = 1.0,
+                       now: Optional[float] = None) -> None:
+        """Feed one weighted value sample into a named series — the
+        non-request signal plane (shadow-oracle agreement: value = the
+        sample's agreement fraction, weight = points compared).  Series
+        aggregate as weighted means over the same sliding epochs the
+        request counters use, so the agreement objective gets the same
+        multi-window burn-rate machinery for free."""
+        if weight <= 0:
+            return
+        now = self._clock() if now is None else now
+        ep_key = int(now / self.epoch_s)
+        with self._lock:
+            ep = self._epochs.get(ep_key)
+            if ep is None:
+                ep = self._epochs[ep_key] = _Epoch()
+                self._prune(now)
+            vw = ep.samples.get(name)
+            if vw is None:
+                vw = ep.samples[name] = [0.0, 0.0]
+            vw[0] += float(value) * float(weight)
+            vw[1] += float(weight)
+
     def _violations(self, route: str, code: int, cls: str,
                     latency_s: Optional[float]) -> List[str]:
         out = []
@@ -378,11 +423,24 @@ class SLOEngine:
                         dst = agg.hist[r] = [0] * len(h)
                     for i, c in enumerate(h):
                         dst[i] += c
+                for name, vw in ep.samples.items():
+                    dst_vw = agg.samples.get(name)
+                    if dst_vw is None:
+                        dst_vw = agg.samples[name] = [0.0, 0.0]
+                    dst_vw[0] += vw[0]
+                    dst_vw[1] += vw[1]
         return agg
 
     def _bad_fraction(self, o: Objective, agg: _Agg) -> Optional[float]:
         """The objective's non-compliant traffic fraction in ``agg``;
         None with no eligible traffic (vacuously compliant)."""
+        if o.kind == "agreement":
+            # mean disagreement — an objective over the sample series, not
+            # the request counters, so it needs no request traffic
+            mean = agg.sample_mean("agreement")
+            if mean is None:
+                return None
+            return min(1.0, max(0.0, 1.0 - mean))
         n = agg.eligible(o.route)
         if n <= 0:
             return None
@@ -411,6 +469,9 @@ class SLOEngine:
             frac = self._bad_fraction(o, agg)
             value = None if frac is None else 1.0 - frac
             ok = value is None or value >= o.target
+        elif o.kind == "agreement":
+            value = agg.sample_mean("agreement")
+            ok = value is None or value >= o.target
         else:
             value = self._bad_fraction(o, agg)
             ok = value is None or value <= o.target
@@ -425,7 +486,7 @@ class SLOEngine:
             # pair's factor for this pair to page
             alerting = alerting or (bs > factor and bl > factor)
         budget_remaining = max(0.0, 1.0 - self.burn_rate(o, self.window_s, now))
-        return {
+        out = {
             "name": o.name,
             "kind": o.kind,
             "route": o.route,
@@ -437,6 +498,11 @@ class SLOEngine:
             "budget_remaining": round(budget_remaining, 4),
             "alerting": bool(alerting),
         }
+        if o.kind == "agreement":
+            # compared-point weight behind the mean: a gate reading this
+            # verdict can judge statistical strength, not just the value
+            out["sample_weight"] = round(agg.sample_weight("agreement"), 1)
+        return out
 
     def report(self, window_s: Optional[float] = None,
                now: Optional[float] = None) -> dict:
@@ -545,6 +611,13 @@ def default_objectives() -> List[Objective]:
     if degr and degr > 0:
         out.append(Objective("degraded_fraction", "degraded_fraction",
                              float(degr)))
+    # the match-QUALITY objective (docs/match-quality.md): off by default
+    # — it only means something with shadow-oracle sampling feeding the
+    # "agreement" series, and obs/quality.configure() ensures it exists
+    # whenever sampling is on (at this env target, default 0.90 there)
+    agree = _env_float("REPORTER_SLO_AGREEMENT", 0.0)
+    if agree and agree > 0:
+        out.append(Objective("agreement", "agreement", float(agree)))
     return out
 
 
@@ -553,6 +626,7 @@ def objectives_from_spec(spec: Optional[dict]) -> List[Objective]:
     (docs/http-api.md "Service config"):
 
       {"window_s": 300, "availability": 0.99, "degraded_fraction": 0.25,
+       "agreement": 0.90,
        "latency": {"report": {"p99_ms": 2500, "p999_ms": 10000},
                    "*": {"p95_ms": 1000}}}
 
@@ -577,6 +651,9 @@ def objectives_from_spec(spec: Optional[dict]) -> List[Objective]:
     if degr:
         out.append(Objective("degraded_fraction", "degraded_fraction",
                              float(degr)))
+    agree = spec.get("agreement")
+    if agree:
+        out.append(Objective("agreement", "agreement", float(agree)))
     return out or default_objectives()
 
 

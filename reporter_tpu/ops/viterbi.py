@@ -88,6 +88,12 @@ class MatchResult(NamedTuple):
     route_dist: jnp.ndarray  # [T] f32 route distance from previous chosen candidate
     # (NEG_INF-free) final per-point viterbi score of the chosen slot
     score: jnp.ndarray  # [T] f32
+    # per-trace confidence aux (docs/match-quality.md): [4] f32 —
+    # (min winner-vs-runner-up margin, sum of margins, margin point count,
+    # candidate-pool-exhausted point count).  All four components combine
+    # across chunk seams (min / + / + / +), so the long-trace path can sum
+    # them per chunk.  Purely diagnostic: never feeds back into the match.
+    aux: jnp.ndarray
 
 
 def transition_matrix(dg: DeviceGraph, du: DeviceUBODT, src: Candidates, dst: Candidates,
@@ -406,7 +412,35 @@ def chain_trace(dg: DeviceGraph, du: DeviceUBODT, pre: TracePre, px, py, times,
     chosen_route = jnp.take_along_axis(route_in, jnp.maximum(idx, 0)[:, None], axis=1)[:, 0]
     chosen_route = jnp.where((idx >= 0) & ~breaks, chosen_route, jnp.inf)
 
-    result = MatchResult(cand=cand, idx=idx, breaks=breaks, route_dist=chosen_route, score=chosen_score)
+    # per-trace confidence diagnostics, computed from state already in
+    # registers (docs/match-quality.md "Kernel confidence"): the
+    # winner-vs-runner-up viterbi margin per point (small margin = the
+    # decode was nearly a coin flip between two paths — the ambiguity
+    # signal the flight recorder retains low-margin traces on) and the
+    # candidate-pool exhaustion flag (all K slots filled: the quadrant
+    # sweep may have truncated the true pool).  O(T K) next to the
+    # O(T K^2) transition build; XLA dead-code-eliminates it in programs
+    # that do not output aux.  Margins inherit the kernels' documented
+    # float-associativity ULP wiggle, so they are diagnostics, never part
+    # of any bit-exact differential contract.
+    with stage("confidence"):
+        top1 = jnp.max(scores_mat, axis=1)  # [T]
+        am = jnp.argmax(scores_mat, axis=1)
+        masked = jnp.where(jnp.arange(k)[None, :] == am[:, None],
+                           NEG_INF, scores_mat)
+        top2 = jnp.max(masked, axis=1)
+        two_alive = (top1 > NEG_INF / 2) & (top2 > NEG_INF / 2) & valid
+        marg = top1 - top2
+        exhausted = (cand.edge[:, k - 1] >= 0) & valid
+        aux = jnp.stack([
+            jnp.min(jnp.where(two_alive, marg, jnp.inf)),
+            jnp.sum(jnp.where(two_alive, marg, 0.0)),
+            jnp.sum(two_alive).astype(jnp.float32),
+            jnp.sum(exhausted).astype(jnp.float32),
+        ])
+
+    result = MatchResult(cand=cand, idx=idx, breaks=breaks,
+                         route_dist=chosen_route, score=chosen_score, aux=aux)
     if carry is None:
         return result
 
@@ -625,11 +659,15 @@ def match_batch(dg: DeviceGraph, du: DeviceUBODT, px, py, times, valid, p: Match
 class CompactMatch(NamedTuple):
     """Per-point chosen match, gathered on device so only [B, T] arrays cross
     the host boundary (the full MatchResult is [B, T, K] — K times the
-    transfer for fields the host never reads)."""
+    transfer for fields the host never reads).  ``aux`` is the per-trace
+    confidence diagnostic block ([B, 4] f32, see MatchResult.aux); it rides
+    the *_aux packed entry points only and stays None on the classic
+    transport, whose [3, B, T] wire layout is pinned by tests."""
 
     edge: jnp.ndarray  # [B, T] i32 matched edge, -1 unmatched
     offset: jnp.ndarray  # [B, T] f32 metres along edge
     breaks: jnp.ndarray  # [B, T] bool
+    aux: "jnp.ndarray | None" = None  # [B, 4] f32 confidence diagnostics
 
 
 def match_batch_compact(dg: DeviceGraph, du: DeviceUBODT, px, py, times, valid, p: MatchParams, k: int,
@@ -645,7 +683,8 @@ def _compact(res: MatchResult) -> CompactMatch:
         edge = jnp.take_along_axis(res.cand.edge, sel, axis=-1)[..., 0]
         offset = jnp.take_along_axis(res.cand.offset, sel, axis=-1)[..., 0]
         edge = jnp.where(res.idx >= 0, edge, -1)
-        return CompactMatch(edge=edge, offset=offset, breaks=res.breaks)
+        return CompactMatch(edge=edge, offset=offset, breaks=res.breaks,
+                            aux=res.aux)
 
 
 def match_batch_carry(dg: DeviceGraph, du: DeviceUBODT, px, py, times, valid,
@@ -718,6 +757,21 @@ def match_batch_compact_packed(dg: DeviceGraph, du: DeviceUBODT, xin,
         dg, du, px, py, times, valid, p, k, kernel, dedup))
 
 
+def match_batch_compact_packed_aux(dg: DeviceGraph, du: DeviceUBODT, xin,
+                                   p: MatchParams, k: int,
+                                   kernel: str = "scan",
+                                   dedup: bool = False):
+    """match_batch_compact_packed + the per-trace confidence block:
+    (packed [3, B, T], aux [B, 4]).  Same match program (the packed wire
+    layout is untouched); the aux output merely keeps the confidence ops
+    live through XLA's DCE.  The serving matcher dispatches this variant
+    when quality diagnostics are enabled (docs/match-quality.md)."""
+    px, py, times, valid = unpack_inputs(xin)
+    cm = match_batch_compact(dg, du, px, py, times, valid, p, k, kernel,
+                             dedup)
+    return pack_compact(cm), cm.aux
+
+
 def match_batch_carry_packed(dg: DeviceGraph, du: DeviceUBODT, xin,
                              p: MatchParams, k: int, carry: TraceCarry,
                              kernel: str = "scan"):
@@ -761,6 +815,23 @@ def chain_batch_carry_packed(dg: DeviceGraph, du: DeviceUBODT, pre: TracePre,
         fn, in_axes=(None, None, 0, 0, 0, 0, 0, None, None, 0)
     )(dg, du, pre, px, py, times, valid, p, k, carry)
     return pack_compact(_compact(res)), carry_out
+
+
+def chain_batch_carry_packed_aux(dg: DeviceGraph, du: DeviceUBODT,
+                                 pre: TracePre, xin, p: MatchParams, k: int,
+                                 carry: TraceCarry, kernel: str = "scan"):
+    """chain_batch_carry_packed + the per-chunk confidence block: (packed
+    [3, B, T], aux [B, 4], carry').  Aux components are seam-combinable
+    (min / + / + / +), so the matcher folds each chunk's block into a
+    per-trace total as the chain advances."""
+    import functools
+
+    px, py, times, valid = unpack_inputs(xin)
+    fn = functools.partial(chain_trace, kernel=kernel)
+    res, carry_out = jax.vmap(
+        fn, in_axes=(None, None, 0, 0, 0, 0, 0, None, None, 0)
+    )(dg, du, pre, px, py, times, valid, p, k, carry)
+    return pack_compact(_compact(res)), res.aux, carry_out
 
 
 def initial_carry_batch(b: int, k: int) -> TraceCarry:

@@ -32,6 +32,13 @@ def main(argv):
     obs_log.configure()
     obs_flight.install_shutdown_dump()
     ensure_platform()
+    # kernel confidence diagnostics default ON for the serving entrypoint
+    # (library callers and the bit-exact differential suites keep the
+    # config default of off): the matcher built below reads this env, so
+    # every served match carries margins and the flight recorder can
+    # retain ambiguous decodes (docs/match-quality.md).  An explicit
+    # REPORTER_QUALITY_AUX=0 still disables.
+    os.environ.setdefault("REPORTER_QUALITY_AUX", "1")
     # conf path: positional arg, else $MATCHER_CONF_FILE — the reference's
     # container default (README.md Env Var Overrides: MATCHER_CONF_FILE).
     # With the env set, the single positional may be the bind address.
@@ -101,6 +108,10 @@ def main(argv):
         # over sliding windows at GET /debug/slo; REPORTER_SLO_* env
         # knobs tune the defaults when the config has no "slo" block
         slo=conf.get("slo"),
+        # match-quality plane (docs/match-quality.md): shadow-oracle
+        # sampling cadence + agreement objective; REPORTER_QUALITY_*
+        # env knobs override the config "quality" block
+        quality=conf.get("quality"),
     )
     httpd = service.make_server(host, int(port))
     # log the BOUND port: with port 0 the OS picks one, and supervisors /

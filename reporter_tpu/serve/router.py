@@ -249,17 +249,23 @@ class FleetRouter:
         # reporter_fleet_slo_* families (a failed-over success is
         # fleet-good), and the masking-debt collector bills the delta
         # between summed replica burn and fleet burn at scrape time
-        self.federator = obs_fed.Federator(
-            [r.url for r in self.replicas], pool=self.pool)
         self.slo = obs_slo.SLOEngine(
             window_s=obs_slo._env_float("REPORTER_SLO_WINDOW_S", 300.0),
             families=obs_fed.FLEET_SLO)
+        # the federator relays each replica's windowed agreement into the
+        # fleet engine's sample series on every pull, so the match-quality
+        # objective rides the reporter_fleet_slo_* plane like the others
+        # (docs/match-quality.md "Fleet view")
+        self.federator = obs_fed.Federator(
+            [r.url for r in self.replicas], pool=self.pool,
+            fleet_engine=self.slo)
         obs.REGISTRY.register_collect(self._export_fleet_gauges)
 
     def _export_fleet_gauges(self) -> None:
         self.federator.export_gauges()
         self.slo.export_gauges()
         self.federator.export_masking_debt(self.slo)
+        self.federator.export_fleet_quality()
 
     # -- health: active probing + passive outlier ejection -----------------
 
@@ -722,6 +728,9 @@ class FleetRouter:
         out = self.slo.report(window_s=window)
         out["scope"] = "fleet"
         out["masking_debt"] = self.federator.masking_debt(self.slo)
+        # the fleet quality view: per-replica windowed agreement + mean
+        # and min (min diverging from mean = ONE replica mismatching)
+        out["quality"] = self.federator.fleet_quality()
         return 200, out
 
     def handle_traces(self, query: dict) -> Tuple[int, dict]:

@@ -44,6 +44,7 @@ from ..matching import MatcherConfig, SegmentMatcher
 from ..obs import flight as obs_flight
 from ..obs import log as obs_log
 from ..obs import metrics as obs
+from ..obs import quality as obs_quality
 from ..obs import slo as obs_slo
 from ..obs import trace as obs_trace
 from ..obs.trace import Span
@@ -679,6 +680,7 @@ class ReporterService:
         max_inflight: Optional[int] = None,
         robustness: Optional[dict] = None,
         slo: Optional[dict] = None,
+        quality: Optional[dict] = None,
     ):
         """``matcher=None`` defers the engine: the HTTP socket can bind and
         /health can answer before the accelerator backend is even
@@ -714,6 +716,15 @@ class ReporterService:
         }
         if slo is not None:
             obs_slo.configure(slo)
+        # match-quality plane (docs/match-quality.md): the shadow-oracle
+        # sampling engine builds at attach time (it needs the matcher's
+        # arrays + confidence-aux programs); the config "quality" block /
+        # REPORTER_QUALITY_* env knobs tune it, sample_every 0 = off
+        self._quality_spec = dict(quality or {})
+        self.quality: "Optional[obs_quality.QualityEngine]" = None
+        self._margin_keep = _resolve_num(
+            "REPORTER_QUALITY_MARGIN_KEEP",
+            self._quality_spec.get("margin_keep"), 1.0)
         self._threshold_arg = threshold_sec
         self.matcher = None
         self.batcher = None
@@ -793,6 +804,11 @@ class ReporterService:
         self.threshold_sec = int(threshold)
         self.matcher = matcher
         self.batcher = self._make_batcher(matcher)
+        try:
+            self.quality = obs_quality.configure(matcher, self._quality_spec)
+        except Exception:  # noqa: BLE001 - diagnostics must not block boot
+            log.exception("quality engine configure failed; sampling off")
+            self.quality = None
 
     def _make_batcher(self, matcher: SegmentMatcher) -> MicroBatcher:
         return MicroBatcher(
@@ -835,6 +851,10 @@ class ReporterService:
                 self._cpu_matcher = SegmentMatcher(
                     arrays=m.arrays, ubodt=m.ubodt, config=m.cfg,
                     backend="cpu")
+                # degraded answers keep the quality plane fed: per-point
+                # edges still attach (margins stay None — the cpu oracle
+                # computes no runner-up scores)
+                self._cpu_matcher._quality_aux = m._quality_aux
             return self._cpu_matcher
 
     def _probe_loop(self) -> None:
@@ -905,6 +925,31 @@ class ReporterService:
             span.meta["slo_violation"] = violated
         obs_flight.record(span)
 
+    def _note_quality(self, trace, match, span: Span) -> Optional[dict]:
+        """Pop the matcher's ``"_quality"`` block off a match dict (it must
+        never reach the wire renderer — report_fn embeds the match dict as
+        ``segment_matcher``), feed the confidence metrics, mark low-margin
+        spans for flight retention, and offer the request to the
+        shadow-oracle sampler (docs/match-quality.md).  Cheap: dict pops,
+        two metric updates, one non-blocking enqueue at most."""
+        if not isinstance(match, dict):
+            return None
+        q = match.pop("_quality", None)
+        if not isinstance(q, dict):
+            return None
+        mm = q.get("margin_mean")
+        if mm is not None:
+            obs_quality.H_MARGIN.observe(mm, exemplar=span.trace_id)
+            # the keep signal compares the MEAN margin: min is routinely 0
+            # on two-way streets (both directions of one edge tie exactly)
+            # while a low mean means the whole decode was ambiguous
+            if mm < self._margin_keep:
+                obs_quality.C_LOW_MARGIN.inc()
+                span.meta["low_margin"] = round(float(mm), 4)
+        if self.quality is not None:
+            self.quality.maybe_sample(trace, q)
+        return q
+
     def validate(self, trace: dict) -> Tuple[Optional[str], Optional[set], Optional[set]]:
         """Returns (error, report_levels, transition_levels)."""
         if trace.get("uuid") is None:
@@ -924,6 +969,28 @@ class ReporterService:
             tl = set(trace["match_options"]["transition_levels"])
         except Exception:
             return "match_options must include transition_levels array", None, None
+        # per-request HMM parameter overrides (reference wire contract,
+        # docs/http-api.md): values are validated HERE so a bad one is a
+        # clean 400 instead of failing (and poison-quarantining) a whole
+        # device batch; the matcher applies the effective values with no
+        # recompile and ?debug=1 echoes them
+        mo = trace["match_options"]
+        if isinstance(mo, dict):
+            for key in ("sigma_z", "beta", "search_radius", "gps_accuracy"):
+                if key not in mo:
+                    continue
+                try:
+                    v = float(mo[key])
+                except (TypeError, ValueError):
+                    v = float("nan")
+                if not (v > 0 and v == v and v != float("inf")):
+                    return ("match_options.%s must be a positive finite "
+                            "number" % key), None, None
+            sm = mo.get("shape_match")
+            if sm is not None and sm != "map_snap":
+                return ("match_options.shape_match %r is not supported "
+                        "(this matcher map-snaps; use \"map_snap\" or omit "
+                        "the key)" % (sm,)), None, None
         return None, rl, tl
 
     def handle_report(self, trace: dict, debug: bool = False,
@@ -1024,6 +1091,7 @@ class ReporterService:
                     with self._cpu_lock:
                         match = m.match_many([trace])[0]
                     span.mark("cpu_fallback_s", _time.monotonic() - t_m)
+                quality = self._note_quality(trace, match, span)
                 t_rep = _time.monotonic()
                 data = report_fn(match, trace, self.threshold_sec, rl, tl,
                                  mode=trace.get("match_options", {}).get("mode", "auto"))
@@ -1035,6 +1103,16 @@ class ReporterService:
                 C_DEGRADED_REQ.inc()
             if debug:
                 data["debug"] = span.breakdown()
+                if quality is not None:
+                    data["debug"]["quality"] = {
+                        k: v for k, v in quality.items() if k != "edge"}
+                m_ = self.matcher
+                if m_ is not None:
+                    # effective HMM parameters this request actually ran
+                    # with (per-request match_options applied + clamped)
+                    data["debug"]["match_options"] = (
+                        m_.effective_match_options(
+                            trace.get("match_options") or {}))
             self._terminal("report", 200, span, degraded=degraded)
             self._count(ok=True)
             C_REQUESTS.labels(
@@ -1164,6 +1242,8 @@ class ReporterService:
                     matches = batcher.match_many(
                         [t for t, _, _ in validated], **mkw)
                 span.mark("match_s", _time.monotonic() - t0)
+                for m_, (t_, _rl, _tl) in zip(matches, validated):
+                    self._note_quality(t_, m_, span)
                 t0 = _time.monotonic()
                 results = [
                     report_fn(m, t, self.threshold_sec, rl, tl,
@@ -1253,6 +1333,10 @@ class ReporterService:
             # the burn-rate line: per-objective value/target/burn/budget
             # so an on-call eye catches a fast burn without /debug/slo
             "slo": obs_slo.engine().summary(),
+            # the quality line: shadow-oracle agreement + sampler health
+            # (None until a quality engine is configured)
+            "quality": (self.quality.summary()
+                        if self.quality is not None else None),
             "metrics": obs.REGISTRY.snapshot(),
         }
 
@@ -1295,7 +1379,13 @@ class ReporterService:
                 window = max(1.0, float(raw))
             except (TypeError, ValueError):
                 return 400, {"error": "window must be a number (seconds)"}
-        return 200, obs_slo.engine().report(window_s=window)
+        out = obs_slo.engine().report(window_s=window)
+        # the match-quality section (docs/match-quality.md): cohort
+        # agreement windows + sampler state; tools/quality_gate.py judges
+        # exactly this block against the pinned baseline profile
+        if self.quality is not None:
+            out["quality"] = self.quality.report()
+        return 200, out
 
     def handle_profile(self, query: dict) -> Tuple[int, dict]:
         """GET /debug/profile?seconds=N — record a jax.profiler trace to a

@@ -1,0 +1,237 @@
+#!/usr/bin/env bash
+# Match-quality gating rehearsal (the CI `quality-rehearsal` leg;
+# runnable locally — docs/match-quality.md):
+#
+#   1. no-fault: a warmed serve with shadow-oracle sampling at 1-in-1
+#      replays the pinned synth corpus — a dense fleet plus the
+#      `--gap-s 45,60` sparse fleet (the reference BatchingProcessor
+#      operating point, ROADMAP open item 4).  The server's /debug/slo
+#      quality snapshot must PASS tools/quality_gate.py against the
+#      committed QUALITY_BASELINE.json, the agreement objective must be
+#      ok and not alerting, and loadgen's --server-slo verdict must
+#      agree.
+#
+#   2. injected quality_skew (faults.py): the SAME load against a server
+#      whose device batches are silently perturbed.  The serving plane
+#      stays green (that is the point — availability and latency cannot
+#      see a quality drift), but the shadow oracle does: the agreement
+#      objective must be VIOLATING + alerting and the SAME quality gate
+#      must FAIL.
+#
+# Baseline refresh: QUALITY_BASELINE_OUT=<path> writes leg 1's snapshot
+# instead of judging it (commit the result as QUALITY_BASELINE.json).
+#
+# Usage: tests/quality_rehearsal.sh [workdir]
+set -euo pipefail
+
+cd "$(dirname "$0")/.."
+export PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}"
+export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+
+WORK="${1:-$(mktemp -d /tmp/reporter-quality.XXXXXX)}"
+mkdir -p "$WORK"
+PORT=18071
+PORT2=18072
+echo "quality rehearsal workdir: $WORK"
+
+PIDS=()
+cleanup() {
+    for pid in "${PIDS[@]}"; do
+        kill "$pid" 2>/dev/null || true
+    done
+    for pid in "${PIDS[@]}"; do
+        for _ in $(seq 1 20); do
+            kill -0 "$pid" 2>/dev/null || break
+            sleep 0.5
+        done
+        kill -9 "$pid" 2>/dev/null || true
+        wait "$pid" 2>/dev/null || true
+    done
+}
+trap cleanup EXIT
+
+# grid matches loadgen's synth default (8x8 @ 200 m); one 16-pt length
+# bucket keeps --warmup fast; shadow sampling 1-in-1 so every request is
+# scored; the quality worker unthrottled (this is a rehearsal box, not a
+# production replica — fidelity here is the verdict, not the p99)
+cat > "$WORK/config.json" <<EOF
+{
+  "network": {"type": "grid", "rows": 8, "cols": 8, "spacing_m": 200},
+  "matcher": {"sigma_z": 4.07, "beta": 3.0, "search_radius": 50.0,
+              "length_buckets": [16]},
+  "backend": "jax",
+  "batch": {"max_batch": 64, "max_wait_ms": 5},
+  "slo": {"window_s": 120, "availability": 0.95,
+          "latency": {"*": {"p99_ms": 8000}}},
+  "quality": {"sample_every": 1, "queue_max": 256, "window_s": 600,
+              "target": 0.90}
+}
+EOF
+export REPORTER_QUALITY_PACE=0
+
+# the pinned corpus: a dense fleet + the sparse 45/60 s fleet, fixed
+# seeds — the SAME arguments produced QUALITY_BASELINE.json
+DENSE_ARGS=(--rate 12 --duration 5 --vehicles 10 --points 32 --window 16
+            --grid 8 --seed 7 --concurrency 16 --timeout-s 8
+            --slo-availability 0.95 --slo-p99-ms 8000)
+SPARSE_ARGS=(--rate 12 --duration 5 --vehicles 10 --points 32 --window 16
+             --grid 8 --seed 11 --gap-s 45,60 --concurrency 16 --timeout-s 8
+             --slo-availability 0.95 --slo-p99-ms 8000)
+
+wait_up() {
+    local port=$1 tries=$2
+    for _ in $(seq 1 "$tries"); do
+        python - <<EOF && return 0 || sleep 1
+import json, sys, urllib.request
+try:
+    h = json.load(urllib.request.urlopen(
+        "http://127.0.0.1:$port/health", timeout=2))
+except Exception:
+    sys.exit(1)
+sys.exit(0 if h.get("status") == "ok" and h.get("backend") else 1)
+EOF
+    done
+    return 1
+}
+
+drain_quality() {
+    # wait for the shadow-oracle queue to empty so the snapshot covers
+    # every sampled request
+    local port=$1
+    python - <<EOF
+import json, sys, time, urllib.request
+deadline = time.time() + 120
+last = -1
+while time.time() < deadline:
+    slo = json.load(urllib.request.urlopen(
+        "http://127.0.0.1:$port/debug/slo", timeout=5))
+    q = slo.get("quality") or {}
+    if q.get("queue_depth", 1) == 0 and q.get("samples_compared", 0) == last:
+        json.dump(slo, open("$WORK/slo_snapshot.json", "w"))
+        print("quality drained: %d compared, %d dropped"
+              % (q.get("samples_compared", 0), q.get("samples_dropped", 0)))
+        sys.exit(0)
+    last = q.get("samples_compared", 0)
+    time.sleep(1.0)
+sys.exit("quality queue never drained")
+EOF
+}
+
+run_legs() {
+    local port=$1 tag=$2
+    python tools/loadgen.py --url "http://127.0.0.1:$port" \
+        "${DENSE_ARGS[@]}" --server-slo \
+        --out "$WORK/loadgen_dense_$tag.json"
+    python tools/loadgen.py --url "http://127.0.0.1:$port" \
+        "${SPARSE_ARGS[@]}" --server-slo \
+        --out "$WORK/loadgen_sparse_$tag.json"
+}
+
+# ---- leg 1: no fault — the gate must pass --------------------------------
+echo "== leg 1: no-fault (warmed serve + shadow sampling, gate must pass) =="
+python -m reporter_tpu.serve --warmup "$WORK/config.json" "127.0.0.1:$PORT" \
+    > "$WORK/serve_nofault.log" 2>&1 &
+SERVE_PID=$!
+PIDS+=("$SERVE_PID")
+if ! wait_up "$PORT" 240; then
+    echo "FAIL: no-fault service never came up; tail of serve log:"
+    tail -20 "$WORK/serve_nofault.log"
+    exit 1
+fi
+
+run_legs "$PORT" nofault
+drain_quality "$PORT"
+mv "$WORK/slo_snapshot.json" "$WORK/slo_nofault.json"
+
+if [ -n "${QUALITY_BASELINE_OUT:-}" ]; then
+    python - <<EOF
+import json
+slo = json.load(open("$WORK/slo_nofault.json"))
+json.dump(slo["quality"], open("$QUALITY_BASELINE_OUT", "w"), indent=1)
+print("baseline written to $QUALITY_BASELINE_OUT — commit it as "
+      "QUALITY_BASELINE.json")
+EOF
+    exit 0
+fi
+
+python tools/quality_gate.py QUALITY_BASELINE.json \
+    --fresh "$WORK/slo_nofault.json" --min-agreement 0.85 \
+    > "$WORK/quality_gate_nofault.json"
+echo "no-fault leg: quality gate PASSED"
+
+python - <<EOF
+# the agreement objective is live, ok, and not alerting; the sparse
+# 45-60 s cohort actually got sampled (the whole point of --gap-s)
+import json
+slo = json.load(open("$WORK/slo_nofault.json"))
+agr = [o for o in slo["objectives"] if o["kind"] == "agreement"]
+assert agr and agr[0]["ok"] and not agr[0]["alerting"], agr
+assert agr[0]["value"] is not None
+cohorts = slo["quality"]["cohorts"]
+sparse = [k for k in cohorts if "gap=45-60" in k or "gap=ge60" in k]
+assert sparse, "no sparse-gap cohort sampled: %s" % list(cohorts)
+for lg in ("loadgen_dense_nofault", "loadgen_sparse_nofault"):
+    art = json.load(open("$WORK/%s.json" % lg))
+    assert art["slo"]["agree"] is True, lg
+    assert art["slo"]["server_quality"] is not None, lg
+print("agreement %.4f ok; sparse cohorts sampled: %s"
+      % (agr[0]["value"], sparse))
+EOF
+
+kill "$SERVE_PID" 2>/dev/null || true
+wait "$SERVE_PID" 2>/dev/null || true
+
+# ---- leg 2: quality_skew — serving green, quality gate must fail ---------
+echo "== leg 2: injected quality_skew (silent drift, gate must FAIL) =="
+REPORTER_FAULT_QUALITY_SKEW="60.0" \
+python -m reporter_tpu.serve --warmup "$WORK/config.json" "127.0.0.1:$PORT2" \
+    > "$WORK/serve_skew.log" 2>&1 &
+SERVE_PID=$!
+PIDS+=("$SERVE_PID")
+if ! wait_up "$PORT2" 240; then
+    echo "FAIL: skew-leg service never came up; tail of serve log:"
+    tail -20 "$WORK/serve_skew.log"
+    exit 1
+fi
+
+# no --server-slo here, deliberately: the serving objectives stay green
+# under the skew (latency/availability cannot see it) and the server's
+# agreement objective is EXPECTED to violate — what must catch it is the
+# quality gate below, not the load generator
+python tools/loadgen.py --url "http://127.0.0.1:$PORT2" \
+    "${DENSE_ARGS[@]}" --out "$WORK/loadgen_dense_skew.json"
+python tools/loadgen.py --url "http://127.0.0.1:$PORT2" \
+    "${SPARSE_ARGS[@]}" --out "$WORK/loadgen_sparse_skew.json"
+drain_quality "$PORT2"
+mv "$WORK/slo_snapshot.json" "$WORK/slo_skew.json"
+
+set +e
+python tools/quality_gate.py QUALITY_BASELINE.json \
+    --fresh "$WORK/slo_skew.json" --min-agreement 0.85 \
+    > "$WORK/quality_gate_skew.json"
+SKEW_RC=$?
+set -e
+if [ "$SKEW_RC" -ne 1 ]; then
+    echo "FAIL: quality gate rc $SKEW_RC under injected skew (want 1)"
+    cat "$WORK/quality_gate_skew.json"
+    exit 1
+fi
+
+python - <<EOF
+# the drift is visible exactly where it should be: serving SLO green,
+# agreement objective violating + alerting
+import json
+slo = json.load(open("$WORK/slo_skew.json"))
+agr = [o for o in slo["objectives"] if o["kind"] == "agreement"][0]
+assert agr["value"] is not None and not agr["ok"], agr
+assert agr["alerting"], agr
+serving = [o for o in slo["objectives"] if o["kind"] != "agreement"]
+assert all(o["ok"] for o in serving), serving
+dense = json.load(open("$WORK/loadgen_dense_skew.json"))
+assert dense["slo"]["client"]["ok"] is True  # the drift IS silent on the wire
+print("skew leg: serving green, agreement %.4f violating+alerting, "
+      "gate rc 1 — the quality plane catches what the serving plane "
+      "cannot" % agr["value"])
+EOF
+
+echo "quality rehearsal OK (artifacts in $WORK)"

@@ -1,0 +1,530 @@
+"""Match-quality observability plane (docs/match-quality.md): shadow-
+oracle sampling, kernel confidence diagnostics, per-request
+match_options parity, the agreement SLO objective + drift alerting, the
+quality gate, and the ≤5% p99 sampling-overhead bound."""
+
+import json
+import time
+
+import numpy as np
+import pytest
+
+from reporter_tpu import faults
+from reporter_tpu.matching import MatcherConfig, SegmentMatcher
+from reporter_tpu.obs import quality as obs_quality
+from reporter_tpu.obs import slo as obs_slo
+from reporter_tpu.obs.quality import QualityEngine, gap_bucket, len_bucket
+from reporter_tpu.obs.slo import Objective, SLOEngine
+from reporter_tpu.tiles.arrays import build_graph_arrays
+from reporter_tpu.tiles.network import grid_city
+from reporter_tpu.tiles.ubodt import build_ubodt
+
+
+@pytest.fixture(scope="module")
+def arrays():
+    return build_graph_arrays(grid_city(rows=5, cols=5, spacing_m=150.0),
+                              cell_size=100.0)
+
+
+@pytest.fixture(scope="module")
+def ubodt(arrays):
+    return build_ubodt(arrays, delta=2000.0)
+
+
+@pytest.fixture()
+def fresh_slo():
+    """Isolate the process-wide SLO/quality engines: tests that configure
+    them must not leak an agreement objective into later suites."""
+    yield
+    obs_slo.configure(None)
+    obs_quality._ENGINE = None
+
+
+def _street_trace(arrays, n=10, uuid="veh-q", dt=5.0, row=2):
+    nodes = [row * 5 + c for c in range(5)]
+    t = np.linspace(0.05, 0.9, n)
+    xs = np.interp(t, np.linspace(0, 1, 5), arrays.node_x[nodes])
+    ys = np.interp(t, np.linspace(0, 1, 5), arrays.node_y[nodes])
+    lat, lon = arrays.proj.to_latlon(xs, ys)
+    return {
+        "uuid": uuid,
+        "trace": [{"lat": float(a), "lon": float(o), "time": 1000.0 + dt * i}
+                  for i, (a, o) in enumerate(zip(lat, lon))],
+        "match_options": {"mode": "auto", "report_levels": [0, 1],
+                          "transition_levels": [0, 1]},
+    }
+
+
+# -- cohort bucketing --------------------------------------------------------
+
+
+def test_gap_and_len_buckets():
+    assert gap_bucket([0, 5, 10]) == "lt15"
+    assert gap_bucket([0, 50, 100]) == "45-60"
+    assert gap_bucket([0, 60]) == "ge60"
+    assert gap_bucket([0, 20, 40]) == "15-30"
+    assert gap_bucket([1000.0]) == "lt15"  # degenerate: one point
+    assert len_bucket(8) == "short"
+    assert len_bucket(64) == "med"
+    assert len_bucket(500) == "long"
+
+
+# -- kernel confidence aux ---------------------------------------------------
+
+
+def test_quality_aux_off_by_default(arrays, ubodt):
+    m = SegmentMatcher(arrays=arrays, ubodt=ubodt, config=MatcherConfig())
+    out = m.match_many([_street_trace(arrays)])
+    assert "_quality" not in out[0]
+
+
+def test_quality_aux_attached_with_margins(arrays, ubodt):
+    m = SegmentMatcher(arrays=arrays, ubodt=ubodt,
+                       config=MatcherConfig(quality_aux=True))
+    out = m.match_many([_street_trace(arrays, n=10)])
+    q = out[0]["_quality"]
+    assert q["n_points"] == 10
+    assert len(q["edge"]) == 10
+    assert q["breaks"] >= 1  # the window start counts
+    assert q["margin_mean"] is not None and q["margin_mean"] >= 0
+    assert q["margin_min"] is not None and q["margin_min"] >= 0
+    assert 0.0 <= q["pool_exhausted_frac"] <= 1.0
+    # the segments themselves are untouched by the aux programs
+    ref = SegmentMatcher(arrays=arrays, ubodt=ubodt, config=MatcherConfig())
+    assert out[0]["segments"] == ref.match_many(
+        [_street_trace(arrays, n=10)])[0]["segments"]
+
+
+def test_quality_aux_long_trace_folds_across_chunks(arrays, ubodt):
+    cfg = MatcherConfig(quality_aux=True, length_buckets=[16, 32])
+    m = SegmentMatcher(arrays=arrays, ubodt=ubodt, config=cfg)
+    tr = _street_trace(arrays, n=80)  # 3 chunks at W=32
+    q = m.match_many([tr])[0]["_quality"]
+    assert q["n_points"] == 80 and len(q["edge"]) == 80
+    assert q["margin_mean"] is not None
+
+
+# -- per-request match_options parity ---------------------------------------
+
+
+def test_match_options_override_equals_configured(arrays, ubodt):
+    """A per-request sigma_z/beta/search_radius override must produce the
+    EXACT wire output of a matcher configured with those values — the
+    override is the same traced program with different scalars."""
+    override = {"sigma_z": 6.5, "beta": 5.0, "search_radius": 40.0}
+    m_default = SegmentMatcher(arrays=arrays, ubodt=ubodt,
+                               config=MatcherConfig())
+    m_tuned = SegmentMatcher(
+        arrays=arrays, ubodt=ubodt,
+        config=MatcherConfig(sigma_z=6.5, beta=5.0, search_radius=40.0))
+    rng = np.random.default_rng(7)
+    traces = []
+    for i in range(4):
+        t = _street_trace(arrays, n=12, uuid="veh-%d" % i, row=1 + i % 3)
+        for p in t["trace"]:
+            p["lat"] += float(rng.normal(0, 2e-5))
+            p["lon"] += float(rng.normal(0, 2e-5))
+        traces.append(t)
+    tuned_req = [dict(t, match_options=dict(t["match_options"], **override))
+                 for t in traces]
+    out_override = m_default.match_many(tuned_req)
+    out_tuned = m_tuned.match_many(traces)
+    for a, b in zip(out_override, out_tuned):
+        assert a == b
+
+
+def test_match_options_mixed_batch_and_key(arrays, ubodt):
+    m = SegmentMatcher(arrays=arrays, ubodt=ubodt, config=MatcherConfig())
+    plain = _street_trace(arrays, uuid="plain")
+    custom = _street_trace(arrays, uuid="custom")
+    custom["match_options"]["beta"] = 9.0
+    assert m._params_key(plain) == ()
+    key = m._params_key(custom)
+    assert key and key[1] == 9.0
+    # override equal to the config default collapses to the fast path
+    same = _street_trace(arrays, uuid="same")
+    same["match_options"]["beta"] = m.cfg.beta
+    assert m._params_key(same) == ()
+    out = m.match_many([plain, custom, plain])
+    assert all(r["segments"] for r in out)
+
+
+def test_match_options_effective_clamps_radius(arrays, ubodt):
+    m = SegmentMatcher(arrays=arrays, ubodt=ubodt, config=MatcherConfig())
+    eff = m.effective_match_options({"search_radius": 10_000.0})
+    assert eff["search_radius"] == float(arrays.cell_size) / 2.0
+    # gps_accuracy is sigma-like and loses to an explicit sigma_z
+    assert m.effective_match_options({"gps_accuracy": 9.0})["sigma_z"] == 9.0
+    assert m.effective_match_options(
+        {"gps_accuracy": 9.0, "sigma_z": 3.0})["sigma_z"] == 3.0
+    # invalid values degrade to the config (the service 400s them first)
+    assert (m.effective_match_options({"beta": "bogus"})["beta"]
+            == m.cfg.beta)
+
+
+# -- the shadow-oracle engine ------------------------------------------------
+
+
+def test_engine_compare_scores_agreement(arrays, ubodt):
+    m = SegmentMatcher(arrays=arrays, ubodt=ubodt,
+                       config=MatcherConfig(quality_aux=True))
+    fed = []
+    eng = QualityEngine(m, sample_every=1, start_worker=False,
+                        slo_feed=lambda v, w: fed.append((v, w)))
+    tr = _street_trace(arrays, n=10)
+    prod = m.match_many([tr])[0]["_quality"]["edge"]
+    frac = eng.compare(tr, prod)
+    assert frac == 1.0  # the device agrees with itself re-matched by brute
+    assert fed and fed[-1] == (1.0, 10.0)
+    rep = eng.report()
+    assert rep["overall"]["agreement"] == 1.0
+    assert rep["overall"]["points"] == 10
+    (cohort,) = rep["cohorts"]
+    assert cohort.startswith("gap=lt15|len=short|kernel=scan|")
+    # a corrupted production answer scores below 1.0
+    bad = list(prod)
+    bad[0] = -1 if prod[0] >= 0 else 0
+    frac2 = eng.compare(tr, bad)
+    assert frac2 is not None and frac2 < 1.0
+
+
+def test_engine_queue_bounded_and_drops(arrays, ubodt):
+    m = SegmentMatcher(arrays=arrays, ubodt=ubodt,
+                       config=MatcherConfig(quality_aux=True))
+    eng = QualityEngine(m, sample_every=1, queue_max=2, start_worker=False,
+                        slo_feed=lambda v, w: None)
+    tr = _street_trace(arrays, n=4)
+    q = {"edge": [0, 1, 2, 3]}
+    takes = [eng.maybe_sample(tr, q) for _ in range(5)]
+    assert takes == [True, True, False, False, False]
+    assert eng._q.qsize() == 2
+    assert eng.report()["samples_dropped"] == 3
+    # no per-point edges -> skipped, never enqueued
+    assert eng.maybe_sample(tr, {}) is False
+
+
+def test_engine_sampling_cadence(arrays, ubodt):
+    m = SegmentMatcher(arrays=arrays, ubodt=ubodt,
+                       config=MatcherConfig(quality_aux=True))
+    eng = QualityEngine(m, sample_every=4, queue_max=64, start_worker=False,
+                        slo_feed=lambda v, w: None)
+    tr = _street_trace(arrays, n=4)
+    q = {"edge": [0, 1, 2, 3]}
+    took = sum(eng.maybe_sample(tr, q) for _ in range(40))
+    assert took == 10  # exactly 1-in-4
+
+
+# -- the agreement SLO objective --------------------------------------------
+
+
+def test_agreement_objective_math_and_alerting():
+    clock = {"t": 1000.0}
+    eng = SLOEngine([Objective("agreement", "agreement", 0.90)],
+                    window_s=30.0, instrument=False,
+                    clock=lambda: clock["t"])
+    # healthy: mean 0.96 over the window -> ok, burn < 1
+    for i in range(10):
+        clock["t"] += 1.0
+        eng.observe_sample("agreement", 0.96, weight=10.0)
+    st = eng._objective_state(eng.objectives[0], clock["t"])
+    assert st["ok"] and not st["alerting"]
+    assert abs(st["value"] - 0.96) < 1e-6
+    assert st["sample_weight"] == 100.0
+    assert abs(eng.burn_rate(eng.objectives[0], 30.0) - 0.4) < 1e-6
+    # drift: agreement collapses -> burn >> factor in BOTH pair windows
+    # within one short window's worth of samples
+    for i in range(6):
+        clock["t"] += 1.0
+        eng.observe_sample("agreement", 0.30, weight=50.0)
+    st = eng._objective_state(eng.objectives[0], clock["t"])
+    assert not st["ok"]
+    assert st["alerting"], st
+    # no samples at all: vacuously compliant, burns nothing
+    eng2 = SLOEngine([Objective("agreement", "agreement", 0.90)],
+                     window_s=30.0, instrument=False)
+    st2 = eng2._objective_state(eng2.objectives[0], None)
+    assert st2["ok"] and st2["value"] is None
+    assert eng2.burn_rate(eng2.objectives[0], 30.0) == 0.0
+
+
+def test_agreement_objective_spec_and_env(monkeypatch, fresh_slo):
+    assert any(o.kind == "agreement"
+               for o in obs_slo.objectives_from_spec({"agreement": 0.92}))
+    monkeypatch.setenv("REPORTER_SLO_AGREEMENT", "0.88")
+    objs = obs_slo.default_objectives()
+    (agr,) = [o for o in objs if o.kind == "agreement"]
+    assert agr.target == 0.88
+    monkeypatch.delenv("REPORTER_SLO_AGREEMENT")
+    assert not any(o.kind == "agreement"
+                   for o in obs_slo.default_objectives())
+
+
+# -- end to end through the service -----------------------------------------
+
+
+def _mk_service(arrays, ubodt, quality=None, slo=None, **cfg_kw):
+    from reporter_tpu.serve import ReporterService
+
+    matcher = SegmentMatcher(arrays=arrays, ubodt=ubodt,
+                             config=MatcherConfig(**cfg_kw))
+    return ReporterService(matcher, max_wait_ms=2.0, quality=quality,
+                           slo=slo)
+
+
+def test_service_shadow_sampling_e2e(arrays, ubodt, fresh_slo):
+    svc = _mk_service(arrays, ubodt, quality={"sample_every": 1},
+                      slo={"window_s": 60, "availability": 0.95})
+    assert svc.quality is not None
+    assert svc.matcher._quality_aux  # configure() flipped it on
+    for i in range(6):
+        code, out = svc.handle_report(_street_trace(arrays, uuid="v%d" % i))
+        assert code == 200
+    assert svc.quality.drain(30)
+    code, slo = svc.handle_slo({})
+    assert code == 200
+    q = slo["quality"]
+    assert q["samples_compared"] == 6
+    assert q["overall"]["agreement"] is not None
+    assert q["overall"]["agreement"] >= 0.95  # clean street traces
+    (agr,) = [o for o in slo["objectives"] if o["kind"] == "agreement"]
+    assert agr["ok"] and not agr["alerting"]
+    # the statusz quality line rides too
+    _code, statusz = svc.handle_statusz()
+    assert statusz["quality"]["agreement"] == q["overall"]["agreement"]
+
+
+def test_service_debug_payload_and_low_margin_flight(arrays, ubodt,
+                                                     monkeypatch, fresh_slo):
+    # threshold high enough that every decode counts as low-margin
+    monkeypatch.setenv("REPORTER_QUALITY_MARGIN_KEEP", "1e9")
+    from reporter_tpu.obs import flight as obs_flight
+
+    svc = _mk_service(arrays, ubodt, quality_aux=True)
+    code, out = svc.handle_report(_street_trace(arrays, uuid="veh-dbg"),
+                                  debug=True)
+    assert code == 200
+    dbg = out["debug"]
+    assert dbg["quality"]["margin_mean"] is not None
+    assert "edge" not in dbg["quality"]  # raw edges never reach the wire
+    assert dbg["match_options"]["sigma_z"] == pytest.approx(4.07)
+    # the wire payload carries no leaked matcher internals
+    assert "_quality" not in out.get("segment_matcher", {})
+    found = [e for e in obs_flight.RECORDER.snapshot(64)
+             if e.get("retained") == "low_margin"]
+    assert found, "low-margin trace must be flight-retained"
+
+
+def test_service_rejects_bad_match_options(arrays, ubodt, fresh_slo):
+    svc = _mk_service(arrays, ubodt)
+    bad = _street_trace(arrays)
+    bad["match_options"]["sigma_z"] = -2
+    code, out = svc.handle_report(bad)
+    assert code == 400 and "sigma_z" in out["error"]
+    walk = _street_trace(arrays)
+    walk["match_options"]["shape_match"] = "edge_walk"
+    code, out = svc.handle_report(walk)
+    assert code == 400 and "shape_match" in out["error"]
+    snap = _street_trace(arrays)
+    snap["match_options"]["shape_match"] = "map_snap"
+    snap["match_options"]["gps_accuracy"] = 5.0
+    code, _ = svc.handle_report(snap)
+    assert code == 200
+
+
+def test_quality_skew_trips_agreement_alert(arrays, ubodt, monkeypatch,
+                                            fresh_slo):
+    """The drift-injection contract (ISSUE acceptance): an armed
+    quality_skew must trip the agreement burn alert within one window;
+    the no-fault leg (test_service_shadow_sampling_e2e) must not."""
+    monkeypatch.setenv("REPORTER_FAULT_QUALITY_SKEW", "60.0")
+    faults.reset()
+    try:
+        svc = _mk_service(arrays, ubodt, quality={"sample_every": 1},
+                          slo={"window_s": 30, "availability": 0.95})
+        for i in range(10):
+            code, _ = svc.handle_report(
+                _street_trace(arrays, uuid="skew-%d" % i))
+            assert code == 200  # the degradation is SILENT on the wire
+        assert svc.quality.drain(30)
+        code, slo = svc.handle_slo({})
+        (agr,) = [o for o in slo["objectives"] if o["kind"] == "agreement"]
+        assert agr["value"] is not None and agr["value"] < 0.9
+        assert not agr["ok"]
+        assert agr["alerting"], agr
+        # the skewed snapshot also fails the quality gate (leg parity
+        # with the CI rehearsal)
+        assert slo["quality"]["overall"]["agreement"] < 0.9
+    finally:
+        monkeypatch.delenv("REPORTER_FAULT_QUALITY_SKEW")
+        faults.reset()
+
+
+def test_sampling_overhead_p99(arrays, ubodt, fresh_slo):
+    """Shadow sampling must stay off the hot path: ≤5% p99 delta with
+    sampling ON at a production cadence vs OFF, over the same request
+    stream (plus a small absolute epsilon for scheduler jitter, the
+    PR-1/2 overhead-bound pattern)."""
+    n = 300
+    traces = [_street_trace(arrays, uuid="ov-%d" % i, n=6)
+              for i in range(n)]
+
+    def p99(svc):
+        lats = []
+        for t in traces:
+            t0 = time.perf_counter()
+            code, _ = svc.handle_report(t)
+            lats.append(time.perf_counter() - t0)
+            assert code == 200
+        lats.sort()
+        return lats[int(0.99 * len(lats))]
+
+    def run(sampling):
+        svc = _mk_service(
+            arrays, ubodt,
+            quality={"sample_every": 8} if sampling else None,
+            quality_aux=True)
+        p99(svc)  # warm the dispatch path on both sides
+        return min(p99(svc) for _ in range(3))
+
+    t_off = run(False)
+    t_on = run(True)
+    assert t_on <= 1.05 * t_off + 0.005, (t_on, t_off)
+
+
+# -- the quality gate --------------------------------------------------------
+
+
+def _gate():
+    import importlib.util
+    import os
+
+    spec = importlib.util.spec_from_file_location(
+        "quality_gate",
+        os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "tools", "quality_gate.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _snap(overall_a, overall_n, cohorts=None):
+    return {"overall": {"agreement": overall_a, "points": overall_n},
+            "cohorts": cohorts or {}}
+
+
+def test_quality_gate_verdicts(tmp_path):
+    qg = _gate()
+
+    def write(name, d):
+        p = tmp_path / name
+        p.write_text(json.dumps(d))
+        return str(p)
+
+    base = write("base.json", _snap(0.95, 5000, {
+        "gap=45-60": {"agreement": 0.90, "points": 2000},
+        "gap=lt15": {"agreement": 0.97, "points": 3000},
+        "thin": {"agreement": 0.99, "points": 10},
+    }))
+    # same quality: OK
+    rc, v = qg.gate(base, write("same.json", _snap(0.95, 5000, {
+        "gap=45-60": {"agreement": 0.90, "points": 2000},
+        "gap=lt15": {"agreement": 0.968, "points": 3000},
+    })))
+    assert rc == 0 and v["verdict"] == "OK"
+    # a real regression in one cohort: rc 1
+    rc, v = qg.gate(base, write("reg.json", _snap(0.95, 5000, {
+        "gap=45-60": {"agreement": 0.70, "points": 2000},
+        "gap=lt15": {"agreement": 0.97, "points": 3000},
+    })))
+    assert rc == 1
+    bad = [r for r in v["rows"] if r["verdict"] == "REGRESSION"]
+    assert bad and bad[0]["cohort"] == "gap=45-60"
+    # thin cohorts are skipped, never judged
+    rc, v = qg.gate(base, write("thin.json", _snap(0.95, 5000, {
+        "thin": {"agreement": 0.0, "points": 5},
+    })))
+    assert rc == 0
+    assert any(s["cohort"] == "thin" for s in v["skipped"])
+    # tiny samples cannot fail on noise: 40 points at 0.85 vs base 0.95
+    # sits inside 3 binomial sigmas
+    rc, v = qg.gate(
+        write("b2.json", _snap(0.95, 40)),
+        write("f2.json", _snap(0.85, 40)))
+    assert rc == 0, v
+    # the absolute floor is baseline-independent
+    rc, v = qg.gate(base, write("floor.json", _snap(0.94, 5000)),
+                    min_agreement=0.97)
+    assert rc == 1 and v["floor_violated"]
+    # no samples: rc 2, an explicit INVALID
+    rc, v = qg.gate(base, write("empty.json", _snap(None, 0)))
+    assert rc == 2 and v["verdict"] == "INVALID"
+    # a /debug/slo response is unwrapped automatically
+    rc, _ = qg.gate(base, write("wrapped.json",
+                                {"ok": True, "quality": _snap(0.95, 5000)}))
+    assert rc == 0
+
+
+# -- loadgen sparse-gap scenario --------------------------------------------
+
+
+def test_loadgen_gap_sessions():
+    import importlib.util
+    import os
+
+    spec = importlib.util.spec_from_file_location(
+        "loadgen",
+        os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "tools", "loadgen.py"))
+    lg = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(lg)
+    sessions = lg.synth_sessions(4, 12, window=6, grid=5, seed=3,
+                                 gaps=[45.0, 60.0])
+    assert len(sessions) == 4
+    for i, (_uuid, reqs) in enumerate(sessions):
+        ts = [p["time"] for p in reqs[0]["trace"]]
+        gaps = np.diff(ts)
+        want = 45.0 if i % 2 == 0 else 60.0
+        assert np.allclose(gaps, want), (i, gaps[:3])
+    # default stays the dense 5 s fleet
+    dense = lg.synth_sessions(2, 12, window=6, grid=5, seed=3)
+    ts = [p["time"] for p in dense[0][1][0]["trace"]]
+    assert np.allclose(np.diff(ts), 5.0)
+
+
+# -- fleet federation of the quality plane ----------------------------------
+
+
+def test_federator_relays_agreement_to_fleet_engine():
+    from reporter_tpu.obs import federation as obs_fed
+
+    clock = {"t": 100.0}
+    fleet = SLOEngine([], window_s=60.0, instrument=False,
+                      clock=lambda: clock["t"])
+    fed = obs_fed.Federator([], fleet_engine=fleet)
+    statusz = {"replica": "rep-a",
+               "slo": {"objectives": {"agreement": {"value": 0.93,
+                                                    "target": 0.9}}}}
+    fed._feed_fleet_quality(statusz)
+    # the objective was added at the replica's target and the sample landed
+    (agr,) = [o for o in fleet.objectives if o.kind == "agreement"]
+    assert agr.target == 0.9
+    st = fleet._objective_state(agr, clock["t"])
+    assert st["value"] == pytest.approx(0.93)
+    # a replica without quality data is a no-op, never an error
+    fed._feed_fleet_quality({"replica": "rep-b", "slo": {"objectives": {}}})
+
+    # fleet_quality aggregates the feeds' last statusz: mean/min + the
+    # one-replica-diverging signal
+    f1 = obs_fed.ReplicaFeed("http://a")
+    f1.statusz = statusz
+    f1.rid = "rep-a"
+    f2 = obs_fed.ReplicaFeed("http://b")
+    f2.statusz = {"replica": "rep-b",
+                  "slo": {"objectives": {"agreement": {"value": 0.63,
+                                                       "target": 0.9}}}}
+    f2.rid = "rep-b"
+    fed._feeds = [f1, f2]
+    fq = fed.fleet_quality()
+    assert fq["mean"] == pytest.approx(0.78)
+    assert fq["min"] == pytest.approx(0.63)
+    assert set(fq["replicas"]) == {"rep-a", "rep-b"}

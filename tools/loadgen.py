@@ -70,10 +70,16 @@ MATCH_OPTIONS = {"mode": "auto", "report_levels": [0, 1],
 # -- request corpus ---------------------------------------------------------
 
 def synth_sessions(vehicles: int, points: int, window: int, grid: int,
-                   seed: int) -> List[Tuple[str, List[dict]]]:
+                   seed: int,
+                   gaps: Optional[List[float]] = None) -> List[Tuple[str, List[dict]]]:
     """Per-vehicle sessions from the in-repo synthesizer (numpy only — no
     accelerator): each vehicle is one route walk, windowed into
-    ``window``-point /report bodies in drive order."""
+    ``window``-point /report bodies in drive order.  ``gaps`` (seconds)
+    cycles per vehicle over the listed inter-point sampling gaps —
+    ``--gap-s 45,60`` synthesizes a fleet at the reference
+    BatchingProcessor's sparse operating point, the cohort whose
+    agreement cliff ROADMAP open item 4 chases (the quality plane labels
+    its shadow samples by exactly these gap buckets)."""
     from reporter_tpu.synth import TraceSynthesizer
     from reporter_tpu.tiles.arrays import build_graph_arrays
     from reporter_tpu.tiles.network import grid_city
@@ -81,8 +87,16 @@ def synth_sessions(vehicles: int, points: int, window: int, grid: int,
     city = grid_city(rows=grid, cols=grid, spacing_m=200.0)
     arrays = build_graph_arrays(city, cell_size=100.0)
     synth = TraceSynthesizer(arrays, seed=seed)
+    gaps = [g for g in (gaps or []) if g > 0]
     sessions = []
-    for i, s in enumerate(synth.batch(vehicles, points, dt=5.0, sigma=5.0)):
+    for i in range(vehicles):
+        dt = gaps[i % len(gaps)] if gaps else 5.0
+        # sparse gaps need long drives: scale the synthesizer's
+        # route-chaining budget with the drive time so a 45-60 s fleet on
+        # a small grid can still stitch enough legs together
+        s = synth.synthesize(points, dt=dt, sigma=5.0,
+                             uuid="loadgen-veh-%04d" % i,
+                             max_tries=max(20, int(points * dt / 10.0)))
         uuid = "loadgen-veh-%04d" % i
         pts = s.trace["trace"]
         reqs = []
@@ -403,6 +417,11 @@ def main(argv=None) -> int:
     ap.add_argument("--grid", type=int, default=8,
                     help="synth grid size (must match the served network "
                          "for sensible matches)")
+    ap.add_argument("--gap-s", default=None,
+                    help="comma list of inter-point sampling gaps in "
+                         "seconds, cycled per vehicle (e.g. 45,60 — the "
+                         "reference BatchingProcessor operating point; "
+                         "default: dense 5 s sampling)")
     # archive replay (make_requests.py-style rows)
     ap.add_argument("--archive", default=None, help="probe dir or glob")
     ap.add_argument("--sep", default="|")
@@ -438,6 +457,13 @@ def main(argv=None) -> int:
     health = fetch_json(base + "/health") or {}
 
     # corpus
+    gaps = None
+    if args.gap_s:
+        try:
+            gaps = [float(g) for g in str(args.gap_s).split(",") if g.strip()]
+            assert all(g > 0 for g in gaps) and gaps
+        except (ValueError, AssertionError):
+            ap.error("--gap-s wants a comma list of positive seconds")
     try:
         if args.archive:
             sessions = archive_sessions(
@@ -445,7 +471,8 @@ def main(argv=None) -> int:
                 args.lat_col, args.lon_col, args.window, args.limit)
         else:
             sessions = synth_sessions(args.vehicles, args.points,
-                                      args.window, args.grid, args.seed)
+                                      args.window, args.grid, args.seed,
+                                      gaps=gaps)
     except Exception as e:  # noqa: BLE001 - setup failure is rc 2
         sys.stderr.write("loadgen: corpus build failed: %s\n" % (e,))
         return 2
@@ -516,11 +543,25 @@ def main(argv=None) -> int:
     server_slo = None
     agree = None
     masking_debt = None
+    server_quality = None
     if args.server_slo:
         span_s = max(60.0, max(s.done for s in all_samples) + 30.0)
         server_slo = fetch_json(base + "/debug/slo?window=%d" % int(span_s))
         if server_slo is not None:
             agree = bool(server_slo.get("ok")) == bool(client["ok"])
+            # the quality objective rides the server verdict (the client
+            # cannot measure agreement — only the shadow oracle can), so
+            # its section is surfaced verbatim in the artifact and a
+            # violating agreement objective fails the agreement check
+            # above through server ok=false
+            server_quality = server_slo.get("quality")
+            agr_obj = next((o for o in server_slo.get("objectives", ())
+                            if o.get("kind") == "agreement"), None)
+            if agr_obj is not None and agr_obj.get("value") is not None:
+                sys.stderr.write(
+                    "loadgen: server agreement %.4f (target %.2f, %s)\n"
+                    % (agr_obj["value"], agr_obj["target"],
+                       "ok" if agr_obj["ok"] else "VIOLATING"))
             # a fleet router's verdict carries the masking-debt gauge
             # (obs/federation.py): replica budget failover hid from this
             # client.  Surfaced loudly — a PASSING run with a fat debt
@@ -548,6 +589,7 @@ def main(argv=None) -> int:
         "arrival": args.arrival,
         "seed": args.seed,
         "mode": ("archive" if args.archive else "synth"),
+        "gap_s": gaps,
         "time_warp": args.time_warp or None,
         "sessions": len(sessions),
         "requests": len(all_samples),
@@ -567,6 +609,7 @@ def main(argv=None) -> int:
             "client": {"ok": client["ok"], "verdict": client["verdict"],
                        "objectives": client["objectives"]},
             "server": server_slo,
+            "server_quality": server_quality,
             "agree": agree,
             "masking_debt": masking_debt,
         },

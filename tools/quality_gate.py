@@ -1,0 +1,169 @@
+#!/usr/bin/env python3
+"""Match-quality regression gate: judge a live/bench quality snapshot
+against a pinned baseline profile, the way tools/perf_gate.py judges
+throughput against the BENCH_r*.json history.
+
+Inputs are quality snapshots in the shape ``obs/quality.QualityEngine
+.report()`` emits — either the raw dict, or a whole ``GET /debug/slo``
+response (the ``"quality"`` section is extracted automatically):
+
+    {"overall": {"agreement": 0.957, "points": 4200},
+     "cohorts": {"gap=45-60|len=short|kernel=scan|layout=cuckoo|params=default":
+                 {"agreement": 0.91, "points": 800, "samples": 50}, ...}}
+
+The baseline profile (``QUALITY_BASELINE.json``, produced by the same
+rehearsal flow and committed) pins the expected agreement per cohort on
+the pinned fixture corpus.  Judgement is noise-aware: the failure
+threshold per cohort is
+
+    max(--threshold, z * (binomial sigma of baseline + of candidate))
+
+so a thin cohort (few compared points) cannot fail the gate on sampling
+noise, and a fat cohort cannot hide a real regression behind a generous
+flat threshold.  Cohorts with fewer than --min-points on either side are
+skipped (listed in the verdict).  The overall row always judges.
+
+``--min-agreement`` adds an absolute floor on the overall value —
+independent of the baseline, so a corrupted baseline cannot bless a
+broken matcher.
+
+Exit codes: 0 = no regression, 1 = regression (or floor violation),
+2 = invalid input (no samples, missing baseline, schema).  The verdict
+renders as one JSON object on stdout.  CI: the quality-rehearsal leg
+runs a warmed serve with shadow sampling at 1-in-1 over a pinned synth
+corpus, gates the /debug/slo quality section here, and asserts that an
+injected ``quality_skew`` fault FAILS the same gate.
+
+    python tools/quality_gate.py QUALITY_BASELINE.json --fresh /tmp/q.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import sys
+
+
+def load_snapshot(path: str) -> dict:
+    """A quality snapshot from either shape: the raw QualityEngine report
+    or a full /debug/slo response carrying it under "quality"."""
+    with open(path) as f:
+        d = json.load(f)
+    if "quality" in d and isinstance(d["quality"], dict):
+        d = d["quality"]
+    return d
+
+
+def _binom_sigma(a: float, n: float) -> float:
+    """Binomial std-dev of an agreement fraction over n compared points."""
+    if n <= 0:
+        return float("inf")
+    a = min(1.0, max(0.0, a))
+    return math.sqrt(a * (1.0 - a) / n)
+
+
+def _judge_row(name: str, base: dict, fresh: dict, threshold: float,
+               z: float) -> dict:
+    ba, bn = float(base.get("agreement") or 0.0), float(base.get("points") or 0)
+    fa, fn = float(fresh.get("agreement") or 0.0), float(fresh.get("points") or 0)
+    tol = max(threshold, z * (_binom_sigma(ba, bn) + _binom_sigma(fa, fn)))
+    drop = ba - fa
+    return {
+        "cohort": name,
+        "baseline": round(ba, 4),
+        "baseline_points": int(bn),
+        "candidate": round(fa, 4),
+        "candidate_points": int(fn),
+        "drop": round(drop, 4),
+        "tolerance": round(tol, 4),
+        "verdict": "REGRESSION" if drop > tol else "ok",
+    }
+
+
+def gate(baseline_path: str, fresh_path: str, threshold: float = 0.02,
+         z: float = 3.0, min_points: int = 100,
+         min_agreement: "float | None" = None) -> "tuple[int, dict]":
+    """The whole gate as a function (unit-tested directly).  Returns
+    (exit_code, verdict_dict)."""
+    try:
+        base = load_snapshot(baseline_path)
+        fresh = load_snapshot(fresh_path)
+    except (OSError, json.JSONDecodeError, ValueError) as e:
+        return 2, {"error": "unreadable input: %s" % e}
+
+    verdict: dict = {"baseline": baseline_path, "candidate": fresh_path}
+    b_all = base.get("overall") or {}
+    f_all = fresh.get("overall") or {}
+    if not b_all.get("points"):
+        verdict["verdict"] = "INVALID"
+        verdict["error"] = "baseline has no compared points"
+        return 2, verdict
+    if not f_all.get("points"):
+        verdict["verdict"] = "INVALID"
+        verdict["error"] = ("candidate has no compared points (is shadow "
+                            "sampling on? REPORTER_QUALITY_SAMPLE_EVERY)")
+        return 2, verdict
+
+    rows = [_judge_row("overall", b_all, f_all, threshold, z)]
+    skipped = []
+    b_cohorts = base.get("cohorts") or {}
+    f_cohorts = fresh.get("cohorts") or {}
+    for name in sorted(set(b_cohorts) & set(f_cohorts)):
+        b, f = b_cohorts[name], f_cohorts[name]
+        if (b.get("points", 0) < min_points
+                or f.get("points", 0) < min_points):
+            skipped.append({"cohort": name,
+                            "baseline_points": b.get("points", 0),
+                            "candidate_points": f.get("points", 0),
+                            "reason": "fewer than %d compared points"
+                                      % min_points})
+            continue
+        rows.append(_judge_row(name, b, f, threshold, z))
+    # a cohort present in only one profile is worth seeing, not judging
+    for name in sorted(set(b_cohorts) ^ set(f_cohorts)):
+        skipped.append({"cohort": name,
+                        "reason": "present in only one profile"})
+
+    regressed = any(r["verdict"] == "REGRESSION" for r in rows)
+    floor_violated = False
+    if min_agreement is not None:
+        floor_violated = float(f_all.get("agreement") or 0.0) < min_agreement
+        verdict["min_agreement"] = min_agreement
+        verdict["floor_violated"] = floor_violated
+    verdict["rows"] = rows
+    verdict["skipped"] = skipped
+    verdict["regressed"] = bool(regressed or floor_violated)
+    verdict["verdict"] = ("REGRESSION" if verdict["regressed"] else "OK")
+    return (1 if verdict["regressed"] else 0), verdict
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="match-quality regression gate vs a pinned baseline")
+    ap.add_argument("baseline", help="pinned baseline profile "
+                                     "(QUALITY_BASELINE.json)")
+    ap.add_argument("--fresh", required=True,
+                    help="candidate snapshot (QualityEngine.report() dict "
+                         "or a /debug/slo response)")
+    ap.add_argument("--threshold", type=float, default=0.02,
+                    help="flat agreement drop that fails a cohort "
+                         "(widened by binomial noise either way; "
+                         "default 0.02)")
+    ap.add_argument("--z", type=float, default=3.0,
+                    help="noise widening in binomial sigmas (default 3)")
+    ap.add_argument("--min-points", type=int, default=100,
+                    help="skip cohorts with fewer compared points than "
+                         "this on either side (default 100)")
+    ap.add_argument("--min-agreement", type=float, default=None,
+                    help="absolute floor on the candidate's overall "
+                         "agreement, independent of the baseline")
+    args = ap.parse_args(argv)
+    rc, verdict = gate(args.baseline, args.fresh, args.threshold, args.z,
+                       args.min_points, args.min_agreement)
+    print(json.dumps(verdict, indent=1))
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

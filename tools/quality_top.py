@@ -1,0 +1,159 @@
+#!/usr/bin/env python3
+"""quality_top: a live terminal view of the match-QUALITY plane.
+
+One screen for the shadow-oracle agreement surfaces (obs/quality.py,
+docs/match-quality.md), per cohort and — against a fleet router's
+federated ``GET /metrics`` — per replica:
+
+  - cohort rows from ``reporter_quality_agreement{gap,len,kernel,layout,
+    params}``: the windowed mean agreement each cohort is running at,
+    so the sparse-gap cliff (ROADMAP open item 4) reads as a low
+    ``gap=45-60`` row, not a rerun offline sweep;
+  - the sampler health line: compared / dropped counts
+    (``reporter_quality_samples_total``), queue depth, and the
+    agree/disagree point totals;
+  - the confidence line: low-margin fraction (low-margin traces over
+    margin-scored traces, from ``reporter_match_low_margin_total`` /
+    ``reporter_match_margin_count``) — rising = decodes getting
+    ambiguous even if agreement still holds;
+  - with ``--target`` pointed at a router, every row additionally keys
+    by the ``replica`` label and the fleet mean/min gauges
+    (``reporter_fleet_quality_agreement``) render on the verdict line.
+
+Usage:
+    python tools/quality_top.py --target http://localhost:8002 [--interval 2]
+    python tools/quality_top.py --target http://replica1:8002 \
+        --target http://replica2:8002 --once
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+import urllib.request
+from typing import Dict, List, Optional, Tuple
+
+try:
+    from reporter_tpu.obs.quantile import merge_parsed, parse_metrics
+except ImportError:  # run from anywhere: tools/ sits next to the package
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    from reporter_tpu.obs.quantile import merge_parsed, parse_metrics
+
+
+def fetch_metrics(url: str, timeout: float = 5.0) -> Optional[dict]:
+    try:
+        with urllib.request.urlopen(url + "/metrics", timeout=timeout) as r:
+            return parse_metrics(r.read().decode("utf-8", "replace"))
+    except Exception as e:  # noqa: BLE001 - a dead target is a row, not a crash
+        sys.stderr.write("quality_top: GET %s/metrics failed: %s\n" % (url, e))
+        return None
+
+
+def _scalar(metrics: dict, family: str,
+            match: Optional[dict] = None) -> Optional[float]:
+    total = None
+    for labels, v in (metrics.get(family) or {}).items():
+        d = dict(labels)
+        if match and any(d.get(k) != want for k, want in match.items()):
+            continue
+        total = (total or 0.0) + v
+    return total
+
+
+def cohort_rows(metrics: dict) -> List[Tuple[tuple, float]]:
+    """Sorted ((replica, gap, len, kernel, layout, params), agreement)
+    rows from the (possibly replica-labeled federated) gauge family."""
+    rows = []
+    for labels, v in (metrics.get("reporter_quality_agreement") or {}).items():
+        d = dict(labels)
+        key = (d.get("replica", "-"), d.get("gap", "?"), d.get("len", "?"),
+               d.get("kernel", "?"), d.get("layout", "?"),
+               d.get("params", "?"))
+        rows.append((key, v))
+    rows.sort()
+    return rows
+
+
+def render(metrics: dict) -> str:
+    out = []
+    out.append("%-10s %-7s %-6s %-6s %-7s %-8s %10s"
+               % ("replica", "gap", "len", "kernel", "layout", "params",
+                  "agreement"))
+    rows = cohort_rows(metrics)
+    if not rows:
+        out.append("  (no reporter_quality_agreement samples — is "
+                   "REPORTER_QUALITY_SAMPLE_EVERY set?)")
+    for (rid, gap, ln, kern, layout, params), v in rows:
+        flag = "  <-- LOW" if v < 0.9 else ""
+        out.append("%-10s %-7s %-6s %-6s %-7s %-8s %10.4f%s"
+                   % (rid[:10], gap, ln, kern, layout, params, v, flag))
+
+    agree = _scalar(metrics, "reporter_quality_points_total",
+                    {"verdict": "agree"}) or 0.0
+    disagree = _scalar(metrics, "reporter_quality_points_total",
+                       {"verdict": "disagree"}) or 0.0
+    compared = _scalar(metrics, "reporter_quality_samples_total",
+                       {"outcome": "compared"}) or 0.0
+    dropped = _scalar(metrics, "reporter_quality_samples_total",
+                      {"outcome": "dropped_queue"}) or 0.0
+    depth = _scalar(metrics, "reporter_quality_queue_depth") or 0.0
+    total_pts = agree + disagree
+    out.append("")
+    out.append("sampler: %d compared, %d dropped, queue depth %d, "
+               "lifetime agreement %s"
+               % (compared, dropped, depth,
+                  "%.4f" % (agree / total_pts) if total_pts else "-"))
+
+    low = _scalar(metrics, "reporter_match_low_margin_total") or 0.0
+    scored = _scalar(metrics, "reporter_match_margin_count") or 0.0
+    out.append("confidence: %d low-margin of %d margin-scored traces (%s)"
+               % (low, scored,
+                  "%.2f%%" % (100.0 * low / scored) if scored else "-"))
+
+    mean = _scalar(metrics, "reporter_fleet_quality_agreement",
+                   {"stat": "mean"})
+    mn = _scalar(metrics, "reporter_fleet_quality_agreement",
+                 {"stat": "min"})
+    if mean is not None and mean >= 0:
+        out.append("fleet: mean %.4f / min %.4f%s"
+                   % (mean, mn if mn is not None else -1,
+                      "   <-- ONE replica diverging"
+                      if mn is not None and mean - mn > 0.02 else ""))
+    return "\n".join(out)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="live per-cohort match-quality terminal view")
+    ap.add_argument("--target", action="append", required=True,
+                    help="service or router base url (repeatable; a "
+                         "router's federated /metrics carries every "
+                         "replica)")
+    ap.add_argument("--interval", type=float, default=2.0)
+    ap.add_argument("--once", action="store_true",
+                    help="render one frame and exit (scripts/tests)")
+    args = ap.parse_args(argv)
+
+    while True:
+        frames = [m for m in (fetch_metrics(u.rstrip("/"))
+                              for u in args.target) if m]
+        if not frames:
+            if args.once:
+                return 2
+            time.sleep(args.interval)
+            continue
+        metrics = frames[0] if len(frames) == 1 else merge_parsed(frames)
+        frame = render(metrics)
+        if args.once:
+            print(frame)
+            return 0
+        sys.stdout.write("\x1b[2J\x1b[H" + time.strftime("%H:%M:%S")
+                         + "  match-quality plane\n" + frame + "\n")
+        sys.stdout.flush()
+        time.sleep(args.interval)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
