@@ -1,4 +1,6 @@
 from .config import MatcherConfig
 from .matcher import SegmentMatcher
+from .session import SessionEngine, SessionState, SessionStore
 
-__all__ = ["MatcherConfig", "SegmentMatcher"]
+__all__ = ["MatcherConfig", "SegmentMatcher", "SessionEngine",
+           "SessionState", "SessionStore"]

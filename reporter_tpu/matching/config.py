@@ -71,6 +71,17 @@ class MatcherConfig:
     # Margins carry the kernels' documented float-associativity ULP
     # wiggle and are diagnostics only.
     quality_aux: bool = False
+    # per-vehicle session matcher (docs/performance.md "The session
+    # matcher"; ROADMAP open item 2): padded window buckets for the
+    # incremental session step — a streaming submit of n new points snaps
+    # to the smallest bucket >= n (beyond the largest: next power of two,
+    # the rebuild-from-replay path).  The session store is bounded
+    # (max_sessions, LRU) and TTL-evicted; session_tail_points bounds the
+    # rolling association tail + replay buffer per vehicle.
+    session_buckets: List[int] = field(default_factory=lambda: [4, 16])
+    session_tail_points: int = 64
+    max_sessions: int = 65536
+    session_ttl_s: float = 3600.0
     # batch rungs pre-dispatched per length bucket by warmup passes
     # (serve --warmup / batch --warmup); each snaps up to a ladder rung
     warmup_batch_sizes: List[int] = field(default_factory=lambda: [1])

@@ -56,8 +56,9 @@ C_DISPATCHES = obs.counter(
 C_DISPATCH_COHORT = obs.counter(
     "reporter_dispatch_cohort_total",
     "Device dispatches by trace cohort (bucketed = length-bucket batches, "
-    "long = carry-chain groups) and program kind (compact / pre / chain / "
-    "carry; docs/performance.md chunk-batched carry chain)",
+    "long = carry-chain groups, session = per-vehicle incremental steps) "
+    "and program kind (compact / pre / chain / carry / step; "
+    "docs/performance.md)",
     ("cohort", "kind"))
 C_WARM_SHAPES = obs.counter(
     "reporter_warmup_shapes_total",
@@ -309,13 +310,15 @@ class SegmentMatcher:
         self._jits: Dict[tuple, object] = {}
 
     def _get_jit(self, kind: str, kernel: str):
-        """Lazily-built jitted forward for (kind in compact|carry|pre|chain,
-        kernel in scan|assoc).  "pre" is the carry-independent long-trace
-        precompute — it contains no viterbi forward, so it is
-        kernel-independent and cached under kernel "none"; "chain" is the
-        carry-dependent remainder it feeds.  The gp-sharded variants are
-        built through _make_gp_jits; all expose packed calling
-        conventions."""
+        """Lazily-built jitted forward for (kind in compact|carry|pre|
+        chain|session, kernel in scan|assoc).  "pre" is the
+        carry-independent long-trace precompute — it contains no viterbi
+        forward, so it is kernel-independent and cached under kernel
+        "none"; "chain" is the carry-dependent remainder it feeds;
+        "session" is the per-vehicle incremental step (ops/viterbi
+        .session_step_packed — always aux: the streaming path is the
+        ambiguity-sensitive one).  The gp-sharded variants are built
+        through _make_gp_jits; all expose packed calling conventions."""
         if kind == "pre":
             kernel = "none"
         # the aux (confidence-diagnostics) flag selects program VARIANTS
@@ -331,7 +334,7 @@ class SegmentMatcher:
                     self._jits[key] = self._make_gp_pre_jit()
                 else:
                     built = self._make_gp_jits(kernel, aux=qa)
-                    for kd in ("compact", "carry", "chain"):
+                    for kd in ("compact", "carry", "chain", "session"):
                         self._jits[(kd, kernel,
                                     qa and kd in ("compact", "chain"))] = built[kd]
             else:
@@ -343,6 +346,7 @@ class SegmentMatcher:
                     chain_batch_carry_packed, chain_batch_carry_packed_aux,
                     match_batch_carry_packed, match_batch_compact_packed,
                     match_batch_compact_packed_aux, precompute_batch_packed,
+                    session_step_packed,
                 )
 
                 # in-batch probe dedup applies where the UBODT probe sees a
@@ -369,6 +373,7 @@ class SegmentMatcher:
                         "carry": (match_batch_carry_packed, 4),
                         "chain": (chain_batch_carry_packed_aux if qa
                                   else chain_batch_carry_packed, 5),
+                        "session": (session_step_packed, 4),
                     }[kind]
                     self._jits[key] = jax.jit(
                         functools.partial(base, kernel=kernel),
@@ -516,7 +521,7 @@ class SegmentMatcher:
         from ..ops.viterbi import (
             chain_batch_carry_packed, chain_batch_carry_packed_aux,
             match_batch_carry_packed, match_batch_compact_packed,
-            match_batch_compact_packed_aux,
+            match_batch_compact_packed_aux, session_step_packed,
         )
         from ..parallel.mesh import BATCH_AXIS, GRAPH_AXIS
 
@@ -539,6 +544,10 @@ class SegmentMatcher:
                 dg, du.with_shard_axis(GRAPH_AXIS), pre, xin, p, k, carry,
                 kernel)
 
+        def body_session(dg, du, xin, p, carry):
+            return session_step_packed(
+                dg, du.with_shard_axis(GRAPH_AXIS), xin, p, k, carry, kernel)
+
         bat = P(None, BATCH_AXIS)  # packed arrays: [field, B, T]
         row = P(BATCH_AXIS)  # carry pytrees / [B, 4] aux blocks
         sm_compact = jax.jit(jax.shard_map(
@@ -558,12 +567,19 @@ class SegmentMatcher:
             out_specs=(bat, row, P(BATCH_AXIS)) if aux
             else (bat, P(BATCH_AXIS)), check_vma=False,
         ))
+        sm_session = jax.jit(jax.shard_map(
+            body_session, mesh=self._mesh,
+            in_specs=(P(), P(GRAPH_AXIS), bat, P(), P(BATCH_AXIS)),
+            out_specs=(bat, row, P(BATCH_AXIS)), check_vma=False,
+        ))
         return {
             "compact": lambda dg, du, xin, p, _k: sm_compact(dg, du, xin, p),
             "carry": lambda dg, du, xin, p, _k, carry: sm_carry(
                 dg, du, xin, p, carry),
             "chain": lambda dg, du, pre, xin, p, _k, carry: sm_chain(
                 dg, du, pre, xin, p, carry),
+            "session": lambda dg, du, xin, p, _k, carry: sm_session(
+                dg, du, xin, p, carry),
         }
 
     def _make_gp_pre_jit(self):
@@ -1347,10 +1363,283 @@ class SegmentMatcher:
         aux = None if aux_dev is None else np.asarray(aux_dev)[: len(group)]
         return group, (edge, offset, breaks), times, aux
 
+    # -- per-vehicle session steps (docs/performance.md "The session
+    # matcher"): the carried beam as first-class serving state.  Each call
+    # folds the newly-arrived points of MANY sessions into fixed-shape
+    # [B, small-W] dispatches of ops/viterbi.session_step_packed — B snaps
+    # to the same _BATCH_LADDER rungs as bucketed traffic, W to the
+    # session_buckets list, and the programs live in the same
+    # (kind, kernel) jit cache, so single-point latency and cross-vehicle
+    # batch throughput coexist on one compile set.
+
+    def _session_bucket(self, n: int) -> int:
+        """Smallest session window bucket >= n (next power of two beyond
+        the largest — the rebuild-from-replay path's occasional wide
+        step)."""
+        buckets = list(getattr(self.cfg, "session_buckets", ()) or (4, 16))
+        for b in buckets:
+            if n <= int(b):
+                return int(b)
+        b = int(buckets[-1])
+        while b < n:
+            b <<= 1
+        return b
+
+    def _fill_session_rows(self, items, idxs, W):
+        """Pack items[idxs]' points into padded [B, W] device arrays.
+        Times rebase against each session's own t0 epoch (not the step's
+        first point) so the carried beam's f32 time frame stays coherent
+        across the whole session (matcher._fill_rows rationale)."""
+        B = len(idxs)
+        px = np.zeros((B, W), np.float32)
+        py = np.zeros((B, W), np.float32)
+        tm = np.zeros((B, W), np.float32)
+        valid = np.zeros((B, W), bool)
+        ns = []
+        for row, i in enumerate(idxs):
+            pts = items[i]["points"]
+            n = len(pts)
+            lats = np.array([p["lat"] for p in pts], np.float64)
+            lons = np.array([p["lon"] for p in pts], np.float64)
+            x, y = self.arrays.proj.to_xy(lats, lons)
+            px[row, :n] = x
+            py[row, :n] = y
+            tm[row, :n] = (np.array([float(p["time"]) for p in pts],
+                                    np.float64)
+                           - float(items[i]["t0"]))
+            valid[row, :n] = True
+            ns.append(n)
+        return px, py, tm, valid, ns
+
+    def _carry_batch(self, carries, b_pad: int):
+        """Host carry dicts (None = inactive) -> one device TraceCarry
+        with leading [b_pad].  Exact f32 round trip: the pinned-host
+        session store and the device see identical bits."""
+        import jax
+        import jax.numpy as jnp
+
+        from ..ops.viterbi import NEG_INF, TraceCarry
+
+        k = self.cfg.beam_k
+        scores = np.full((b_pad, k), NEG_INF, np.float32)
+        edge = np.full((b_pad, k), -1, np.int32)
+        offset = np.zeros((b_pad, k), np.float32)
+        x = np.zeros(b_pad, np.float32)
+        y = np.zeros(b_pad, np.float32)
+        t = np.zeros(b_pad, np.float32)
+        active = np.zeros(b_pad, bool)
+        committed = np.full(b_pad, -1, np.int32)
+        for i, c in enumerate(carries):
+            if c is None:
+                continue
+            scores[i] = c["scores"]
+            edge[i] = c["edge"]
+            offset[i] = c["offset"]
+            x[i] = c["x"]
+            y[i] = c["y"]
+            t[i] = c["t"]
+            active[i] = bool(c["active"])
+            committed[i] = c["committed"]
+        carry = TraceCarry(scores=scores, edge=edge, offset=offset,
+                           x=x, y=y, t=t, active=active, committed=committed)
+        if self._carry_sharding is not None:
+            return jax.device_put(carry, self._carry_sharding)
+        return jax.tree_util.tree_map(jnp.asarray, carry)
+
+    @staticmethod
+    def _carry_rows(carry, b: int):
+        """Device TraceCarry (leading [B_pad]) -> per-row host dicts,
+        trimmed to the first b live rows.  One sync wave (np.asarray per
+        leaf), on the collect side."""
+        scores = np.asarray(carry.scores)[:b]
+        edge = np.asarray(carry.edge)[:b]
+        offset = np.asarray(carry.offset)[:b]
+        x = np.asarray(carry.x)[:b]
+        y = np.asarray(carry.y)[:b]
+        t = np.asarray(carry.t)[:b]
+        active = np.asarray(carry.active)[:b]
+        committed = np.asarray(carry.committed)[:b]
+        return [
+            {"scores": scores[i], "edge": edge[i], "offset": offset[i],
+             "x": x[i], "y": y[i], "t": t[i], "active": bool(active[i]),
+             "committed": committed[i]}
+            for i in range(b)
+        ]
+
+    def match_sessions_async(self, items):
+        """Dispatch incremental session steps for ``items`` and return a
+        zero-arg ``finish()`` resolving to one result per item:
+        ``((edge[n], offset[n], breaks[n]) numpy, aux [4] | None,
+        carry_host | None)``.
+
+        items: [{"points": [{"lat","lon","time"}...] (1..n, the arriving
+        delta — replay-prefixed by the rebuild path), "carry": host carry
+        dict or None (fresh/rebuilding session), "t0": rebase epoch,
+        "pkey": effective-params key}].
+
+        Items group by (pkey, session window bucket) and dispatch as
+        fixed-shape [B_rung, W] session_step_packed programs — the same
+        ladder rungs, compile counters and params grouping as bucketed
+        traffic.  On the cpu backend the step is a stateless windowed
+        rematch (no carry machinery in the numpy oracle): callers keep
+        continuity by replay-prefixing every step (SessionEngine does)."""
+        from ..ops.viterbi import pack_inputs
+
+        w_max = int((list(getattr(self.cfg, "session_buckets", ()) or ())
+                     or [16])[-1])
+        groups: Dict[tuple, List[int]] = {}
+        handles = []
+        for i, it in enumerate(items):
+            n = max(1, len(it["points"]))
+            if n > w_max and self.backend == "jax":
+                # an over-bucket step (rebuild-from-replay, or a fat
+                # delta) CHAINS through the largest warmed [B, W] session
+                # shape instead of compiling a wider one — the same
+                # fixed-compile-set property the long-trace path has, and
+                # the same decode the windowed long path produces (carry
+                # seams at W boundaries)
+                handles.append(self._dispatch_session_chain(it, i, w_max))
+                continue
+            groups.setdefault(
+                (it["pkey"], self._session_bucket(n)), []).append(i)
+        for (pkey, W), idxs in sorted(groups.items()):
+            cap = self._device_cap(W)
+            for g in range(0, len(idxs), cap):
+                sub = idxs[g : g + cap]
+                # same chaos seam as the windowed per-chunk dispatch: a
+                # transient device-program failure surfaces here and the
+                # session batcher's bisect-retry isolates it
+                faults.maybe_raise("ubodt_probe")
+                px, py, tm, valid, ns = self._fill_session_rows(
+                    items, sub, W)
+                if self.backend != "jax":
+                    cpu = self._cpu if not pkey else self._cpu_for(pkey)
+                    res = cpu.run_batch(px, py, tm, valid)
+                    handles.append(("cpu", sub, ns, res))
+                    continue
+                # NB allocating pads, not the pinned staging pool: the
+                # session batcher dispatches on ITS OWN worker thread next
+                # to the windowed batcher's, and _stage_rows assumes one
+                # dispatch thread per matcher.  Session windows are tiny
+                # ([B, 4..16]), so the copy is noise.
+                px, py, tm, valid = self._pad_batch(px, py, tm, valid)
+                if self._mesh is not None and px.shape[0] % self._n_dp:
+                    px, py, tm, valid = _pad_rows(
+                        self._n_dp - px.shape[0] % self._n_dp,
+                        px, py, tm, valid)
+                b_pad = px.shape[0]
+                carry = self._carry_batch(
+                    [items[i]["carry"] for i in sub]
+                    + [None] * (b_pad - len(sub)), b_pad)
+                kernel = self._kernel_for(W)
+                p = self._params_for(pkey)
+                fn = self._get_jit("session", kernel)
+                xin = self._put_packed(pack_inputs(px, py, tm, valid))
+                t0 = _time.monotonic()
+                packed, aux, carry_out = fn(
+                    self._dg, self._du, xin, p, self.cfg.beam_k, carry)
+                C_DISPATCHES.labels(kernel).inc()
+                C_DISPATCH_COHORT.labels("session", "step").inc()
+                self._note_dispatch(
+                    (b_pad, W), _time.monotonic() - t0, kind="session",
+                    kernel=kernel, fn=fn,
+                    args=(self._dg, self._du, xin, p, self.cfg.beam_k,
+                          carry))
+                self._start_host_copy(packed)
+                handles.append(("jax", sub, ns, packed, aux, carry_out))
+
+        def finish():
+            # chaos seam: a wedged device step hangs the session finisher
+            # exactly like the windowed one — the watchdog's prey, and the
+            # degraded CPU-oracle answering path's trigger
+            faults.hang("device_hang")
+            out = [None] * len(items)
+            from ..ops.viterbi import unpack_compact
+
+            for h in handles:
+                if h[0] == "cpu":
+                    _kind, sub, ns, res = h
+                    edge, offset, breaks = res
+                    for row, i in enumerate(sub):
+                        n = ns[row]
+                        out[i] = ((edge[row, :n], offset[row, :n],
+                                   breaks[row, :n]), None, None)
+                    continue
+                if h[0] == "chain":
+                    _kind, i, chunk_outs, carry_out = h
+                    E, O, B, aux_rows = [], [], [], []
+                    for packed, aux_dev, nc in chunk_outs:
+                        e_, o_, b_ = unpack_compact(packed)
+                        E.append(e_[0, :nc])
+                        O.append(o_[0, :nc])
+                        B.append(b_[0, :nc])
+                        aux_rows.append(np.asarray(aux_dev)[0])
+                    # aux components combine across seams as min/+/+/+
+                    aux = np.concatenate([
+                        [min(r[0] for r in aux_rows)],
+                        np.sum([r[1:] for r in aux_rows], axis=0)])
+                    out[i] = ((np.concatenate(E), np.concatenate(O),
+                               np.concatenate(B)), aux,
+                              self._carry_rows(carry_out, 1)[0])
+                    continue
+                _kind, sub, ns, packed, aux, carry_out = h
+                edge, offset, breaks = unpack_compact(packed)
+                aux_np = np.asarray(aux)
+                rows = self._carry_rows(carry_out, len(sub))
+                for row, i in enumerate(sub):
+                    n = ns[row]
+                    out[i] = ((edge[row, :n], offset[row, :n],
+                               breaks[row, :n]), aux_np[row], rows[row])
+            return out
+
+        return finish
+
+    def _dispatch_session_chain(self, item, idx: int, W: int):
+        """One over-bucket session step as a carry chain of [B, W]
+        session-program dispatches (B = 1 padded to the dp width): the
+        rebuild-from-replay path's occasional wide window rides the SAME
+        warmed shapes as normal streaming, and its decode equals the
+        windowed long-trace path's (carry seams at W boundaries) — the
+        differential suite pins it.  All chunks enqueue asynchronously;
+        the carry chains on device."""
+        from ..ops.viterbi import pack_inputs
+
+        pts = item["points"]
+        b_pad = max(1, self._n_dp)
+        carry = self._carry_batch(
+            [item["carry"]] + [None] * (b_pad - 1), b_pad)
+        p = self._params_for(item["pkey"])
+        kernel = self._kernel_for(W)
+        fn = self._get_jit("session", kernel)
+        chunk_outs = []
+        for c0 in range(0, len(pts), W):
+            chunk = dict(item, points=pts[c0 : c0 + W])
+            px, py, tm, valid, ns = self._fill_session_rows([chunk], [0], W)
+            if b_pad > 1:
+                px, py, tm, valid = _pad_rows(b_pad - 1, px, py, tm, valid)
+            xin = self._put_packed(pack_inputs(px, py, tm, valid))
+            t0 = _time.monotonic()
+            packed, aux, carry = fn(
+                self._dg, self._du, xin, p, self.cfg.beam_k, carry)
+            C_DISPATCHES.labels(kernel).inc()
+            C_DISPATCH_COHORT.labels("session", "chain").inc()
+            self._note_dispatch(
+                (b_pad, W), _time.monotonic() - t0, kind="session",
+                kernel=kernel, fn=fn,
+                args=(self._dg, self._du, xin, p, self.cfg.beam_k, carry))
+            chunk_outs.append((packed, aux, ns[0]))
+        self._start_host_copy(chunk_outs[-1][0])
+        return ("chain", idx, chunk_outs, carry)
+
+    def match_sessions(self, items):
+        """Synchronous match_sessions_async (tests/tools)."""
+        return self.match_sessions_async(items)()
+
     def warmup(self, lengths: "Sequence[int] | None" = None,
                batch_sizes: "Sequence[int] | None" = None,
                kernels: "Sequence[str] | None" = None,
-               carry_chain: bool = False) -> float:
+               carry_chain: bool = False,
+               session_step: bool = False) -> float:
         """Pre-compile the hot dispatch shapes so the first real request
         doesn't pay XLA compilation (the streaming operating point is a
         single ~64-pt window per call; a cold compile there blows the
@@ -1377,6 +1666,11 @@ class SegmentMatcher:
                        1-4 chunks per dispatch wave) and the "chain" score
                        recursion at [1, W]; legacy mode warms the fused
                        "carry" program as before
+          session_step also warm the per-vehicle incremental session-step
+                       programs: one (batch rung, session bucket) grid of
+                       ops/viterbi.session_step_packed dispatches (serve
+                       --warmup turns this on so the first streaming
+                       point never compiles inline)
 
         With the persistent compilation cache enabled
         ($REPORTER_XLA_CACHE_DIR, utils/jaxenv) a warm restart replays the
@@ -1419,6 +1713,24 @@ class SegmentMatcher:
                 # plus the kernel-independent chunk-batched precompute
                 n_shapes += 1
                 C_WARM_SHAPES.labels("none").inc()
+        if session_step:
+            # pre-dispatch the per-vehicle incremental-step shapes: one
+            # (batch rung, session bucket) grid through the REAL session
+            # dispatch path, so the first streaming point of a fresh boot
+            # never hits a compile stall (asserted like the carry-chain
+            # programs in tests/test_warmup_cache.py)
+            for w in (getattr(self.cfg, "session_buckets", ()) or (4, 16)):
+                w = max(1, int(w))
+                kern = self._kernel_for(w)
+                pts = _dummy_traces(max(2, w), 1)[0]["trace"][:w]
+                for b in batch_sizes:
+                    b = self._ladder_rung(max(1, int(b)))
+                    self.match_sessions([
+                        {"points": pts, "carry": None,
+                         "t0": float(pts[0]["time"]), "pkey": ()}
+                    ] * b)
+                    n_shapes += 1
+                    C_WARM_SHAPES.labels(kern).inc()
         dt = _time.time() - t0
         C_WARM_S.inc(dt)
         log.info("matcher warmup: %d shapes in %.1fs", n_shapes, dt)

@@ -1,0 +1,617 @@
+"""Per-vehicle matching sessions: the carried Viterbi beam as first-class,
+serialisable serving state (ROADMAP open item 2; FLASH Viterbi's adaptive
+online decoding and the O(1) autoregressive-caching framing are the models
+— PAPERS.md).
+
+The windowed path makes every served point pay window latency: the client
+(or the stream topology) re-batches micro-traces per uuid until a window
+fills, then the whole window is matched.  A **session** inverts that: the
+carried beam the PR 4 ``precompute_trace``/``chain_trace`` split already
+materialises — and previously threw away between requests — lives in a
+bounded, TTL-evicted, pinned-host store keyed by uuid, so each arriving
+point costs O(1) incremental work (one row of a ``session_step_packed``
+dispatch) and answers at point latency.
+
+Three pieces:
+
+  SessionState   one vehicle's live decode: the carried beam (host numpy,
+                 exact f32 — serialisable for the drain-time handoff), the
+                 rebase epoch the f32 device times are relative to, a
+                 bounded rolling tail of matched per-point records (the
+                 association context + the answer window), and a bounded
+                 replay buffer of raw points (the rebuild path when the
+                 beam could not travel).
+  SessionStore   uuid -> SessionState with max-size LRU eviction, TTL
+                 expiry, export/import (the beam handoff wire format) and
+                 metrics.
+  SessionEngine  the MicroBatcher-compatible engine: aggregates the
+                 streaming submits of many vehicles into one fixed-shape
+                 [B, small-W] ``session_step_packed`` dispatch through
+                 SegmentMatcher.match_sessions_async, applies results to
+                 the store only on success (so the poison bisect-retry can
+                 replay a failed batch safely), and renders each answer by
+                 associating the session's rolling tail + the new points —
+                 the same incremental contract the reference serves
+                 (shape_used over an accumulated recent shape).
+
+Robustness parity comes free: serve/service.py runs this engine inside a
+second MicroBatcher, so deadlines, 429 shedding, the poison bisect
+quarantine, the device watchdog and crash-loud loops all apply to session
+submits unchanged (docs/robustness.md).
+"""
+
+from __future__ import annotations
+
+import threading
+import time as _time
+from collections import OrderedDict
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .. import faults
+from ..obs import metrics as obs
+
+# session-plane metric families (docs/observability.md "Sessions")
+G_SESSIONS = obs.gauge(
+    "reporter_sessions_active",
+    "Open per-vehicle matching sessions in the pinned-host store")
+C_SESSION_EVENTS = obs.counter(
+    "reporter_sessions_total",
+    "Session lifecycle events (opened / expired / evicted / exported / "
+    "imported / import_merged / rebuilt / reattached)",
+    ("event",))
+C_SESSION_POINTS = obs.counter(
+    "reporter_session_points_total",
+    "Points folded into open sessions by the incremental step")
+H_STEP_SESSIONS = obs.histogram(
+    "reporter_session_step_sessions",
+    "Sessions folded per incremental session-step device dispatch",
+    buckets=obs.BATCH_FILL_BUCKETS)
+
+WIRE_VERSION = 1
+
+
+class SessionState:
+    """One vehicle's live decode.  Not thread-safe on its own — the store
+    lock serialises metadata and the single-worker SessionEngine
+    serialises step application."""
+
+    __slots__ = ("uuid", "t0", "carry", "records", "replay", "seq",
+                 "points_total", "pkey", "last_used", "created",
+                 "rebuild_pending", "imported")
+
+    def __init__(self, uuid: str, t0: float, pkey: tuple = ()):
+        self.uuid = uuid
+        # rebase epoch for the device's f32 times: epoch seconds would lose
+        # the dt resolution the time-factor cut needs (matcher._fill_rows)
+        self.t0 = float(t0)
+        # host-side TraceCarry leaves (dict of numpy / python scalars),
+        # None until the first step lands (or after a degraded-mode window
+        # invalidated it: rebuild_pending replays the buffer first)
+        self.carry: Optional[dict] = None
+        # rolling tail of matched per-point records, newest last:
+        # (edge i32, offset f32, break bool, time f64 epoch) — the
+        # association context the next answer window starts from
+        self.records: List[Tuple[int, float, bool, float]] = []
+        # raw points backing the records tail (same length, same order):
+        # the replay buffer the rebuild path re-matches
+        self.replay: List[dict] = []
+        self.seq = 0            # steps applied
+        self.points_total = 0   # points ever folded in
+        self.pkey = pkey
+        self.rebuild_pending = False
+        self.imported = False
+        now = _time.monotonic()
+        self.created = now
+        self.last_used = now
+
+    def trim(self, tail_points: int) -> None:
+        if len(self.records) > tail_points:
+            del self.records[: len(self.records) - tail_points]
+        if len(self.replay) > tail_points:
+            del self.replay[: len(self.replay) - tail_points]
+
+    # -- handoff wire format ------------------------------------------------
+
+    def to_wire(self) -> dict:
+        """JSON-able snapshot.  Carry floats ride as Python floats (f32 ->
+        f64 -> f32 is an exact round trip), so a handed-off beam continues
+        bit-exact on the inheriting replica."""
+        carry = None
+        if self.carry is not None:
+            c = self.carry
+            carry = {
+                "scores": [float(v) for v in c["scores"]],
+                "edge": [int(v) for v in c["edge"]],
+                "offset": [float(v) for v in c["offset"]],
+                "x": float(c["x"]), "y": float(c["y"]), "t": float(c["t"]),
+                "active": bool(c["active"]),
+                "committed": int(c["committed"]),
+            }
+        return {
+            "v": WIRE_VERSION,
+            "uuid": self.uuid,
+            "t0": self.t0,
+            "seq": self.seq,
+            "points_total": self.points_total,
+            "params": list(self.pkey) if self.pkey else None,
+            "carry": carry,
+            "records": [[int(e), float(o), bool(b), float(t)]
+                        for e, o, b, t in self.records],
+            "replay": self.replay,
+        }
+
+    @classmethod
+    def from_wire(cls, w: dict) -> "SessionState":
+        pkey = tuple(float(v) for v in w["params"]) if w.get("params") else ()
+        s = cls(str(w["uuid"]), float(w["t0"]), pkey)
+        s.seq = int(w.get("seq", 0))
+        s.points_total = int(w.get("points_total", 0))
+        s.records = [(int(e), float(o), bool(b), float(t))
+                     for e, o, b, t in w.get("records", ())]
+        s.replay = [dict(p) for p in w.get("replay", ())]
+        c = w.get("carry")
+        if c is not None:
+            s.carry = {
+                "scores": np.asarray(c["scores"], np.float32),
+                "edge": np.asarray(c["edge"], np.int32),
+                "offset": np.asarray(c["offset"], np.float32),
+                "x": np.float32(c["x"]), "y": np.float32(c["y"]),
+                "t": np.float32(c["t"]),
+                "active": bool(c["active"]),
+                "committed": np.int32(c["committed"]),
+            }
+        else:
+            # a replay-only payload rebuilds lazily on its next step
+            s.rebuild_pending = bool(s.replay)
+        s.imported = True
+        return s
+
+    def meta(self) -> dict:
+        """The per-answer session block (``"session"`` in the streaming
+        /report response) and the /sessions debug view."""
+        return {
+            "uuid": self.uuid,
+            "seq": self.seq,
+            "points_total": self.points_total,
+            "tail_points": len(self.records),
+            "rebuild_pending": bool(self.rebuild_pending),
+            "imported": bool(self.imported),
+            "age_s": round(_time.monotonic() - self.created, 1),
+        }
+
+
+class SessionStore:
+    """uuid -> SessionState, bounded and TTL-evicted.
+
+    LRU order rides an OrderedDict (move_to_end on touch); expiry sweeps
+    lazily on access so an idle store costs nothing.  All mutation is
+    lock-serialised; step application itself is serialised by the
+    single-worker SessionEngine above it."""
+
+    def __init__(self, max_sessions: int = 65536, ttl_s: float = 3600.0):
+        self.max_sessions = max(1, int(max_sessions))
+        self.ttl_s = float(ttl_s)
+        self._lock = threading.Lock()
+        self._by_uuid: "OrderedDict[str, SessionState]" = OrderedDict()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._by_uuid)
+
+    def _expire_locked(self, now: float) -> None:
+        if self.ttl_s <= 0:
+            return
+        dead = [u for u, s in self._by_uuid.items()
+                if now - s.last_used > self.ttl_s]
+        for u in dead:
+            del self._by_uuid[u]
+            C_SESSION_EVENTS.labels("expired").inc()
+        if dead:
+            G_SESSIONS.set(len(self._by_uuid))
+
+    def get_or_open(self, uuid: str, t0: float,
+                    pkey: tuple = ()) -> SessionState:
+        """The step path: returns the live session (touching its LRU/TTL
+        clock) or opens a fresh one, evicting the least-recently-used
+        session past the bound.  A params-key change mid-session reopens
+        it (a changed sigma_z invalidates the carried scores)."""
+        now = _time.monotonic()
+        with self._lock:
+            self._expire_locked(now)
+            s = self._by_uuid.get(uuid)
+            if s is not None and s.pkey == pkey:
+                s.last_used = now
+                self._by_uuid.move_to_end(uuid)
+                return s
+            if s is not None:  # params changed: restart the decode
+                del self._by_uuid[uuid]
+            while len(self._by_uuid) >= self.max_sessions:
+                self._by_uuid.popitem(last=False)
+                C_SESSION_EVENTS.labels("evicted").inc()
+            s = SessionState(uuid, t0, pkey)
+            self._by_uuid[uuid] = s
+            C_SESSION_EVENTS.labels("opened").inc()
+            G_SESSIONS.set(len(self._by_uuid))
+            return s
+
+    def peek(self, uuid: str) -> Optional[SessionState]:
+        with self._lock:
+            return self._by_uuid.get(uuid)
+
+    def drop(self, uuid: str) -> bool:
+        with self._lock:
+            s = self._by_uuid.pop(uuid, None)
+            G_SESSIONS.set(len(self._by_uuid))
+            return s is not None
+
+    def pop_wire(self, uuids) -> List[dict]:
+        """Atomic remove-and-serialise — the recovery rebalance's exact
+        transfer: the returned wires carry every point committed up to
+        the pop, and nothing can commit into the removed entry afterwards
+        (a step already in flight re-accounts itself via ``finalize``).
+        One locked sweep, so export+delete cannot interleave with a
+        concurrent import or commit."""
+        out = []
+        with self._lock:
+            for u in uuids:
+                s = self._by_uuid.pop(str(u), None)
+                if s is not None:
+                    out.append(s.to_wire())
+            G_SESSIONS.set(len(self._by_uuid))
+        if out:
+            C_SESSION_EVENTS.labels("exported").inc(len(out))
+        return out
+
+    def finalize(self, sess: SessionState, step_points: int,
+                 step_subs: int) -> None:
+        """Post-commit placement check (called by the engine after it
+        mutated ``sess``): if the session was popped (rebalance) or
+        evicted while this step was in flight, the popped wire already
+        carried the PRE-step ledger — so re-account ONLY this step's
+        points on a fresh local copy (or fold them into whatever session
+        took the uuid since).  Keeps the fleet-wide points ledger exact
+        under every interleaving of steps and handoffs."""
+        now = _time.monotonic()
+        with self._lock:
+            cur = self._by_uuid.get(sess.uuid)
+            if cur is sess:
+                return
+            if cur is not None:
+                # a different live session took the uuid: it owns the
+                # decode; this step's answered points join its ledger
+                cur.points_total += step_points
+                return
+            sess.points_total = step_points
+            sess.seq = step_subs
+            sess.last_used = now
+            self._by_uuid[sess.uuid] = sess
+            C_SESSION_EVENTS.labels("reattached").inc()
+            G_SESSIONS.set(len(self._by_uuid))
+
+    def export_all(self) -> List[dict]:
+        """The drain-time handoff payload: every live session's wire
+        snapshot.  Non-destructive — the exporting replica is about to
+        die anyway, and the importer skips uuids that already went live
+        elsewhere (so a racing re-dispatch can never be clobbered)."""
+        with self._lock:
+            out = [s.to_wire() for s in self._by_uuid.values()]
+        C_SESSION_EVENTS.labels("exported").inc(len(out))
+        return out
+
+    def import_wire(self, wires: List[dict]) -> dict:
+        """The inheriting side of the handoff.  A uuid with no local
+        session lands as-is — with its exact beam when the payload
+        carried one, else flagged for a rebuild-from-replay on its next
+        step.  A uuid that already went live locally (a re-dispatched
+        point raced the handoff and opened a fresh session) MERGES: the
+        imported replay prepends the live one and the live decode is
+        flagged for a rebuild over the combined history, while the points
+        ledger absorbs the imported count — no point is ever lost or
+        double-counted across a drain, and the race loser still converges
+        to the windowed decode of the full tail."""
+        skipped = rebuild = merged = 0
+        imported: List[str] = []
+        now = _time.monotonic()
+        states = []
+        for w in wires:
+            try:
+                states.append(SessionState.from_wire(w))
+            except (KeyError, TypeError, ValueError):
+                skipped += 1
+        with self._lock:
+            self._expire_locked(now)
+            for s in states:
+                live = self._by_uuid.get(s.uuid)
+                if live is not None:
+                    live.points_total += s.points_total
+                    live.seq += s.seq
+                    if s.replay:
+                        live.replay = list(s.replay) + live.replay
+                        live.rebuild_pending = True
+                    live.imported = True
+                    merged += 1
+                    imported.append(s.uuid)
+                    C_SESSION_EVENTS.labels("import_merged").inc()
+                    continue
+                while len(self._by_uuid) >= self.max_sessions:
+                    self._by_uuid.popitem(last=False)
+                    C_SESSION_EVENTS.labels("evicted").inc()
+                s.last_used = now
+                self._by_uuid[s.uuid] = s
+                imported.append(s.uuid)
+                if s.rebuild_pending:
+                    rebuild += 1
+                C_SESSION_EVENTS.labels("imported").inc()
+            G_SESSIONS.set(len(self._by_uuid))
+        # imported_uuids (absorbed payloads, merged included) lets the
+        # handoff driver DROP the source copies it duplicated (the
+        # recovery rebalance), keeping the fleet-wide points_total ledger
+        # exact — every folded point counted once
+        return {"imported": len(imported) - merged, "merged": merged,
+                "skipped": skipped, "rebuild_pending": rebuild,
+                "imported_uuids": imported}
+
+    def summary(self) -> dict:
+        with self._lock:
+            n = len(self._by_uuid)
+            pts = sum(s.points_total for s in self._by_uuid.values())
+        return {"sessions": n, "points_total": pts,
+                "max_sessions": self.max_sessions, "ttl_s": self.ttl_s}
+
+
+class SessionEngine:
+    """The streaming match engine serve/service.py mounts inside its
+    second MicroBatcher.  Speaks the SegmentMatcher batching contract
+    (``match_many_async(traces) -> finish``, ``match_many``), so every
+    MicroBatcher fault domain — bounded-queue shedding, deadlines, the
+    poison bisect-retry quarantine, the device watchdog, crash-loud
+    loops — applies to session submits without new machinery.
+
+    Store mutation happens ONLY in finish(), after the device answered:
+    a failed batch leaves every touched session exactly as it was, so the
+    bisect retry re-runs it safely and a poisoned session fails alone.
+    """
+
+    def __init__(self, matcher, store: SessionStore,
+                 tail_points: int = 64):
+        self.matcher = matcher
+        self.store = store
+        self.tail_points = max(2, int(tail_points))
+        # commit serialisation + the late-commit guard: _apply (finisher
+        # thread) and degraded_step (handler threads under the service's
+        # cpu lock) both mutate sessions; the generation bumps whenever
+        # the owning batcher wedges/crashes so a blocked finish that
+        # WAKES AFTER its futures were failed can never double-apply
+        # points the degraded path (or the client's retry) re-submitted
+        self._lock = threading.Lock()
+        self._generation = 0
+
+    def invalidate_inflight(self) -> None:
+        """Called by the serving tier when the session batcher wedges or
+        crashes: every step already dispatched had its futures failed, so
+        its eventual (late) finish must commit NOTHING — the points will
+        arrive again via the degraded path or the client's retry, and a
+        late commit would duplicate them in the session ledger."""
+        with self._lock:
+            self._generation += 1
+
+    # MicroBatcher sizes max_inflight off the engine's backend
+    @property
+    def backend(self) -> str:
+        return self.matcher.backend
+
+    def match_many(self, traces) -> List[dict]:
+        return self.match_many_async(traces)()
+
+    def match_many_async(self, traces):
+        # the same chaos seam as the windowed engine: an armed
+        # REPORTER_FAULT_DISPATCH uuid:<u> poisons any batch carrying that
+        # vehicle's step, which is exactly what the bisect quarantine
+        # isolates (docs/robustness.md; the chaos suite pins it for
+        # streaming too)
+        faults.maybe_raise("dispatch", key=",".join(
+            str(t.get("uuid", "")) for t in traces if isinstance(t, dict)))
+        m = self.matcher
+
+        # group by uuid IN ARRIVAL ORDER: two steps of one vehicle in one
+        # micro-batch must chain (the second sees the first's carry), so
+        # they fold into one entry and split back into per-request answers
+        order: "OrderedDict[str, dict]" = OrderedDict()
+        for i, tr in enumerate(traces):
+            uuid = str(tr.get("uuid") or "")
+            pts = list(tr.get("trace") or ())
+            ent = order.get(uuid)
+            if ent is None:
+                ent = order[uuid] = {
+                    "uuid": uuid, "pkey": m._params_key(tr),
+                    "subs": [], "points": []}
+            ent["subs"].append((i, len(ent["points"]), len(pts)))
+            ent["points"].extend(pts)
+
+        # resolve sessions + build the dispatch items.  The store is only
+        # READ here; rebuild-from-replay prepends the replay buffer to the
+        # step so the beam reconstitutes inside the same dispatch.
+        items = []
+        for ent in order.values():
+            pts = ent["points"]
+            t_first = float(pts[0]["time"]) if pts else 0.0
+            sess = self.store.get_or_open(ent["uuid"], t_first, ent["pkey"])
+            ent["sess"] = sess
+            rebuild = sess.rebuild_pending and bool(sess.replay)
+            ent["rebuild"] = rebuild
+            step_pts = (list(sess.replay) + pts) if rebuild else pts
+            ent["n_prefix"] = len(sess.replay) if rebuild else 0
+            items.append({
+                "points": step_pts,
+                "carry": None if rebuild else sess.carry,
+                "t0": sess.t0,
+                "pkey": ent["pkey"],
+            })
+        entries = list(order.values())
+        H_STEP_SESSIONS.observe(len(entries))
+        gen = self._generation
+        finish_dev = m.match_sessions_async(items)
+
+        def finish() -> List[dict]:
+            step_out = finish_dev()
+            results: List[Optional[dict]] = [None] * len(traces)
+            with self._lock:
+                if gen != self._generation:
+                    # the batcher wedged/crashed while this step was in
+                    # flight: its futures are already failed — commit
+                    # nothing, answer nothing (late-commit guard)
+                    return results  # type: ignore[return-value]
+                for ent, (rec, aux, carry_out) in zip(entries, step_out):
+                    self._apply(ent, rec, aux, carry_out, results)
+            return results  # type: ignore[return-value]
+
+        return finish
+
+    def _apply(self, ent: dict, rec, aux, carry_out, results) -> None:
+        """Fold one entry's device answer into its session and render the
+        per-sub-request answers.  rec: (edge[n], offset[n], breaks[n])
+        numpy over the step's points (replay prefix included)."""
+        sess: SessionState = ent["sess"]
+        edge, offset, breaks = rec
+        n_prefix = ent["n_prefix"]
+        pts = ent["points"]
+        step_pts = (list(sess.replay) + pts) if ent["rebuild"] else pts
+
+        new_recs = [
+            (int(edge[j]), float(np.float32(offset[j])), bool(breaks[j]),
+             float(step_pts[j]["time"]))
+            for j in range(len(step_pts))
+        ]
+        if ent["rebuild"]:
+            # the replay prefix REPLACES the stale tail: the rebuilt beam's
+            # records are the new association context
+            tail_recs = new_recs[:n_prefix]
+            tail_raw = list(sess.replay)
+            new_recs = new_recs[n_prefix:]
+            sess.rebuild_pending = False
+            C_SESSION_EVENTS.labels("rebuilt").inc()
+        else:
+            tail_recs = list(sess.records)
+            tail_raw = list(sess.replay)
+
+        # per-sub-request answers: each covers the tail + its own (and any
+        # earlier same-batch) points — the accumulated recent shape the
+        # reference's incremental contract reports over
+        for k, (i, p0, n) in enumerate(ent["subs"]):
+            win_recs = tail_recs + new_recs[: p0 + n]
+            win_raw = tail_raw + pts[: p0 + n]
+            results[i] = self._render(
+                sess, win_recs, win_raw, aux, n_new=n,
+                meta=dict(sess.meta(), points=n, seq=sess.seq + k + 1,
+                          points_total=sess.points_total + p0 + n,
+                          tail_points=len(win_recs),
+                          rebuilt=bool(ent["rebuild"])))
+
+        # commit the session (success only: a raised step never lands here)
+        sess.carry = carry_out
+        sess.records = tail_recs + new_recs
+        sess.replay = tail_raw + [
+            {"lat": p["lat"], "lon": p["lon"], "time": p["time"]}
+            for p in pts]
+        sess.trim(self.tail_points)
+        sess.seq += len(ent["subs"])
+        sess.points_total += len(pts)
+        C_SESSION_POINTS.inc(len(pts))
+        # placement check: a rebalance pop (or LRU eviction) may have
+        # removed this session mid-step — re-account just this step's
+        # points so the fleet ledger stays exact
+        self.store.finalize(sess, step_points=len(pts),
+                            step_subs=len(ent["subs"]))
+
+    def _render(self, sess: SessionState, win_recs, win_raw, aux,
+                n_new: int, meta: dict) -> dict:
+        """Associate one answer window into the wire match dict."""
+        m = self.matcher
+        n = len(win_recs)
+        seg_lists = self.associate(win_recs)
+        match: dict = {"segments": seg_lists}
+        match["_stream"] = {"trace": win_raw, "session": meta}
+        if getattr(m, "_quality_aux", False):
+            q: dict = {
+                "edge": [r[0] for r in win_recs],
+                "n_points": n,
+                "breaks": sum(1 for r in win_recs if r[2]),
+            }
+            if aux is not None:
+                mn, sm, nm, nx = (float(v) for v in aux)
+                q["margin_min"] = (round(mn, 4) if nm > 0 else None)
+                q["margin_mean"] = (round(sm / nm, 4) if nm > 0 else None)
+                q["pool_exhausted_frac"] = (round(nx / n, 4) if n else 0.0)
+            match["_quality"] = q
+        return match
+
+    def associate(self, recs) -> List[dict]:
+        """Wire-format association over a window of matched per-point
+        records — the same native batch walk (and arithmetic) the windowed
+        path runs, so identical per-point records render identical
+        segments by construction."""
+        from .assoc_native import associate_segments_batch
+
+        m = self.matcher
+        n = len(recs)
+        if n == 0:
+            return []
+        edge = np.asarray([[r[0] for r in recs]], np.int32)
+        offset = np.asarray([[r[1] for r in recs]], np.float32)
+        breaks = np.asarray([[r[2] for r in recs]], bool)
+        times = np.asarray([[r[3] for r in recs]], np.float64)
+        return associate_segments_batch(
+            m.arrays, m.ubodt, edge, offset, breaks, times, [n],
+            queue_thresh_mps=m.cfg.queue_speed_threshold_kph / 3.6,
+            back_tol=2.0 * m.cfg.sigma_z + 5.0,
+        )[0]
+
+    def degraded_step(self, cpu_matcher, trace: dict) -> dict:
+        """Degraded-mode parity (docs/robustness.md): answer a streaming
+        submit from the CPU oracle while the device is wedged.  The
+        session's replay buffer + the new points re-match as one windowed
+        trace; the carried beam is invalidated (rebuild-from-replay on the
+        next healthy step), so sessions SURVIVE a degradation window
+        instead of dying with the device."""
+        uuid = str(trace.get("uuid") or "")
+        pts = list(trace.get("trace") or ())
+        pkey = self.matcher._params_key(trace)
+        t_first = float(pts[0]["time"]) if pts else 0.0
+        self._lock.acquire()
+        try:
+            return self._degraded_step_locked(cpu_matcher, trace, uuid,
+                                              pts, pkey, t_first)
+        finally:
+            self._lock.release()
+
+    def _degraded_step_locked(self, cpu_matcher, trace, uuid, pts, pkey,
+                              t_first) -> dict:
+        sess = self.store.get_or_open(uuid, t_first, pkey)
+        win_raw = list(sess.replay) + [
+            {"lat": p["lat"], "lon": p["lon"], "time": p["time"]}
+            for p in pts]
+        if len(win_raw) >= 2:
+            match = cpu_matcher.match_many(
+                [{"uuid": uuid, "trace": win_raw}])[0]
+            match.pop("_quality", None)
+        else:
+            match = {"segments": []}
+        # commit: raw points recorded, matched records dropped (the cpu
+        # oracle's choices must not contaminate the bit-exact device
+        # chain), beam invalidated for a replay rebuild
+        sess.replay = win_raw
+        sess.records = []
+        sess.carry = None
+        sess.rebuild_pending = True
+        sess.trim(self.tail_points)
+        sess.seq += 1
+        sess.points_total += len(pts)
+        C_SESSION_POINTS.inc(len(pts))
+        self.store.finalize(sess, step_points=len(pts), step_subs=1)
+        match["_stream"] = {
+            "trace": win_raw,
+            "session": dict(sess.meta(), points=len(pts), degraded=True),
+        }
+        return match

@@ -593,6 +593,13 @@ def default_objectives() -> List[Objective]:
       REPORTER_SLO_P99_MS         p99 latency target ms  (default 2500)
       REPORTER_SLO_P999_MS        p99.9 target ms        (default 10000)
       REPORTER_SLO_DEGRADED_FRAC  max degraded fraction  (default 0.25)
+      REPORTER_SLO_STREAM_P99_MS  per-POINT p99 target ms for the
+                                  streaming session route
+                                  ("report_stream"; default 0 = off) —
+                                  the objective the session matcher's
+                                  point-latency win is gated against
+                                  (docs/performance.md "The session
+                                  matcher")
 
     A value <= 0 drops that objective."""
     out: List[Objective] = []
@@ -603,6 +610,10 @@ def default_objectives() -> List[Objective]:
     if p99 and p99 > 0:
         out.append(Objective("p99_latency", "latency", p99 / 1000.0,
                              quantile=0.99))
+    sp99 = _env_float("REPORTER_SLO_STREAM_P99_MS", 0.0)
+    if sp99 and sp99 > 0:
+        out.append(Objective("stream_p99_latency", "latency", sp99 / 1000.0,
+                             route="report_stream", quantile=0.99))
     p999 = _env_float("REPORTER_SLO_P999_MS", 10000.0)
     if p999 and p999 > 0:
         out.append(Objective("p999_latency", "latency", p999 / 1000.0,

@@ -834,6 +834,36 @@ def chain_batch_carry_packed_aux(dg: DeviceGraph, du: DeviceUBODT,
     return pack_compact(_compact(res)), res.aux, carry_out
 
 
+def session_step_packed(dg: DeviceGraph, du: DeviceUBODT, xin,
+                        p: MatchParams, k: int, carry: TraceCarry,
+                        kernel: str = "scan"):
+    """The per-vehicle session matcher's incremental step (ROADMAP item 2,
+    docs/performance.md "The session matcher"): fold the newly-arrived
+    points of B open sessions into ONE fixed-shape [B, W] dispatch.  Each
+    row is one session's delta (1..W points, contiguous valid prefix) and
+    its carried Viterbi beam; the first transition of every row runs from
+    that beam exactly like a long-trace chunk seam, so a stream of W=1
+    steps is the same recursion as one windowed decode — the carry-seam
+    differential suite pins the two bit-exact.
+
+    Same math as match_batch_carry_packed; the separate entry point exists
+    so the serving matcher caches it under its own (kind="session",
+    kernel) jit key and always keeps the confidence block live (the
+    streaming path is the ambiguity-sensitive one).  Returns
+    (packed [3, B, W], aux [B, 4], carry') — the carry pytree is fetched
+    to the pinned-host session store between steps ([B, K] floats, exact
+    f32 round trip), which is what makes a session serialisable for the
+    drain-time beam handoff."""
+    import functools
+
+    px, py, times, valid = unpack_inputs(xin)
+    fn = functools.partial(match_trace, kernel=kernel)
+    res, carry_out = jax.vmap(
+        fn, in_axes=(None, None, 0, 0, 0, 0, None, None, 0)
+    )(dg, du, px, py, times, valid, p, k, carry)
+    return pack_compact(_compact(res)), res.aux, carry_out
+
+
 def initial_carry_batch(b: int, k: int) -> TraceCarry:
     """Inactive carry for a batch of b traces."""
     one = TraceCarry.inactive(k)

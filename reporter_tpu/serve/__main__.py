@@ -98,6 +98,10 @@ def main(argv):
         max_wait_ms=float(batch.get("max_wait_ms", 10.0)),
         max_inflight=(int(batch["max_inflight"])
                       if "max_inflight" in batch else None),
+        # the streaming session batcher's fill knobs: a much shorter wait
+        # than the windowed batcher (point latency is the product)
+        session_max_batch=int(batch.get("session_max_batch", 256)),
+        session_wait_ms=float(batch.get("session_wait_ms", 2.0)),
         # fault-domain knobs (docs/robustness.md): bounded submit queue +
         # shedding, server deadline, device watchdog, poison quarantine,
         # degraded-mode re-attach probing; REPORTER_* env overrides apply
@@ -142,6 +146,11 @@ def main(argv):
         drain_grace = 30.0
     drained = threading.Event()
 
+    try:
+        drain_linger = float(os.environ.get("REPORTER_DRAIN_LINGER_S", 1.5))
+    except ValueError:
+        drain_linger = 1.5
+
     def _drain_then_stop():
         service.begin_drain()
         deadline = _time.monotonic() + max(0.0, drain_grace)
@@ -153,6 +162,19 @@ def main(argv):
             logging.warning(
                 "drain grace (%.1fs) expired with requests still inflight; "
                 "closing anyway", drain_grace)
+        # beam-handoff window (docs/serving-fleet.md): with open sessions,
+        # linger briefly after going idle so the router's prober can see
+        # the draining /health and pull GET /sessions?export=1 before the
+        # listener closes — exiting the instant inflight work finishes
+        # would race the handoff and force rebuild-from-replay on the
+        # inheriting replica.  Bounded by the remaining grace; 0 disables.
+        store = getattr(service, "session_store", None)
+        if store is not None and len(store) > 0 and drain_linger > 0:
+            linger_until = min(_time.monotonic() + drain_linger, deadline)
+            logging.info("drain: lingering up to %.1fs for session handoff "
+                         "(%d open sessions)", drain_linger, len(store))
+            while _time.monotonic() < linger_until:
+                _time.sleep(0.05)
         httpd.shutdown()
         # a request may have slipped past the last idle() sample while
         # the accept loop wound down: give it a moment to finish before
@@ -226,7 +248,12 @@ def main(argv):
                                 break
                             matcher.warmup(lengths=[n])
                         if not stop_warm.is_set():
-                            matcher.warmup(lengths=[], carry_chain=True)
+                            # the long-trace streaming programs AND the
+                            # per-vehicle session-step shapes: the first
+                            # streaming point of a fresh boot must not
+                            # compile inline (tests/test_warmup_cache.py)
+                            matcher.warmup(lengths=[], carry_chain=True,
+                                           session_step=True)
                     except Exception:
                         logging.exception(
                             "--warmup pass failed; serving with inline compiles")

@@ -92,7 +92,7 @@ from .service import _resolve_num
 log = logging.getLogger(__name__)
 
 ACTIONS = {"report", "trace_attributes_batch", "health", "metrics", "fleet",
-           "statusz", "traces", "slo", "attrib", "profile"}
+           "statusz", "traces", "slo", "attrib", "profile", "sessions"}
 
 # the router pins re-dispatched / hedged replica legs with this header so
 # the replica-side flight recorder retains its half of the trace for
@@ -150,6 +150,13 @@ C_REMAP = obs.counter(
     "reporter_router_affinity_remaps_total",
     "Requests routed off their rendezvous-primary replica because it "
     "was unavailable (the affinity disruption a replica loss causes)")
+C_HANDOFF = obs.counter(
+    "reporter_router_session_handoffs_total",
+    "Per-vehicle session beam handoffs driven by the router (drain "
+    "export -> inheriting-replica import, plus recovery rebalance), by "
+    "outcome (moved / skipped / rebalanced / no_target / export_failed "
+    "/ import_failed; docs/serving-fleet.md \"Beam handoff\")",
+    ("outcome",))
 
 
 def rendezvous_score(uuid: str, replica_url: str) -> int:
@@ -174,6 +181,14 @@ class Replica:
         self.fail_streak = 0                 # passive transport-error streak
         self.ejected_until = 0.0             # monotonic; passive ejection
         self.last_probe: Optional[dict] = None
+        # beam-handoff bookkeeping: one export/import sweep per drain
+        # transition, one rebalance per recovery (reset on the opposite
+        # transition so a replica that drains repeatedly hands off each
+        # time).  was_lost survives the warming hold-out (which resets
+        # state to init), so a respawned replica still counts as a
+        # RECOVERY — the rebalance must fire for it.
+        self.handoff_started = False
+        self.was_lost = False
 
     @property
     def label(self) -> str:
@@ -316,6 +331,23 @@ class FleetRouter:
         r.last_probe = {"status": status,
                         "state": info.get("status"),
                         "t": round(_time.time(), 3)}
+        if status == 200 and info.get("backend") is None \
+                and info.get("warming"):
+            # booted but the engine (and session store) is still
+            # attaching: every /report would 503 "service initialising",
+            # so the replica is NOT routable yet — hold it out of
+            # rotation without ejection bookkeeping.  The recovery
+            # transition (and its session rebalance) fires only once the
+            # backend is live, so rebalanced traffic never ping-pongs
+            # through a replica that cannot serve it.
+            r.probe_fail_streak = 0
+            r.probe_ok_streak = 0
+            if r.state == "healthy":
+                obs_log.event(log, "replica_warming", level=logging.WARNING,
+                              replica=r.label, url=r.url)
+            if r.state != "draining":
+                r.state = "init"
+            return
         if status == 200:
             r.probe_fail_streak = 0
             r.probe_ok_streak += 1
@@ -324,13 +356,25 @@ class FleetRouter:
                     or r.state in ("init", "draining")):
                 # draining -> 200 means a fresh process took the slot
                 # (rolling restart); trust it immediately like a boot
-                if r.state != "init":
+                recovered = r.state != "init" or r.was_lost
+                r.was_lost = False
+                if recovered:
                     obs_log.event(log, "replica_recovered",
                                   level=logging.WARNING, replica=r.label,
                                   url=r.url)
                 r.state = "healthy"
                 r.fail_streak = 0
                 r.ejected_until = 0.0
+                r.handoff_started = False
+                if recovered:
+                    # beam rebalance (docs/serving-fleet.md "Beam
+                    # handoff"): the fresh process inherits its vehicles
+                    # back by rendezvous rank but has no session state —
+                    # pull the sessions its vehicles parked on the other
+                    # replicas during the outage
+                    threading.Thread(
+                        target=self._rebalance_to, args=(r,), daemon=True,
+                        name="session-rebalance").start()
             elif r.state == "healthy":
                 r.fail_streak = 0
             return
@@ -340,7 +384,18 @@ class FleetRouter:
                 obs_log.event(log, "replica_draining", level=logging.WARNING,
                               replica=r.label, url=r.url)
             r.state = "draining"
+            r.was_lost = True
             r.probe_ok_streak = 0
+            if not r.handoff_started:
+                # drain-safe beam handoff: pull the drainer's serialised
+                # sessions while it finishes its inflight work and push
+                # each to the replica that now inherits its uuid — the
+                # vehicle's next point continues its decode bit-exact
+                # instead of restarting the HMM
+                r.handoff_started = True
+                threading.Thread(
+                    target=self._handoff_from, args=(r,), daemon=True,
+                    name="session-handoff").start()
             return
         self._probe_failed(r, "status %s (%s)" % (status, info.get("status")))
 
@@ -355,6 +410,7 @@ class FleetRouter:
                           replica=r.label, url=r.url, reason=why,
                           streak=r.probe_fail_streak)
             r.state = "unhealthy"
+            r.was_lost = True
 
     def _note_transport_failure(self, r: Replica) -> None:
         """Passive outlier ejection: consecutive transport errors on live
@@ -368,6 +424,194 @@ class FleetRouter:
                 obs_log.event(log, "replica_ejected", level=logging.ERROR,
                               replica=r.label, url=r.url,
                               eject_s=self.eject_s)
+
+    # -- beam handoff (docs/serving-fleet.md "Beam handoff") -----------------
+    #
+    # Rendezvous-hash affinity already pins a vehicle to one replica, so
+    # that replica's pinned-host session store is the natural home of its
+    # carried Viterbi beam.  When a replica exits deliberately (graceful
+    # drain) the router moves each of its serialised sessions to the
+    # replica that now inherits the uuid — the beam rides an exact-f32
+    # wire snapshot, so the vehicle's next point continues the decode
+    # bit-exact.  When a replica RETURNS (rolling restart, respawn after a
+    # kill), the reverse sweep pulls its vehicles' sessions back from
+    # wherever they parked.  A session that could not travel (export/
+    # import failure, or it raced a re-dispatched point) degrades to the
+    # rebuild-from-replay path on the inheriting side — continuity over a
+    # short replay instead of an HMM restart.
+
+    def _fetch_sessions(self, r: Replica) -> Optional[List[dict]]:
+        # bounded retries: the first pull after a drain begins routinely
+        # lands on a stale pooled keep-alive socket (the drainer closed
+        # its connections when admission shut), and ONE failed export
+        # would strand every beam on the dying replica
+        deadline = _time.monotonic() + 5.0
+        last_err: "Exception | None" = None
+        while _time.monotonic() < deadline:
+            try:
+                status, _hdrs, body = self.pool.request(
+                    "GET", r.url + "/sessions?export=1",
+                    timeout=self.request_timeout_s, target="replica")
+                if status != 200:
+                    raise RuntimeError("export status %d" % status)
+                return json.loads(body.decode("utf-8")).get("sessions") or []
+            except Exception as e:  # noqa: BLE001 - retried until deadline
+                last_err = e
+                if self._stop.wait(0.2):
+                    break
+        C_HANDOFF.labels("export_failed").inc()
+        obs_log.event(log, "session_export_failed",
+                      level=logging.WARNING, replica=r.label,
+                      error=str(last_err)[:200])
+        return None
+
+    def _import_sessions(self, target: Replica, wires: List[dict],
+                         outcome: str) -> int:
+        return self._import_sessions_tracked(target, wires, outcome)[0]
+
+    def _import_sessions_tracked(
+            self, target: Replica, wires: List[dict],
+            outcome: str) -> Tuple[int, List[str]]:
+        # a freshly-respawned target answers /health 200 while its engine
+        # (and session store) is still attaching, so the import retries
+        # through 503s for a bounded window instead of failing the handoff
+        # on the race
+        deadline = _time.monotonic() + 60.0
+        last_err: "Exception | None" = None
+        res = None
+        while _time.monotonic() < deadline:
+            try:
+                status, _hdrs, body = self.pool.request(
+                    "POST", target.url + "/sessions",
+                    body=json.dumps({"sessions": wires},
+                                    separators=(",", ":")).encode("utf-8"),
+                    headers={"Content-Type": "application/json"},
+                    timeout=self.request_timeout_s, target="replica")
+                if status == 503:
+                    raise RuntimeError("store not attached yet (503)")
+                if status != 200:
+                    raise RuntimeError("import status %d" % status)
+                res = json.loads(body.decode("utf-8"))
+                break
+            except Exception as e:  # noqa: BLE001 - retried until deadline
+                last_err = e
+                if self._stop.wait(1.0):
+                    break
+        if res is None:
+            C_HANDOFF.labels("import_failed").inc(len(wires))
+            obs_log.event(log, "session_import_failed",
+                          level=logging.WARNING, replica=target.label,
+                          n=len(wires), error=str(last_err)[:200])
+            return 0, []
+        moved = int(res.get("imported", 0)) + int(res.get("merged", 0))
+        C_HANDOFF.labels(outcome).inc(moved)
+        C_HANDOFF.labels("skipped").inc(int(res.get("skipped", 0)))
+        return moved, [str(u) for u in res.get("imported_uuids", ())]
+
+    def _handoff_from(self, r: Replica) -> None:
+        """Drain-time sweep: export the drainer's sessions, import each on
+        the replica its uuid now rendezvous-ranks to."""
+        wires = self._fetch_sessions(r)
+        if not wires:
+            return
+        groups: Dict[int, Tuple[Replica, List[dict]]] = {}
+        for w in wires:
+            uuid = str(w.get("uuid") or "")
+            order, _ = self.route_order(uuid)  # drainer already excluded
+            order = [x for x in order if x is not r]
+            if not order:
+                C_HANDOFF.labels("no_target").inc()
+                continue
+            groups.setdefault(id(order[0]), (order[0], []))[1].append(w)
+        moved = 0
+        for target, ws in groups.values():
+            moved += self._import_sessions(target, ws, "moved")
+        obs_log.event(log, "session_handoff", level=logging.WARNING,
+                      replica=r.label, exported=len(wires), moved=moved)
+
+    def _pop_sessions(self, src: Replica,
+                      uuids: List[str]) -> List[dict]:
+        """Atomically remove-and-fetch sessions from a source replica
+        (POST /sessions {"pop": [...]}) — export and delete in one locked
+        sweep, so no point can commit into a copy that is about to be
+        dropped (the export+delete TOCTOU a plain drop would have)."""
+        try:
+            status, _hdrs, body = self.pool.request(
+                "POST", src.url + "/sessions",
+                body=json.dumps({"pop": uuids},
+                                separators=(",", ":")).encode("utf-8"),
+                headers={"Content-Type": "application/json"},
+                timeout=self.request_timeout_s, target="replica")
+            if status != 200:
+                raise RuntimeError("pop status %d" % status)
+            return json.loads(body.decode("utf-8")).get("sessions") or []
+        except Exception as e:  # noqa: BLE001 - nothing moved, nothing lost
+            C_HANDOFF.labels("export_failed").inc()
+            obs_log.event(log, "session_pop_failed",
+                          level=logging.WARNING, replica=src.label,
+                          error=str(e)[:200])
+            return []
+
+    def _rebalance_to(self, r: Replica) -> None:
+        """Recovery sweep: move the recovered replica's vehicles' sessions
+        back from the replicas they parked on — an atomic POP at each
+        source (so late in-flight commits re-account themselves instead
+        of riding a doomed copy) followed by a merge-capable import at
+        the recovered replica.  If the import ultimately fails, the
+        popped payload is re-imported at its source so no beam (or ledger
+        count) is ever stranded in flight."""
+        total = 0
+        for src in self.replicas:
+            if src is r or not src.available():
+                continue
+            wires = self._fetch_sessions(src)
+            if not wires:
+                continue
+            mine = []
+            for w in wires:
+                order, _ = self.route_order(str(w.get("uuid") or ""))
+                if order and order[0] is r:
+                    mine.append(str(w.get("uuid")))
+            if not mine:
+                continue
+            popped = self._pop_sessions(src, mine)
+            if not popped:
+                continue
+            moved, _uuids = self._import_sessions_tracked(
+                r, popped, "rebalanced")
+            total += moved
+            if not moved:
+                # land the popped beams back home (merge-capable): better
+                # a stale copy than a lost one
+                self._import_sessions_tracked(src, popped, "moved")
+        if total:
+            obs_log.event(log, "session_rebalance", level=logging.WARNING,
+                          replica=r.label, moved=total)
+
+    def handle_sessions(self, query: dict) -> Tuple[int, dict]:
+        """Router ``GET /sessions``: the fleet's session plane on one
+        screen — per-replica store summaries plus fleet totals (the
+        rehearsal's zero-lost/zero-duplicated accounting reads this)."""
+        fleet: Dict[str, dict] = {}
+        sessions = points = 0
+        for r in self.replicas:
+            try:
+                status, _hdrs, body = self.pool.request(
+                    "GET", r.url + "/sessions",
+                    timeout=self.probe_timeout_s, target="replica")
+                info = json.loads(body.decode("utf-8"))
+                if status != 200:
+                    raise RuntimeError(info.get("error") or status)
+            except Exception as e:  # noqa: BLE001 - a dead replica is data
+                fleet[r.label] = {"error": str(e)[:200]}
+                continue
+            fleet[r.label] = {"sessions": info.get("sessions"),
+                              "points_total": info.get("points_total"),
+                              "draining": info.get("draining")}
+            sessions += int(info.get("sessions") or 0)
+            points += int(info.get("points_total") or 0)
+        return 200, {"scope": "fleet", "sessions": sessions,
+                     "points_total": points, "replicas": fleet}
 
     # -- routing ------------------------------------------------------------
 
@@ -885,6 +1129,15 @@ class FleetRouter:
             def _proxy(self, endpoint: str, payload_bytes: bytes,
                        uuid: str):
                 t0 = _time.monotonic()
+                # fleet-SLO route: streaming session submits classify
+                # under "report_stream" like they do replica-side, so the
+                # per-POINT latency objective is a fleet objective too
+                # (best-effort sniff; both compact and spaced JSON forms)
+                slo_route = endpoint
+                if endpoint == "report" and (
+                        b'"stream":true' in payload_bytes
+                        or b'"stream": true' in payload_bytes):
+                    slo_route = "report_stream"
                 # the router's own hop span: admission, ranking, every
                 # dispatch attempt, total router residency — recorded
                 # into the router-side flight recorder under the SAME
@@ -900,7 +1153,7 @@ class FleetRouter:
                     C_REQS.labels(endpoint, "shed").inc()
                     span.fail("router saturated", status="shed")
                     span.finish()
-                    router.slo.observe(endpoint, 429, span.total_s,
+                    router.slo.observe(slo_route, 429, span.total_s,
                                        trace_id=span.trace_id)
                     obs_flight.record(span)
                     return self._answer(
@@ -936,7 +1189,7 @@ class FleetRouter:
                     # already absorbed (a failed-over 200 is fleet-good).
                     # degraded rides the replica's own response body.
                     router.slo.observe(
-                        endpoint, status, span.total_s,
+                        slo_route, status, span.total_s,
                         degraded=b'"degraded":true' in (rbody or b""),
                         trace_id=span.trace_id)
                     # multi-attempt / hedged spans are pinned: the
@@ -973,6 +1226,8 @@ class FleetRouter:
                         return self._answer(*router.fleet())
                     if action == "statusz":
                         return self._answer(*router.fleet_statusz())
+                    if action == "sessions":
+                        return self._answer(*router.handle_sessions(query))
                     if action == "traces":
                         return self._answer(*router.handle_traces(query))
                     if action == "slo":

@@ -41,6 +41,7 @@ from urllib.parse import parse_qs, urlsplit
 
 from .. import faults
 from ..matching import MatcherConfig, SegmentMatcher
+from ..matching.session import SessionEngine, SessionStore
 from ..obs import flight as obs_flight
 from ..obs import log as obs_log
 from ..obs import metrics as obs
@@ -53,7 +54,7 @@ from ..tiles.network import RoadNetwork, grid_city
 
 log = logging.getLogger(__name__)
 
-ACTIONS = {"report", "trace_attributes_batch", "health",
+ACTIONS = {"report", "trace_attributes_batch", "health", "sessions",
            "metrics", "statusz", "profile", "traces", "attrib", "slo"}
 
 
@@ -681,6 +682,8 @@ class ReporterService:
         robustness: Optional[dict] = None,
         slo: Optional[dict] = None,
         quality: Optional[dict] = None,
+        session_max_batch: int = 256,
+        session_wait_ms: float = 2.0,
     ):
         """``matcher=None`` defers the engine: the HTTP socket can bind and
         /health can answer before the accelerator backend is even
@@ -705,6 +708,15 @@ class ReporterService:
         engine another embedder already configured in-process."""
         self._batch_params = dict(max_batch=max_batch, max_wait_ms=max_wait_ms,
                                   max_inflight=max_inflight)
+        # streaming session submits batch on their OWN MicroBatcher with a
+        # much shorter fill window: the whole point of a session is point
+        # latency, so the batcher only aggregates steps that are already
+        # concurrently in flight (REPORTER_SESSION_WAIT_MS overrides)
+        self._session_params = dict(
+            max_batch=max(1, int(_resolve_num(
+                "REPORTER_SESSION_MAX_BATCH", session_max_batch, 256))),
+            max_wait_ms=_resolve_num(
+                "REPORTER_SESSION_WAIT_MS", session_wait_ms, 2.0))
         rb = dict(robustness or {})
         self._reattach_probe_s = _resolve_num(
             "REPORTER_REATTACH_PROBE_S", rb.pop("reattach_probe_s", None),
@@ -728,6 +740,14 @@ class ReporterService:
         self._threshold_arg = threshold_sec
         self.matcher = None
         self.batcher = None
+        # the per-vehicle session plane (docs/performance.md "The session
+        # matcher"): the store and engine build at attach time; streaming
+        # /report submits ("stream": true) run through session_batcher,
+        # whose MicroBatcher machinery gives them the same fault domains
+        # as windowed traffic (docs/robustness.md)
+        self.session_store: Optional[SessionStore] = None
+        self.session_engine: Optional[SessionEngine] = None
+        self.session_batcher: Optional[MicroBatcher] = None
         self.threshold_sec = None
         # degraded mode: after a device watchdog trip the engine is
         # detached and requests are answered by the CPU oracle with
@@ -804,6 +824,17 @@ class ReporterService:
         self.threshold_sec = int(threshold)
         self.matcher = matcher
         self.batcher = self._make_batcher(matcher)
+        # session plane: the store survives matcher/batcher swaps (carries
+        # live pinned-host), so a degraded window or re-attach never drops
+        # an open session
+        if self.session_store is None:
+            self.session_store = SessionStore(
+                max_sessions=int(getattr(matcher.cfg, "max_sessions", 65536)),
+                ttl_s=float(getattr(matcher.cfg, "session_ttl_s", 3600.0)))
+        self.session_engine = SessionEngine(
+            matcher, self.session_store,
+            tail_points=int(getattr(matcher.cfg, "session_tail_points", 64)))
+        self.session_batcher = self._make_session_batcher()
         try:
             self.quality = obs_quality.configure(matcher, self._quality_spec)
         except Exception:  # noqa: BLE001 - diagnostics must not block boot
@@ -815,6 +846,15 @@ class ReporterService:
             matcher, **self._batch_params, **self._robust_params,
             on_wedged=self._enter_degraded, on_crashed=self._note_crash)
 
+    def _make_session_batcher(self) -> MicroBatcher:
+        """The streaming twin: same fault-domain machinery (bounded queue
+        + shedding, deadlines, watchdog, poison bisect quarantine, crash-
+        loud loops) over the SessionEngine instead of the raw matcher."""
+        return MicroBatcher(
+            self.session_engine, **self._session_params,
+            **self._robust_params,
+            on_wedged=self._enter_degraded, on_crashed=self._note_crash)
+
     # -- fault domains: degraded mode + re-attach --------------------------
 
     def _note_crash(self, who: str, e: BaseException) -> None:
@@ -822,6 +862,10 @@ class ReporterService:
         orchestrator restarts this replica (a crashed batcher is a bug,
         not a device fault — no CPU fallback, fail loud)."""
         self.unhealthy_reason = "batcher %s thread died: %s" % (who, e)
+        if self.session_engine is not None:
+            # in-flight session steps had their futures failed: their late
+            # finishes must not commit (zero-duplication contract)
+            self.session_engine.invalidate_inflight()
 
     def _enter_degraded(self, reason: str) -> None:
         """Device watchdog trip: detach the engine, serve from the CPU
@@ -831,6 +875,11 @@ class ReporterService:
             if self.degraded:
                 return
             self.degraded = True
+        if self.session_engine is not None:
+            # a wedged device step may WAKE long after its futures were
+            # failed: bump the engine generation FIRST so the late finish
+            # commits nothing — the degraded path re-applies the points
+            self.session_engine.invalidate_inflight()
         G_DEGRADED.set(1)
         obs_log.event(log, "degraded_enter", level=logging.ERROR,
                       reason=reason)
@@ -898,6 +947,11 @@ class ReporterService:
 
     def _reattach(self) -> None:
         self.batcher = self._make_batcher(self.matcher)
+        if self.session_engine is not None:
+            # fresh batcher over the SAME engine/store: open sessions kept
+            # their replay buffers through the degraded window and rebuild
+            # their beams on the next healthy step
+            self.session_batcher = self._make_session_batcher()
         with self._degraded_lock:
             self.degraded = False
         G_DEGRADED.set(0)
@@ -951,11 +1005,14 @@ class ReporterService:
         return q
 
     def validate(self, trace: dict) -> Tuple[Optional[str], Optional[set], Optional[set]]:
-        """Returns (error, report_levels, transition_levels)."""
+        """Returns (error, report_levels, transition_levels).  A streaming
+        submit (``"stream": true``) may carry a SINGLE point — the session
+        provides the rest of the shape; windowed requests keep the
+        reference's >= 2-point contract."""
         if trace.get("uuid") is None:
             return "uuid is required", None, None
         try:
-            trace["trace"][1]
+            trace["trace"][0 if trace.get("stream") else 1]
         except Exception:
             return (
                 "trace must be a non zero length array of object each of which must "
@@ -1002,20 +1059,27 @@ class ReporterService:
         # outcome is offered to the flight recorder regardless.
         # ``deadline`` is the absolute monotonic bound parsed from
         # X-Reporter-Deadline-Ms at ingestion (None -> server default).
-        span = obs_trace.current_span() or Span("report")
-        span.meta.setdefault("endpoint", "report")
+        # streaming session submits ("stream": true) are the SAME wire
+        # endpoint but their own route: they batch on the session
+        # MicroBatcher (point latency, not window fill) and their terminal
+        # outcomes classify under "report_stream" so the per-point-latency
+        # SLO objective can gate them separately (docs/http-api.md)
+        stream = isinstance(trace, dict) and bool(trace.get("stream"))
+        route = "report_stream" if stream else "report"
+        span = obs_trace.current_span() or Span(route)
+        span.meta.setdefault("endpoint", route)
         if isinstance(trace, dict) and trace.get("uuid") is not None:
             span.meta.setdefault("uuid", str(trace["uuid"])[:64])
         if self.draining:
             C_DRAIN_REFUSED.inc()
             span.fail("draining", status="draining")
-            self._terminal("report", 503, span)
+            self._terminal(route, 503, span)
             return 503, {"error": "draining", "status": "draining",
                          "retry_after": 1}
-        batcher = self.batcher
+        batcher = self.session_batcher if stream else self.batcher
         if batcher is None:
             span.fail("service initialising", status="unavailable")
-            self._terminal("report", 503, span)
+            self._terminal(route, 503, span)
             return 503, {"error": "service initialising", "retry_after": 1}
         # chaos seam: an injected admission shed — the canonical
         # failover-MASKED failure (the replica burns its own SLO budget
@@ -1023,19 +1087,19 @@ class ReporterService:
         # 200; the fleet masking-debt gauge must bill the difference)
         if faults.fire("replica_shed") is not None:
             span.fail("injected admission shed", status="shed")
-            self._terminal("report", 429, span)
-            C_REQUESTS.labels("report", "shed").inc()
+            self._terminal(route, 429, span)
+            C_REQUESTS.labels(route, "shed").inc()
             return 429, {"error": "injected admission shed",
                          "retry_after": 1}
         err, rl, tl = self.validate(trace)
         if err:
-            C_REQUESTS.labels("report", "invalid").inc()
+            C_REQUESTS.labels(route, "invalid").inc()
             span.fail(err, status="invalid")
-            self._terminal("report", 400, span)
+            self._terminal(route, 400, span)
             return 400, {"error": err}
         if self.degraded:
             return self._finish_report(trace, rl, tl, span, debug,
-                                       degraded=True)
+                                       degraded=True, route=route)
         try:
             # deadline is forwarded only when the request set one (stub and
             # embedder batchers keep their two-arg match contract); the
@@ -1045,58 +1109,84 @@ class ReporterService:
                 match = batcher.match(trace, span=span, **mkw)
         except Overloaded as e:
             span.fail(e, status="shed")
-            self._terminal("report", 429, span)
-            C_REQUESTS.labels("report", "shed").inc()
+            self._terminal(route, 429, span)
+            C_REQUESTS.labels(route, "shed").inc()
             return 429, {"error": str(e),
                          "retry_after": batcher.retry_after_s()}
         except DeadlineExpired as e:
             span.fail(e, status="expired")
-            self._terminal("report", 504, span)
-            C_REQUESTS.labels("report", "expired").inc()
+            self._terminal(route, 504, span)
+            C_REQUESTS.labels(route, "expired").inc()
             return 504, {"error": str(e)}
         except TraceQuarantined as e:
             span.fail(e, status="quarantined")
-            self._terminal("report", 422, span)
-            C_REQUESTS.labels("report", "quarantined").inc()
+            self._terminal(route, 422, span)
+            C_REQUESTS.labels(route, "quarantined").inc()
             return 422, {"error": str(e)}
         except (DeviceWedged, BatcherCrashed) as e:
             if self.degraded:
                 # raced the watchdog trip: answer from the CPU fallback
                 return self._finish_report(trace, rl, tl, span, debug,
-                                           degraded=True)
+                                           degraded=True, route=route)
             span.fail(e, status="unavailable")
-            self._terminal("report", 503, span)
+            self._terminal(route, 503, span)
             self._count(ok=False)
-            C_REQUESTS.labels("report", "error").inc()
+            C_REQUESTS.labels(route, "error").inc()
             return 503, {"error": str(e), "retry_after": 1}
         except Exception as e:
             log.exception("match failed")
             span.fail(e)
-            self._terminal("report", 500, span)
+            self._terminal(route, 500, span)
             self._count(ok=False)
-            C_REQUESTS.labels("report", "error").inc()
+            C_REQUESTS.labels(route, "error").inc()
             return 500, {"error": str(e)}
-        return self._finish_report(trace, rl, tl, span, debug, match=match)
+        return self._finish_report(trace, rl, tl, span, debug, match=match,
+                                   route=route)
 
     def _finish_report(self, trace, rl, tl, span, debug,
                        match: Optional[dict] = None,
-                       degraded: bool = False) -> Tuple[int, dict]:
+                       degraded: bool = False,
+                       route: str = "report") -> Tuple[int, dict]:
         """Render the report (matching first via the CPU fallback on the
-        degraded path); degraded answers carry ``"degraded": true``."""
+        degraded path); degraded answers carry ``"degraded": true``.  A
+        streaming answer (route "report_stream") renders over the
+        session's accumulated window — the rolling tail + the new points
+        — exactly the incremental shape the reference's threshold/
+        shape_used contract expects, and carries a ``"session"`` block."""
+        stream = route == "report_stream"
         try:
             with obs_trace.bind(span):
                 if degraded:
                     m = self._cpu_fallback()
                     t_m = _time.monotonic()
                     with self._cpu_lock:
-                        match = m.match_many([trace])[0]
+                        if stream:
+                            # sessions SURVIVE the degraded window: the cpu
+                            # oracle answers over replay + new points and
+                            # the beam rebuilds on the next healthy step
+                            match = self.session_engine.degraded_step(
+                                m, trace)
+                        else:
+                            match = m.match_many([trace])[0]
                     span.mark("cpu_fallback_s", _time.monotonic() - t_m)
-                quality = self._note_quality(trace, match, span)
+                st = match.pop("_stream", None) if isinstance(match, dict) \
+                    else None
+                render_trace = trace
+                if st is not None:
+                    # the answer window: session tail + this step's points
+                    render_trace = {
+                        "uuid": trace.get("uuid"), "trace": st["trace"],
+                        "match_options": trace.get("match_options") or {}}
+                quality = self._note_quality(render_trace, match, span)
                 t_rep = _time.monotonic()
-                data = report_fn(match, trace, self.threshold_sec, rl, tl,
-                                 mode=trace.get("match_options", {}).get("mode", "auto"))
+                data = report_fn(match, render_trace, self.threshold_sec,
+                                 rl, tl,
+                                 mode=(trace.get("match_options") or {})
+                                 .get("mode", "auto"))
             span.mark("report_fn_s", _time.monotonic() - t_rep)
             span.finish()
+            if st is not None:
+                data["session"] = st["session"]
             if degraded:
                 data["degraded"] = True
                 span.meta["degraded"] = True
@@ -1113,18 +1203,18 @@ class ReporterService:
                     data["debug"]["match_options"] = (
                         m_.effective_match_options(
                             trace.get("match_options") or {}))
-            self._terminal("report", 200, span, degraded=degraded)
+            self._terminal(route, 200, span, degraded=degraded)
             self._count(ok=True)
             C_REQUESTS.labels(
-                "report", "degraded" if degraded else "ok").inc()
+                route, "degraded" if degraded else "ok").inc()
             return 200, data
         except Exception as e:
             log.exception("match failed")
             span.fail(e)
             code = 503 if isinstance(e, (DeviceWedged, BatcherCrashed)) else 500
-            self._terminal("report", code, span)
+            self._terminal(route, code, span)
             self._count(ok=False)
-            C_REQUESTS.labels("report", "error").inc()
+            C_REQUESTS.labels(route, "error").inc()
             out = {"error": str(e)}
             if code == 503:
                 out["retry_after"] = 1
@@ -1194,6 +1284,75 @@ class ReporterService:
             "requests": self._n_requests,
             "errors": self._n_errors,
         }
+
+    def handle_sessions(self, query: dict,
+                        body: Optional[dict] = None) -> Tuple[int, dict]:
+        """The session-store ops surface (docs/http-api.md, docs/
+        serving-fleet.md "Beam handoff"):
+
+          GET  /sessions              store summary (count, points)
+          GET  /sessions?uuid=U       one session's meta (404 if absent)
+          GET  /sessions?export=1     summary + every live session's wire
+                                      snapshot — the drain-time handoff
+                                      payload the router pulls
+          POST /sessions {"sessions": [...]}
+                                      import handed-off sessions; a uuid
+                                      already live locally wins over the
+                                      import (a racing re-dispatch has
+                                      newer points), beam-less payloads
+                                      rebuild from replay on their next
+                                      step
+        """
+        store = self.session_store
+        if store is None:
+            return 503, {"error": "service initialising", "retry_after": 1}
+        if body is not None:
+            drop = body.get("drop")
+            if drop is not None:
+                if not isinstance(drop, list):
+                    return 400, {"error": "drop must be an array of uuids"}
+                dropped = sum(1 for u in drop if store.drop(str(u)))
+                return 200, {"dropped": dropped,
+                             "replica": self.replica_id}
+            pop = body.get("pop")
+            if pop is not None:
+                # atomic remove-and-serialise: the recovery rebalance's
+                # exact transfer (export + delete in one locked sweep)
+                if not isinstance(pop, list):
+                    return 400, {"error": "pop must be an array of uuids"}
+                wires = store.pop_wire(pop)
+                return 200, {"sessions": wires,
+                             "replica": self.replica_id}
+            wires = body.get("sessions")
+            if not isinstance(wires, list):
+                return 400, {"error": "sessions must be an array"}
+            res = store.import_wire(wires)
+            obs_log.event(log, "sessions_imported", replica=self.replica_id,
+                          imported=res["imported"], merged=res["merged"],
+                          skipped=res["skipped"],
+                          rebuild_pending=res["rebuild_pending"])
+            return 200, dict(res, replica=self.replica_id)
+        uuid = (query.get("uuid") or [None])[0]
+        if uuid:
+            s = store.peek(str(uuid))
+            if s is None:
+                return 404, {"error": "no session for uuid %r" % uuid}
+            return 200, dict(s.meta(), replica=self.replica_id)
+        if query.get("export", ["0"])[0] not in ("", "0", "false"):
+            if self.draining:
+                # the handoff race: steps admitted before drain-begin may
+                # still be committing — snapshot only once the report
+                # handlers have gone idle (bounded), so the exported beams
+                # carry every answered point
+                deadline = _time.monotonic() + 2.0
+                while not self.idle() and _time.monotonic() < deadline:
+                    _time.sleep(0.02)
+            out = dict(store.summary(), replica=self.replica_id,
+                       draining=bool(self.draining))
+            out["sessions"] = store.export_all()
+            return 200, out
+        return 200, dict(store.summary(), replica=self.replica_id,
+                         draining=bool(self.draining))
 
     def handle_batch(self, body: dict,
                      deadline: Optional[float] = None) -> Tuple[int, dict]:
@@ -1337,6 +1496,9 @@ class ReporterService:
             # (None until a quality engine is configured)
             "quality": (self.quality.summary()
                         if self.quality is not None else None),
+            # the session plane: open per-vehicle sessions + folded points
+            "sessions": (self.session_store.summary()
+                         if self.session_store is not None else None),
             "metrics": obs.REGISTRY.snapshot(),
         }
 
@@ -1591,6 +1753,28 @@ class ReporterService:
                                        if action == "profile"
                                        else service.handle_attrib)
                             return self._answer(*handler(query))
+                    if action == "sessions":
+                        # GET /sessions[?export=1|uuid=U] | POST /sessions
+                        # {"sessions": [...]} — the beam-handoff surface;
+                        # export stays answerable DURING drain (that is
+                        # when the router pulls it)
+                        if post:
+                            n = self._content_length()
+                            if n is None:
+                                return self._answer(
+                                    400, {"error": "invalid Content-Length"})
+                            try:
+                                body = json.loads(
+                                    self.rfile.read(n).decode("utf-8"))
+                            except Exception as e:  # noqa: BLE001
+                                return self._answer(400, {"error": str(e)})
+                            if not isinstance(body, dict):
+                                return self._answer(
+                                    400, {"error": "request body must be a "
+                                          "json object"})
+                            return self._answer(
+                                *service.handle_sessions(query, body))
+                        return self._answer(*service.handle_sessions(query))
                     if action == "traces":  # GET /debug/traces?n=K
                         self._drain_body(post)
                         return self._answer(*service.handle_traces(query))

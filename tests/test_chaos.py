@@ -552,3 +552,134 @@ def test_gather_worker_death_requeues_unfinished_shard(tmp_path):
     assert len([l for l in gathered if l.startswith("veh-b")]) == 6
     assert len([l for l in gathered if l.startswith("KILLME")]) == 1
     assert pipeline.C_REQUEUED.labels("gather").value >= before + 1
+
+
+# -- streaming session parity (docs/robustness.md; ISSUE 12 satellite) -------
+
+
+def test_poisoned_session_fails_alone_then_quarantines(engine, serve_factory,
+                                                       monkeypatch):
+    """The streaming path inherits the poison bisect quarantine: an armed
+    dispatch fault keyed on one vehicle's uuid fails ONLY that vehicle's
+    session step while every co-batched session answers normally, and the
+    repeat offender is rejected 422 at admission."""
+    arrays, _matcher = engine
+    monkeypatch.setenv("REPORTER_FAULT_DISPATCH", "uuid:poison-veh")
+    s = serve_factory(max_wait_ms=5.0, session_wait_ms=150.0,
+                      robustness=dict(watchdog_s=0, quarantine_after=2,
+                                      quarantine_ttl_s=300.0))
+
+    def stream_round(pt_idx):
+        results = {}
+
+        def hit(i, uuid):
+            tr = street_trace(arrays, row=i % 4, uuid=uuid)
+            body = dict(tr, stream=True, trace=[tr["trace"][pt_idx]])
+            results[uuid] = post_json(s.url + "/report", body)
+
+        uuids = ["sveh-%d" % i for i in range(5)] + ["poison-veh"]
+        threads = [threading.Thread(target=hit, args=(i, u))
+                   for i, u in enumerate(uuids)]
+        [t.start() for t in threads]
+        [t.join() for t in threads]
+        return results
+
+    results = stream_round(0)
+    code, out, _ = results["poison-veh"]
+    assert code == 500 and "failed its device batch alone" in out["error"]
+    for u in ("sveh-%d" % i for i in range(5)):
+        code, out, _ = results[u]
+        assert code == 200, (u, out)
+        assert out["session"]["points_total"] == 1
+
+    # second isolation crosses quarantine_after=2 ...
+    results = stream_round(1)
+    assert results["poison-veh"][0] == 500
+    for u in ("sveh-%d" % i for i in range(5)):
+        assert results[u][0] == 200
+        assert results[u][1]["session"]["points_total"] == 2
+
+    # ... and the third streaming submit is rejected AT ADMISSION while
+    # innocent sessions keep streaming
+    tr = street_trace(arrays, uuid="poison-veh")
+    code, out, _ = post_json(
+        s.url + "/report", dict(tr, stream=True, trace=[tr["trace"][2]]))
+    assert code == 422 and "quarantined" in out["error"]
+    tr = street_trace(arrays, uuid="sveh-0")
+    code, out, _ = post_json(
+        s.url + "/report", dict(tr, stream=True, trace=[tr["trace"][2]]))
+    assert code == 200 and out["session"]["points_total"] == 3
+
+
+def test_streaming_degraded_answering_and_rebuild(engine, serve_factory,
+                                                  monkeypatch):
+    """Degraded CPU-oracle answering applies to session submits too: a
+    wedged device step flips the service degraded, streaming answers keep
+    flowing from the cpu oracle (carrying degraded:true AND the session
+    block), and after re-attach the session REBUILDS its beam from the
+    replay buffer instead of restarting — no point is ever lost from the
+    ledger."""
+    arrays, _matcher = engine
+    monkeypatch.setenv("REPORTER_FAULT_DEVICE_HANG", "2.5")
+    s = serve_factory(max_wait_ms=5.0, session_wait_ms=1.0,
+                      robustness=dict(watchdog_s=0.4, reattach_probe_s=0.25))
+    tr = street_trace(arrays, uuid="deg-veh")
+
+    # the submit that hits the wedged step answers degraded via the oracle
+    code, out, _ = post_json(
+        s.url + "/report", dict(tr, stream=True, trace=tr["trace"][:1]))
+    assert code == 200, out
+    assert out.get("degraded") is True
+    assert out["session"]["points_total"] == 1
+
+    # the session keeps absorbing points through the degraded window
+    for i in (1, 2, 3):
+        code, out, _ = post_json(
+            s.url + "/report",
+            dict(tr, stream=True, trace=[tr["trace"][i]]))
+        assert code == 200 and out.get("degraded") is True, out
+    assert out["session"]["points_total"] == 4
+    sess = s.svc.session_store.peek("deg-veh")
+    assert sess.rebuild_pending and sess.carry is None
+
+    # fault clears -> re-attach -> the next step rebuilds from replay
+    monkeypatch.delenv("REPORTER_FAULT_DEVICE_HANG")
+    faults.reset()
+    deadline = time.monotonic() + 20.0
+    while s.svc.degraded and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert not s.svc.degraded, "engine did not re-attach"
+    code, out, _ = post_json(
+        s.url + "/report", dict(tr, stream=True, trace=[tr["trace"][4]]))
+    assert code == 200 and "degraded" not in out, out
+    assert out["session"]["points_total"] == 5
+    assert out["session"]["rebuilt"] is True
+    sess = s.svc.session_store.peek("deg-veh")
+    assert not sess.rebuild_pending and sess.carry is not None
+    # the rebuilt decode equals the windowed decode of the full history
+    # (the rebuild IS a windowed re-match of replay + new)
+    assert out["datastore"] == post_json(
+        s.url + "/report", dict(tr, uuid="ref-w",
+                                trace=tr["trace"][:5]))[1]["datastore"]
+
+
+def test_streaming_deadline_expires_in_queue(engine, serve_factory):
+    """Deadline parity: a streaming submit whose budget dies in the
+    session queue answers 504 before wasting a device slot — the SAME
+    scrub-before-dispatch the windowed batcher runs."""
+    arrays, _matcher = engine
+    s = serve_factory(max_wait_ms=5.0, session_wait_ms=1.0,
+                      robustness=dict(watchdog_s=0))
+    tr = street_trace(arrays, uuid="dl-veh")
+    # an exhausted budget at ingestion expires during batch formation
+    code, out, _ = post_json(
+        s.url + "/report",
+        dict(tr, stream=True, trace=[tr["trace"][0]]),
+        headers={"X-Reporter-Deadline-Ms": "0"})
+    assert code == 504 and "deadline expired" in out["error"]
+    # a live budget flows normally, and NO session state was mutated by
+    # the expired submit (its point never reached a device slot)
+    code, out, _ = post_json(
+        s.url + "/report",
+        dict(tr, stream=True, trace=[tr["trace"][0]]))
+    assert code == 200 and out["session"]["points_total"] == 1

@@ -336,3 +336,92 @@ def test_main_rc_nonzero_on_slo_violation(loadgen, tmp_path):
     art = json.loads(out.read_text())
     assert art["slo"]["client"]["ok"] is False
     assert art["status"].get("500")
+
+
+# -- streaming scenario (docs/performance.md "The session matcher") ---------
+
+
+def test_stream_points_per_point_corpus(loadgen):
+    sessions = [("a", [{"uuid": "a", "trace": [{"t": i} for i in range(4)]}]),
+                ("b", [{"uuid": "b", "trace": [{"t": i} for i in range(2)]}])]
+    pts = loadgen.stream_points(sessions)
+    assert len(pts) == 6
+    assert all(r["stream"] is True and len(r["trace"]) == 1 for r in pts)
+    for uuid, n in (("a", 4), ("b", 2)):
+        ts = [r["trace"][0]["t"] for r in pts if r["uuid"] == uuid]
+        assert ts == list(range(n)), "per-uuid point order broken"
+
+
+def test_fold_stream_windows_per_point_scheds(loadgen):
+    """The windowed-rebatch baseline: requests fold per-uuid at the SAME
+    per-point schedule, each point keeping its own arrival slot in
+    _scheds, windows sent at their LAST point's slot, <2-point tails
+    dropped and counted."""
+    pts = []
+    sched = []
+    for k in range(5):  # a:3 points then a:2 more; b:2 points total
+        for uuid in ("a", "b")[: 2 if k < 2 else 1]:
+            pts.append({"uuid": uuid, "stream": True,
+                        "trace": [{"t": k}],
+                        "match_options": {}})
+            sched.append(0.1 * len(sched))
+    reqs, out_sched, dropped = loadgen.fold_stream_windows(pts, sched, 2)
+    # a had 5 points -> two 2-windows + 1 dropped tail; b had 2 -> one
+    assert dropped == 1
+    assert len(reqs) == 3 and out_sched == sorted(out_sched)
+    for r, s in zip(reqs, out_sched):
+        assert "stream" not in r  # the baseline is the CLASSIC windowed path
+        assert len(r["trace"]) == 2
+        assert len(r["_scheds"]) == 2
+        assert s == r["_scheds"][-1]  # sent at the last point's slot
+
+
+def test_main_stream_scenario_per_point_samples(loadgen, tmp_path):
+    """--stream end to end against the stub: every POINT lands as one
+    sample (stream mode) and the artifact carries the stream block +
+    scenario-specific metric name."""
+    stub = _Stub()
+    out = tmp_path / "stream.json"
+    try:
+        rc = loadgen.main([
+            "--url", stub.url, "--stream", "--rate", "60",
+            "--duration", "0.4", "--vehicles", "2", "--points", "6",
+            "--window", "6", "--grid", "5", "--seed", "3",
+            "--concurrency", "8", "--slo-availability", "0.5",
+            "--slo-p99-ms", "60000", "--out", str(out),
+        ])
+    finally:
+        stub.close()
+    assert rc == 0
+    art = json.loads(out.read_text())
+    assert art["mode"] == "stream"
+    assert art["metric"] == "loadgen_stream_p99_latency"
+    assert art["stream"] == {"window": 1, "points": art["requests"],
+                             "points_dropped_tail": 0}
+    # one HTTP request per point: the stub counted exactly the samples
+    assert stub.count == art["requests"]
+
+    # the windowed-rebatch baseline: HTTP requests fold ~window-fold but
+    # SAMPLES stay per-point, so the quantiles compare like with like
+    stub2 = _Stub()
+    out2 = tmp_path / "windowed.json"
+    try:
+        rc = loadgen.main([
+            "--url", stub2.url, "--stream", "--stream-window", "3",
+            "--rate", "60", "--duration", "0.4", "--vehicles", "2",
+            "--points", "6", "--window", "6", "--grid", "5", "--seed", "3",
+            "--concurrency", "8", "--slo-availability", "0.5",
+            "--slo-p99-ms", "60000", "--out", str(out2),
+        ])
+    finally:
+        stub2.close()
+    assert rc == 0
+    art2 = json.loads(out2.read_text())
+    assert art2["mode"] == "stream-windowed"
+    assert art2["metric"] == "loadgen_stream_windowed_p99_latency"
+    assert art2["requests"] + art2["stream"]["points_dropped_tail"] \
+        == art["requests"]
+    assert stub2.count < stub.count  # fewer wire requests, same points
+    # the baseline's per-point latency includes the window-fill wait, so
+    # its p50 must exceed the per-point path's against the same stub
+    assert art2["quantiles"]["p50_ms"] > art["quantiles"]["p50_ms"]

@@ -1,0 +1,527 @@
+"""Per-vehicle session matcher: the carry-seam differential suite extended
+to session-incremental decoding (docs/performance.md "The session matcher").
+
+The bit-exact contract: a session's incremental answers equal the windowed
+``match_trace`` path at every matched window boundary —
+
+  * point-at-a-time == the W=1 carried-window chain (every point is a
+    seam; the causal commit at each step is exactly the windowed carry
+    machinery's seam commit),
+  * chunk-at-a-time == the long-trace chunked path at the same seams,
+  * a whole-trace step == the single-window batch decode,
+  * rebuild-from-replay == the windowed decode of the replayed history,
+
+wire- and CompactMatch-identical, for both viterbi kernels, interleaved
+across many uuids, through store eviction, serialisation round trips and
+the drain-time beam handoff.
+"""
+
+import numpy as np
+import pytest
+
+from reporter_tpu.matching import MatcherConfig, SegmentMatcher
+from reporter_tpu.matching.session import (
+    SessionEngine, SessionState, SessionStore,
+)
+from reporter_tpu.synth import TraceSynthesizer
+from reporter_tpu.tiles.arrays import build_graph_arrays
+from reporter_tpu.tiles.network import grid_city
+from reporter_tpu.tiles.ubodt import build_ubodt
+
+MO = {"mode": "auto", "report_levels": [0, 1], "transition_levels": [0, 1]}
+
+
+@pytest.fixture(scope="module")
+def setup():
+    city = grid_city(rows=8, cols=8, spacing_m=150.0)
+    arrays = build_graph_arrays(city, cell_size=100.0)
+    ubodt = build_ubodt(arrays, delta=1500.0)
+    return arrays, ubodt
+
+
+def _matcher(setup, kernel="scan", **kw):
+    arrays, ubodt = setup
+    cfg = MatcherConfig(length_buckets=[16], session_buckets=[4, 16],
+                        viterbi_kernel=kernel, **kw)
+    return SegmentMatcher(arrays=arrays, ubodt=ubodt, config=cfg)
+
+
+def _traces(arrays, b, t, seed=11, sigma=3.0):
+    synth = TraceSynthesizer(arrays, seed=seed)
+    return [s.trace for s in synth.batch(b, t, dt=5.0, sigma=sigma)]
+
+
+def _engine(m, tail=512):
+    store = SessionStore()
+    return SessionEngine(m, store, tail_points=tail), store
+
+
+def _stream(eng, tr, step=1, uuid=None):
+    """Feed a trace through the engine in ``step``-point submits."""
+    uuid = uuid or tr["uuid"]
+    pts = tr["trace"]
+    out = []
+    for j in range(0, len(pts), step):
+        out.extend(eng.match_many([
+            {"uuid": uuid, "trace": pts[j:j + step], "match_options": MO}]))
+    return out
+
+
+def _session_records(store, uuid):
+    s = store.peek(uuid)
+    return (np.array([r[0] for r in s.records], np.int64),
+            np.array([r[1] for r in s.records], np.float32),
+            np.array([r[2] for r in s.records], bool))
+
+
+def _windowed_records(m, tr):
+    """The windowed batch path's CompactMatch for one trace (bucketed or
+    long-trace chunked, whatever match_many would dispatch)."""
+    n = len(tr["trace"])
+    if n > m.cfg.length_buckets[-1]:
+        handles = m._dispatch_long([tr], [0])
+        _grp, (edge, offset, breaks), _tm = m._fetch_long(handles[0])
+    else:
+        px, py, tm, valid, _ = m._fill_rows([tr], [0], m._bucket_len(n))
+        edge, offset, breaks = m._collect_batch(
+            m._dispatch_batch(*m._pad_batch(px, py, tm, valid)))
+    return (edge[0, :n].astype(np.int64),
+            offset[0, :n].astype(np.float32), breaks[0, :n] != 0)
+
+
+def _w1_chain_records(m, tr):
+    """W=1 carried-window chain via the WINDOWED carry machinery
+    (match_batch_carry) — the matched-boundary reference for
+    point-at-a-time streaming."""
+    import jax.numpy as jnp
+
+    from reporter_tpu.ops.viterbi import (
+        MatchParams, initial_carry_batch, match_batch_carry,
+    )
+
+    n = len(tr["trace"])
+    px, py, tm, valid, _ = m._fill_rows([tr], [0], n)
+    p = MatchParams.from_config(m.cfg)
+    carry = initial_carry_batch(1, m.cfg.beam_k)
+    E, O, B = [], [], []
+    for t in range(n):
+        cm, carry = match_batch_carry(
+            m._dg, m._du, jnp.asarray(px[:, t:t + 1]),
+            jnp.asarray(py[:, t:t + 1]), jnp.asarray(tm[:, t:t + 1]),
+            jnp.asarray(valid[:, t:t + 1]), p, m.cfg.beam_k, carry,
+            kernel=m._kernel_for(1))
+        E.append(int(np.asarray(cm.edge)[0, 0]))
+        O.append(np.float32(np.asarray(cm.offset)[0, 0]))
+        B.append(bool(np.asarray(cm.breaks)[0, 0]))
+    return np.array(E, np.int64), np.array(O, np.float32), np.array(B)
+
+
+def _assert_records_equal(a, b, what=""):
+    ae, ao, ab_ = a
+    be, bo, bb = b
+    assert np.array_equal(ae, be), (what, np.nonzero(ae != be))
+    # offsets must agree BITWISE (f32), not approximately
+    assert np.array_equal(ao.view(np.int32), bo.view(np.int32)), what
+    assert np.array_equal(ab_, bb), what
+
+
+# -- bit-exact differentials -------------------------------------------------
+
+
+@pytest.mark.parametrize("kernel", ["scan", "assoc"])
+def test_point_at_a_time_bitexact_vs_windowed_w1_chain(setup, kernel):
+    """Streaming one point per step must reproduce the windowed carry
+    machinery at W=1 seams bit-exactly — CompactMatch-identical."""
+    arrays, _ = setup
+    m = _matcher(setup, kernel)
+    for tr in _traces(arrays, 3, 24):
+        eng, store = _engine(m)
+        _stream(eng, tr, step=1)
+        _assert_records_equal(
+            _session_records(store, tr["uuid"]), _w1_chain_records(m, tr),
+            what=tr["uuid"])
+
+
+@pytest.mark.parametrize("kernel", ["scan", "assoc"])
+def test_whole_trace_step_bitexact_vs_windowed(setup, kernel):
+    """A single session step covering the whole trace IS the windowed
+    single-dispatch decode: records bit-identical, wire segments equal."""
+    arrays, _ = setup
+    m = _matcher(setup, kernel)
+    for tr in _traces(arrays, 3, 14, seed=4):
+        eng, store = _engine(m)
+        out = _stream(eng, tr, step=len(tr["trace"]))
+        _assert_records_equal(
+            _session_records(store, tr["uuid"]), _windowed_records(m, tr),
+            what=tr["uuid"])
+        # wire-identical: the answer's segments equal the windowed match
+        assert out[-1]["segments"] == m.match(tr)["segments"]
+
+
+def test_chunk_steps_bitexact_vs_long_trace_path(setup):
+    """Session steps at the long path's own window boundaries (W = the
+    largest length bucket) reproduce the chunked windowed match_trace
+    decode bit-exactly, and the final accumulated answer is
+    wire-identical to match()."""
+    arrays, _ = setup
+    m = _matcher(setup)
+    W = m.cfg.length_buckets[-1]
+    for tr in _traces(arrays, 2, 3 * W, seed=9):
+        eng, store = _engine(m)
+        out = _stream(eng, tr, step=W)
+        _assert_records_equal(
+            _session_records(store, tr["uuid"]), _windowed_records(m, tr),
+            what=tr["uuid"])
+        assert out[-1]["segments"] == m.match(tr)["segments"]
+
+
+def test_interleaved_sessions_match_isolated_sessions(setup):
+    """Many vehicles stepping through SHARED dispatches (one [B, W]
+    program folding several sessions) decode exactly as each would
+    alone — batch isolation, with mixed step sizes and (for one vehicle)
+    two submits folded into a single engine batch.  The isolated
+    reference submits the SAME per-batch pattern one vehicle at a time,
+    so the decode boundaries match and the only variable is who shares
+    the dispatch."""
+    arrays, _ = setup
+    m = _matcher(setup)
+    traces = _traces(arrays, 4, 18, seed=21)
+    steps = {tr["uuid"]: s for tr, s in zip(traces, (1, 2, 3, 1))}
+
+    # per-vehicle submission pattern: a list of batches, each batch a
+    # list of point-slices (vehicle 0 sends two consecutive 1-point
+    # submits per batch — they legitimately fold into one window)
+    plan = {}
+    for vi, tr in enumerate(traces):
+        u, s = tr["uuid"], steps[tr["uuid"]]
+        batches, c = [], 0
+        while c < len(tr["trace"]):
+            subs = [tr["trace"][c:c + s]]
+            c += s
+            if vi == 0 and c < len(tr["trace"]):
+                subs.append(tr["trace"][c:c + s])
+                c += s
+            batches.append(subs)
+        plan[u] = batches
+
+    def submit(eng, u, subs):
+        eng.match_many([{"uuid": u, "trace": pts, "match_options": MO}
+                        for pts in subs if pts])
+
+    # isolated reference: one vehicle at a time, same batch pattern
+    ref = {}
+    for tr in traces:
+        eng, store = _engine(m)
+        for subs in plan[tr["uuid"]]:
+            submit(eng, tr["uuid"], subs)
+        ref[tr["uuid"]] = _session_records(store, tr["uuid"])
+
+    # interleaved: round k merges every vehicle's k-th batch into ONE
+    # engine batch (one shared [B, W] dispatch per bucket)
+    eng, store = _engine(m)
+    rounds = max(len(b) for b in plan.values())
+    for k in range(rounds):
+        batch = []
+        for tr in traces:
+            batches = plan[tr["uuid"]]
+            if k < len(batches):
+                batch.extend(
+                    {"uuid": tr["uuid"], "trace": pts, "match_options": MO}
+                    for pts in batches[k] if pts)
+        eng.match_many(batch)
+    for tr in traces:
+        _assert_records_equal(_session_records(store, tr["uuid"]),
+                              ref[tr["uuid"]], what=tr["uuid"])
+
+
+def test_rebuild_from_replay_bitexact_vs_windowed(setup):
+    """A beam-less session (replay-only handoff payload, or a degraded
+    window) rebuilds by re-matching its replay buffer: with the replay
+    covering the full history, the rebuilt records ARE the windowed
+    decode of the whole trace — bit-exact."""
+    arrays, _ = setup
+    m = _matcher(setup)
+    tr = _traces(arrays, 1, 12, seed=33)[0]
+    eng, store = _engine(m)
+    _stream(eng, tr, step=1, uuid="veh-r")
+
+    # serialise, strip the beam (the replay-only handoff), re-import
+    wire = store.peek("veh-r").to_wire()
+    wire["carry"] = None
+    store2 = SessionStore()
+    assert store2.import_wire([wire]) == {
+        "imported": 1, "merged": 0, "skipped": 0, "rebuild_pending": 1,
+        "imported_uuids": ["veh-r"]}
+    eng2 = SessionEngine(m, store2, tail_points=512)
+
+    # next point triggers the rebuild; the session's records become the
+    # windowed decode of ALL points seen so far
+    extra = dict(tr["trace"][-1])
+    extra = {"lat": extra["lat"], "lon": extra["lon"],
+             "time": extra["time"] + 5.0}
+    eng2.match_many([{"uuid": "veh-r", "trace": [extra],
+                      "match_options": MO}])
+    s2 = store2.peek("veh-r")
+    assert s2.rebuild_pending is False
+    full = {"uuid": "veh-r", "trace": tr["trace"] + [extra]}
+    _assert_records_equal(_session_records(store2, "veh-r"),
+                          _windowed_records(m, full))
+
+
+def test_long_replay_rebuild_chains_warmed_shapes(setup):
+    """An over-bucket rebuild (replay longer than the largest session
+    bucket) must CHAIN through the largest warmed [B, W] session shape —
+    no new compiled shapes — and its decode equals the windowed
+    long-trace path's bit-exactly (carry seams at W boundaries)."""
+    arrays, _ = setup
+    m = _matcher(setup)
+    tr = _traces(arrays, 1, 40, seed=27)[0]
+    eng, store = _engine(m)
+    _stream(eng, tr, step=1, uuid="veh-lr")
+    wire = store.peek("veh-lr").to_wire()
+    wire["carry"] = None  # replay-only handoff: forces the rebuild
+    store2 = SessionStore()
+    store2.import_wire([wire])
+    eng2 = SessionEngine(m, store2, tail_points=512)
+    shapes_before = set(m._compiled_shapes)
+    extra = dict(tr["trace"][-1])
+    extra = {"lat": extra["lat"], "lon": extra["lon"],
+             "time": extra["time"] + 5.0}
+    eng2.match_many([{"uuid": "veh-lr", "trace": [extra],
+                      "match_options": MO}])
+    w_max = m.cfg.session_buckets[-1]
+    new_shapes = set(m._compiled_shapes) - shapes_before
+    assert all(k[-1] <= w_max for k in new_shapes
+               if k[0] == "session"), (
+        "the rebuild compiled an over-bucket session shape: %r" % new_shapes)
+    full = {"uuid": "veh-lr", "trace": tr["trace"] + [extra]}
+    _assert_records_equal(_session_records(store2, "veh-lr"),
+                          _windowed_records(m, full))
+
+
+def test_wire_roundtrip_continues_bitexact(setup):
+    """Export -> import (the drain-time beam handoff) -> continue: the
+    inheriting matcher's decode equals the uninterrupted one bit-exactly
+    (the carry travels as exact f32)."""
+    arrays, _ = setup
+    m1 = _matcher(setup)
+    m2 = _matcher(setup)  # the inheriting replica's engine
+    tr = _traces(arrays, 1, 20, seed=5)[0]
+    cut = 11
+
+    # uninterrupted reference
+    eng, store = _engine(m1)
+    _stream(eng, tr, step=1, uuid="veh-h")
+    ref = _session_records(store, "veh-h")
+
+    # interrupted at `cut`: serialise, hand off, continue elsewhere
+    eng1, store1 = _engine(m1)
+    head = {"uuid": "veh-h", "trace": tr["trace"][:cut]}
+    _stream(eng1, head, step=1, uuid="veh-h")
+    wires = store1.export_all()
+    assert len(wires) == 1 and wires[0]["carry"] is not None
+    # JSON round trip like the real handoff POST
+    import json
+
+    wires = json.loads(json.dumps(wires))
+    store2 = SessionStore()
+    assert store2.import_wire(wires)["imported"] == 1
+    eng2 = SessionEngine(m2, store2, tail_points=512)
+    tail = {"uuid": "veh-h", "trace": tr["trace"][cut:]}
+    _stream(eng2, tail, step=1, uuid="veh-h")
+    _assert_records_equal(_session_records(store2, "veh-h"), ref)
+    # the zero-lost ledger rides the wire: points_total accumulates
+    # ACROSS the handoff, so the fleet-wide sum still counts every point
+    # exactly once
+    s2 = store2.peek("veh-h")
+    assert s2.points_total == len(tr["trace"])
+
+
+def test_import_merges_into_live_session(setup):
+    """A uuid already live locally MERGES with the import (the racing
+    re-dispatch opened a fresh session before the handoff landed): the
+    imported replay prepends, the decode is flagged for a rebuild over
+    the combined history, and the points ledger absorbs the imported
+    count — zero lost, zero duplicated."""
+    arrays, _ = setup
+    m = _matcher(setup)
+    tr = _traces(arrays, 1, 12, seed=6)[0]
+    cut = 8
+    # the handed-off history (pre-drain decode, cut points)
+    eng1, store1 = _engine(m)
+    _stream(eng1, {"uuid": "x", "trace": tr["trace"][:cut]}, step=1,
+            uuid="veh-l")
+    wire = store1.export_all()[0]
+    # the race loser: a fresh session that already absorbed 2 points
+    eng, store = _engine(m)
+    _stream(eng, {"uuid": "x", "trace": tr["trace"][cut:cut + 2]}, step=1,
+            uuid="veh-l")
+    live = store.peek("veh-l")
+    res = store.import_wire([wire])
+    assert res["merged"] == 1 and res["imported"] == 0
+    assert res["imported_uuids"] == ["veh-l"]
+    assert store.peek("veh-l") is live
+    assert live.points_total == cut + 2  # ledger absorbed, nothing lost
+    assert live.rebuild_pending
+    # the next step rebuilds over the combined history: bit-exact vs the
+    # windowed decode of every point seen so far
+    eng.match_many([{"uuid": "veh-l", "trace": [tr["trace"][cut + 2]],
+                     "match_options": MO}])
+    full = {"uuid": "veh-l", "trace": tr["trace"][:cut + 3]}
+    _assert_records_equal(_session_records(store, "veh-l"),
+                          _windowed_records(m, full))
+    assert store.peek("veh-l").points_total == cut + 3
+    # an empty payload (no replay) is a pure ledger merge: no rebuild
+    res = store.import_wire([SessionState("veh-l", 0.0).to_wire()])
+    assert res["merged"] == 1
+    assert store.peek("veh-l").rebuild_pending is False
+
+
+def test_store_ttl_and_lru_eviction(setup):
+    arrays, _ = setup
+    m = _matcher(setup)
+    store = SessionStore(max_sessions=2, ttl_s=3600.0)
+    eng = SessionEngine(m, store, tail_points=64)
+    traces = _traces(arrays, 3, 4, seed=8)
+    for i, tr in enumerate(traces):
+        _stream(eng, tr, step=1, uuid="veh-%d" % i)
+    # LRU bound: veh-0 (least recently stepped) was evicted
+    assert len(store) == 2
+    assert store.peek("veh-0") is None
+    assert store.peek("veh-2") is not None
+    # TTL: an ancient session expires on the next access sweep
+    store.peek("veh-2").last_used -= 7200.0
+    store.get_or_open("veh-9", t0=1.0)
+    assert store.peek("veh-2") is None
+
+
+def test_params_change_reopens_session(setup):
+    """A changed per-request sigma_z invalidates the carried scores: the
+    session restarts under the new params key instead of mixing scales."""
+    arrays, _ = setup
+    m = _matcher(setup)
+    eng, store = _engine(m)
+    tr = _traces(arrays, 1, 6, seed=13)[0]
+    _stream(eng, {"uuid": "veh-p", "trace": tr["trace"][:3]}, step=1,
+            uuid="veh-p")
+    s1 = store.peek("veh-p")
+    assert s1.pkey == ()
+    eng.match_many([{"uuid": "veh-p", "trace": [tr["trace"][3]],
+                     "match_options": dict(MO, sigma_z=9.0)}])
+    s2 = store.peek("veh-p")
+    assert s2 is not s1 and s2.pkey != ()
+    assert s2.points_total == 1
+
+
+# -- service-level streaming (the wire) --------------------------------------
+
+
+def test_streaming_report_wire_matches_windowed(setup):
+    """The streaming POST /report path: per-point answers carry the
+    session block, and once the session has consumed the whole trace the
+    answer is wire-identical to the windowed /report of that trace."""
+    import json
+
+    from reporter_tpu.serve.service import ReporterService
+
+    arrays, _ = setup
+    m = _matcher(setup, session_tail_points=512)
+    svc = ReporterService(m, max_wait_ms=1.0, session_wait_ms=1.0)
+    tr = _traces(arrays, 1, 14, seed=17)[0]
+    W = len(tr["trace"])
+
+    code, ref = svc.handle_report(
+        {"uuid": "veh-w", "trace": tr["trace"], "match_options": MO})
+    assert code == 200
+
+    code, out = svc.handle_report(
+        {"uuid": "veh-s", "stream": True, "trace": tr["trace"],
+         "match_options": MO})
+    assert code == 200
+    sess = out.pop("session")
+    assert sess["points"] == W and sess["points_total"] == W
+    assert sess["seq"] == 1 and sess["tail_points"] == W
+    # byte-identical wire payload (json round trip normalises floats)
+    assert json.loads(json.dumps(out)) == json.loads(json.dumps(ref))
+
+    # point-at-a-time: every answer 200 with a growing session block and
+    # the route classified under report_stream for the SLO engine
+    from reporter_tpu.obs import slo as obs_slo
+
+    for i, p in enumerate(tr["trace"]):
+        code, out = svc.handle_report(
+            {"uuid": "veh-s2", "stream": True, "trace": [p],
+             "match_options": MO})
+        assert code == 200, out
+        assert out["session"]["seq"] == i + 1
+        assert out["session"]["points_total"] == i + 1
+    rep = obs_slo.engine().report()
+    assert "report_stream" in rep["routes"]
+    assert rep["routes"]["report_stream"]["good"] >= W + 1
+
+    # single-point streaming is valid; single-point WINDOWED stays 400
+    code, out = svc.handle_report(
+        {"uuid": "veh-bad", "trace": [tr["trace"][0]],
+         "match_options": MO})
+    assert code == 400
+
+
+def test_sessions_endpoint_export_import(setup):
+    """GET /sessions (+?export=1) and POST import through the service
+    handlers — the surface the router's beam handoff drives."""
+    from reporter_tpu.serve.service import ReporterService
+
+    arrays, _ = setup
+    m = _matcher(setup)
+    svc = ReporterService(m, max_wait_ms=1.0, session_wait_ms=1.0)
+    tr = _traces(arrays, 1, 6, seed=19)[0]
+    for p in tr["trace"]:
+        code, _out = svc.handle_report(
+            {"uuid": "veh-e", "stream": True, "trace": [p],
+             "match_options": MO})
+        assert code == 200
+    code, out = svc.handle_sessions({})
+    assert code == 200 and out["sessions"] == 1
+    assert out["points_total"] == len(tr["trace"])
+    code, out = svc.handle_sessions({"export": ["1"]})
+    assert code == 200 and len(out["sessions"]) == 1
+    code, one = svc.handle_sessions({"uuid": ["veh-e"]})
+    assert code == 200 and one["points_total"] == len(tr["trace"])
+    code, _ = svc.handle_sessions({"uuid": ["ghost"]})
+    assert code == 404
+
+    # import into a second service (the inheriting replica)
+    svc2 = ReporterService(_matcher(setup), max_wait_ms=1.0,
+                           session_wait_ms=1.0)
+    code, res = svc2.handle_sessions({}, {"sessions": out["sessions"]})
+    assert code == 200 and res["imported"] == 1
+    code, res = svc2.handle_sessions({}, {"sessions": "nope"})
+    assert code == 400
+
+
+def test_session_metrics_and_dispatch_cohort(setup):
+    """The session plane is metrics-instrumented: lifecycle counters,
+    folded-point counter, and the session dispatch cohort."""
+    from reporter_tpu.obs import metrics as obs
+
+    def fam(name):
+        return obs.REGISTRY.snapshot().get(name, {"samples": []})["samples"]
+
+    arrays, _ = setup
+    m = _matcher(setup)
+    before_opened = sum(v for lv, v in fam("reporter_sessions_total")
+                        if lv == ["opened"])
+    before_pts = sum(v for _lv, v in fam("reporter_session_points_total"))
+    before_disp = sum(v for lv, v in fam("reporter_dispatch_cohort_total")
+                      if lv == ["session", "step"])
+    eng, store = _engine(m)
+    tr = _traces(arrays, 1, 5, seed=23)[0]
+    _stream(eng, tr, step=1, uuid="veh-m")
+    snap_opened = sum(v for lv, v in fam("reporter_sessions_total")
+                      if lv == ["opened"])
+    assert snap_opened == before_opened + 1
+    assert sum(v for _lv, v in fam("reporter_session_points_total")) \
+        == before_pts + len(tr["trace"])
+    assert sum(v for lv, v in fam("reporter_dispatch_cohort_total")
+               if lv == ["session", "step"]) \
+        == before_disp + len(tr["trace"])

@@ -165,6 +165,48 @@ def test_warmup_covers_batched_precompute(engine, monkeypatch):
         "a warmed long trace paid a request-path compile")
 
 
+def test_warmup_session_step_covers_streaming(engine):
+    """warmup(session_step=True) — the serve --warmup semantics — must
+    pre-dispatch every (batch rung, session bucket) incremental-step
+    shape, so the FIRST streaming point of a fresh boot records zero
+    request-path compile stalls (the session matcher's whole point is
+    point latency; an inline XLA compile there is the stall the carry
+    chain already eliminated for long traces)."""
+    from reporter_tpu.matching.session import SessionEngine, SessionStore
+    from reporter_tpu.serve.service import ReporterService
+
+    arrays, ubodt = engine
+    matcher = SegmentMatcher(
+        arrays=arrays, ubodt=ubodt,
+        config=MatcherConfig(session_buckets=[4, 16], **CFG))
+    matcher.warmup(lengths=[], session_step=True)
+    for w in matcher.cfg.session_buckets:
+        assert matcher.compiled_shape_count(w, kind="session") > 0, w
+    before = _compile_total()
+    # the real streaming submit path: single point (bucket 4), then a
+    # wider delta (bucket 16) — both warmed, neither may compile
+    service = ReporterService(matcher, max_wait_ms=1.0, session_wait_ms=1.0)
+    tr = _trace(arrays, 12, uuid="wm-stream")
+    code, data = service.handle_report(
+        dict(tr, stream=True, trace=tr["trace"][:1]))
+    assert code == 200, data
+    code, data = service.handle_report(
+        dict(tr, stream=True, trace=tr["trace"][1:]))
+    assert code == 200, data
+    assert _compile_total() == before, (
+        "a warmed session step paid a request-path compile stall")
+
+    # control: an UNwarmed matcher's first streaming step IS a compile
+    m2 = SegmentMatcher(
+        arrays=arrays, ubodt=ubodt,
+        config=MatcherConfig(session_buckets=[4, 16], **CFG))
+    eng = SessionEngine(m2, SessionStore(), tail_points=64)
+    before = _compile_total()
+    eng.match_many([{"uuid": "wm-cold", "trace": tr["trace"][:1],
+                     "match_options": tr["match_options"]}])
+    assert _compile_total() == before + 1
+
+
 def test_legacy_long_path_still_selectable(engine, monkeypatch):
     """REPORTER_LONG_PRECOMPUTE=0 forces the legacy fused per-chunk carry
     program — the differential reference must stay dispatchable."""

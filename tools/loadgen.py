@@ -174,6 +174,60 @@ def interleave(sessions: List[Tuple[str, List[dict]]]) -> List[dict]:
         k += 1
 
 
+def stream_points(sessions: List[Tuple[str, List[dict]]]) -> List[dict]:
+    """The per-point streaming corpus (docs/performance.md "The session
+    matcher"): every probe of every vehicle becomes ONE single-point
+    ``"stream": true`` /report body, round-robin across vehicles with
+    each vehicle's point order preserved — the open-loop firehose the
+    session matcher answers at point latency."""
+    per_uuid = []
+    for uuid, reqs in sessions:
+        flat = [p for r in reqs for p in r["trace"]]
+        per_uuid.append((uuid, [
+            {"uuid": uuid, "stream": True, "trace": [p],
+             "match_options": dict(MATCH_OPTIONS)} for p in flat]))
+    return interleave(per_uuid)
+
+
+def fold_stream_windows(point_reqs: List[dict], schedule: List[float],
+                        window: int):
+    """The windowed-rebatch BASELINE at the same per-point offered rate:
+    buffer each vehicle's points client-side the way the stream topology
+    re-batches micro-traces, send a classic windowed /report when
+    ``window`` points accumulate (at the LAST point's slot), and record
+    every point's latency against ITS OWN arrival slot via ``_scheds`` —
+    so the per-point p99 honestly includes the window-fill wait the
+    session path eliminates.  Returns (requests, schedule, n_dropped):
+    points stranded in a tail window of < 2 points cannot form a valid
+    windowed request and are dropped (counted in the artifact)."""
+    buf: Dict[str, dict] = {}
+    out_reqs: List[dict] = []
+    out_sched: List[float] = []
+
+    def flush(uuid: str, b: dict) -> None:
+        out_reqs.append({"uuid": uuid, "trace": b["pts"],
+                         "match_options": dict(MATCH_OPTIONS),
+                         "_scheds": b["scheds"]})
+        out_sched.append(b["scheds"][-1])
+
+    for r, off in zip(point_reqs, schedule):
+        b = buf.setdefault(r["uuid"], {"pts": [], "scheds": []})
+        b["pts"].extend(r["trace"])
+        b["scheds"].append(off)
+        if len(b["pts"]) >= window:
+            flush(r["uuid"], b)
+            buf[r["uuid"]] = {"pts": [], "scheds": []}
+    dropped = 0
+    for uuid, b in buf.items():
+        if len(b["pts"]) >= 2:
+            flush(uuid, b)
+        else:
+            dropped += len(b["pts"])
+    order = sorted(range(len(out_reqs)), key=lambda i: out_sched[i])
+    return ([out_reqs[i] for i in order],
+            [out_sched[i] for i in order], dropped)
+
+
 # -- schedule ---------------------------------------------------------------
 
 def build_schedule(n: int, rate: float, arrival: str,
@@ -263,9 +317,17 @@ def run_load(url: str, requests: List[dict], schedule: List[float],
     The whole schedule is always drained: a hung server cannot make the
     tail disappear by never being measured.  Returns the samples plus the
     wall-clock epoch of offset 0 (so a rehearsal script can correlate
-    sample offsets with externally-timed kill/restart events)."""
-    bodies = [json.dumps(r, separators=(",", ":")).encode() for r in requests]
-    samples: List[Optional[Sample]] = [None] * len(requests)
+    sample offsets with externally-timed kill/restart events).
+
+    A request may carry ``"_scheds"``: a list of PER-POINT schedule
+    offsets (the streaming scenario's windowed-rebatch baseline buffers
+    points client-side the way the stream topology does, so each point's
+    latency is measured against ITS OWN arrival slot, not the window
+    flush).  Underscore keys never reach the wire."""
+    bodies = [json.dumps({k: v for k, v in r.items()
+                          if not str(k).startswith("_")},
+                         separators=(",", ":")).encode() for r in requests]
+    samples: List[Optional[List[Sample]]] = [None] * len(requests)
     it = {"i": 0}
     lock = threading.Lock()
     t0 = time.monotonic() + 0.05  # everyone references the same epoch
@@ -285,17 +347,19 @@ def run_load(url: str, requests: List[dict], schedule: List[float],
             sent = time.monotonic()
             code, degraded, replica = _post(url, bodies[i], timeout_s)
             done = time.monotonic()
-            samples[i] = Sample(sched - t0, sent - t0, done - t0,
-                                code, degraded, replica=replica,
-                                uuid=requests[i].get("uuid"))
-
+            scheds = requests[i].get("_scheds") or [schedule[i]]
+            samples[i] = [
+                Sample(off, sent - t0, done - t0, code, degraded,
+                       replica=replica, uuid=requests[i].get("uuid"))
+                for off in scheds]
     threads = [threading.Thread(target=worker, daemon=True)
                for _ in range(max(1, concurrency))]
     for t in threads:
         t.start()
     for t in threads:
         t.join()
-    return [s for s in samples if s is not None], t0_epoch
+    return [s for group in samples if group is not None for s in group], \
+        t0_epoch
 
 
 # -- evaluation -------------------------------------------------------------
@@ -422,6 +486,24 @@ def main(argv=None) -> int:
                          "seconds, cycled per vehicle (e.g. 45,60 — the "
                          "reference BatchingProcessor operating point; "
                          "default: dense 5 s sampling)")
+    # streaming session scenario (docs/performance.md "The session
+    # matcher"): open-loop per-POINT sends on uuid-affine sessions, each
+    # point's latency against its own scheduled arrival
+    ap.add_argument("--stream", action="store_true",
+                    help="per-point streaming scenario: every probe is "
+                         "one single-point \"stream\": true /report on "
+                         "its vehicle's open session; --rate is the "
+                         "fleet-wide POINT rate and every quantile below "
+                         "is per-point")
+    ap.add_argument("--stream-window", type=int, default=1,
+                    help="with --stream: client-side points buffered per "
+                         "send.  1 (default) = the pure session path; "
+                         "N>=2 = the windowed-REBATCH baseline at the "
+                         "same per-point offered rate (classic windowed "
+                         "/report sent when N points accumulate, each "
+                         "point still measured against its own arrival "
+                         "slot) — the comparison that shows the window-"
+                         "fill wait the session matcher eliminates")
     # archive replay (make_requests.py-style rows)
     ap.add_argument("--archive", default=None, help="probe dir or glob")
     ap.add_argument("--sep", default="|")
@@ -479,7 +561,9 @@ def main(argv=None) -> int:
     if not sessions:
         sys.stderr.write("loadgen: empty request corpus\n")
         return 2
-    corpus = interleave(sessions)
+    if args.stream_window < 1:
+        ap.error("--stream-window must be >= 1")
+    corpus = stream_points(sessions) if args.stream else interleave(sessions)
 
     # rate steps
     if args.ramp:
@@ -497,6 +581,7 @@ def main(argv=None) -> int:
     steps_out = []
     all_samples: List[Sample] = []
     dump_rows: List[dict] = []
+    stream_dropped = 0
     knee = None
     for rate in rates:
         if args.time_warp > 0 and not args.ramp:
@@ -507,11 +592,24 @@ def main(argv=None) -> int:
             offered = (len(schedule) / schedule[-1]) if schedule and schedule[-1] > 0 else 0.0
         else:
             n = max(1, int(rate * args.duration))
-            reqs = [dict(corpus[i % len(corpus)]) for i in range(n)]
+            reqs = []
+            for i in range(n):
+                r = dict(corpus[i % len(corpus)])
+                cyc = i // len(corpus)
+                if cyc and args.stream:
+                    # a re-cycled stream point must not rewind an open
+                    # session's clock: each pass over the corpus streams
+                    # as a fresh fleet of vehicles
+                    r["uuid"] = "%s~c%d" % (r["uuid"], cyc)
+                reqs.append(r)
             for r in reqs:
                 r.pop("_t0", None)
             schedule = build_schedule(n, rate, args.arrival, rng)
             offered = rate
+        if args.stream and args.stream_window > 1:
+            reqs, schedule, dropped = fold_stream_windows(
+                reqs, schedule, args.stream_window)
+            stream_dropped += dropped
         samples, t0_epoch = run_load(base + "/report", reqs, schedule,
                                      concurrency=args.concurrency,
                                      timeout_s=args.timeout_s)
@@ -575,8 +673,12 @@ def main(argv=None) -> int:
                     % json.dumps(hot))
 
     artifact = {
-        # perf_gate-consumable header (docs/bench-schema.md shape)
-        "metric": "loadgen_p99_latency",
+        # perf_gate-consumable header (docs/bench-schema.md shape); the
+        # stream scenarios carry their own metric names so like-provenance
+        # regression judging never mixes per-point and per-request tails
+        "metric": ("loadgen_p99_latency" if not args.stream else
+                   "loadgen_stream_p99_latency" if args.stream_window <= 1
+                   else "loadgen_stream_windowed_p99_latency"),
         "value": head["quantiles"]["p99_ms"],
         "unit": "ms",
         "platform": args.platform,
@@ -588,7 +690,16 @@ def main(argv=None) -> int:
         "url": base,
         "arrival": args.arrival,
         "seed": args.seed,
-        "mode": ("archive" if args.archive else "synth"),
+        "mode": (("stream" if args.stream_window <= 1 else "stream-windowed")
+                 if args.stream else
+                 ("archive" if args.archive else "synth")),
+        # per-point streaming scenario provenance: quantiles above are
+        # PER-POINT against each point's own scheduled arrival; window>1
+        # is the windowed-rebatch baseline at the same point rate
+        "stream": ({"window": args.stream_window,
+                    "points": len(all_samples),
+                    "points_dropped_tail": stream_dropped}
+                   if args.stream else None),
         "gap_s": gaps,
         "time_warp": args.time_warp or None,
         "sessions": len(sessions),
