@@ -49,6 +49,21 @@ Points and spec grammar (value of ``REPORTER_FAULT_<POINT>``):
                 so the fleet-rehearsal's masking-debt assertion has a
                 deterministic fleet-good/replica-bad request
                 (docs/observability.md "Fleet observability")
+  clock_skew    "<factor>[:N]"   (decimal form, e.g. "4.0" — a bare
+                integer parses as the raise-N grammar)
+                scale the MicroBatcher's deadline clock: during the
+                batch-formation deadline scrub each queued entry's
+                elapsed time is multiplied by <factor>, so deadlines
+                expire early (factor > 1) or late (< 1) — the
+                clock-drift fixture the overload rehearsal uses to
+                prove the 504 path and the adaptive wait controller
+                survive a skewed clock (docs/serving-fleet.md
+                "Self-driving fleet")
+  slow_drain    "<seconds>[:N]"
+                stall the GET /sessions?export=1 beam-handoff export
+                <seconds> before it snapshots — a crawling drain the
+                router's handoff retries (and a scale-down) must wait
+                out without losing a beam
   quality_skew  "<metres>[:N]"   (decimal form, e.g. "30.0" — a bare
                 integer parses as the raise-N grammar)
                 perturb the device batch's projected coordinates with
@@ -84,7 +99,8 @@ C_INJECTED = obs.counter(
 
 POINTS = ("dispatch", "device_hang", "ubodt_probe", "store_put",
           "client_post", "router_connect", "replica_slow_accept",
-          "health_flap", "replica_shed", "quality_skew")
+          "health_flap", "replica_shed", "quality_skew", "clock_skew",
+          "slow_drain")
 
 _lock = threading.Lock()
 _consumed: dict = {}  # (point, raw_spec) -> times fired
@@ -162,6 +178,18 @@ def maybe_raise(point: str, key: Optional[str] = None) -> None:
     """Raise InjectedFault when the point fires (the raise-mode points)."""
     if fire(point, key) is not None:
         raise InjectedFault(point, key or "")
+
+
+def scale(point: str, default: float = 1.0) -> float:
+    """The spec'd multiplier when a scale-mode point (clock_skew) fires,
+    else ``default`` (disarmed = identity)."""
+    tok = fire(point)
+    if tok is None:
+        return default
+    try:
+        return float(tok)
+    except ValueError:
+        return default
 
 
 def hang(point: str = "device_hang") -> float:

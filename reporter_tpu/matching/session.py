@@ -42,15 +42,22 @@ submits unchanged (docs/robustness.md).
 
 from __future__ import annotations
 
+import json
+import logging
+import os
 import threading
 import time as _time
 from collections import OrderedDict
 from typing import Dict, List, Optional, Tuple
+from urllib.parse import quote, unquote
 
 import numpy as np
 
 from .. import faults
+from ..obs import log as obs_log
 from ..obs import metrics as obs
+
+log = logging.getLogger(__name__)
 
 # session-plane metric families (docs/observability.md "Sessions")
 G_SESSIONS = obs.gauge(
@@ -68,6 +75,15 @@ H_STEP_SESSIONS = obs.histogram(
     "reporter_session_step_sessions",
     "Sessions folded per incremental session-step device dispatch",
     buckets=obs.BATCH_FILL_BUCKETS)
+C_CKPT = obs.counter(
+    "reporter_session_checkpoints_total",
+    "Session checkpoint events (written / pruned / cleared / error) — "
+    "the preemption-tolerance plane: dirty session wire-state persisted "
+    "to atomic per-uuid files on REPORTER_SESSION_CHECKPOINT_S cadence "
+    "(or synchronously per commit with _SYNC=1), re-homed by the fleet "
+    "supervisor when a replica is SIGKILLed (docs/serving-fleet.md "
+    "\"Self-driving fleet\")",
+    ("event",))
 
 WIRE_VERSION = 1
 
@@ -195,6 +211,27 @@ class SessionStore:
         self.ttl_s = float(ttl_s)
         self._lock = threading.Lock()
         self._by_uuid: "OrderedDict[str, SessionState]" = OrderedDict()
+        # preemption tolerance (docs/serving-fleet.md "Self-driving
+        # fleet"): an attached SessionCheckpointer persists dirty wire
+        # snapshots so a SIGKILL'd replica's sessions re-home instead of
+        # rebuilding from scratch; None = the PR-12 behaviour exactly
+        self._checkpointer: "Optional[SessionCheckpointer]" = None
+
+    def attach_checkpointer(self, cp: "SessionCheckpointer") -> None:
+        self._checkpointer = cp
+
+    def notify_commit(self, uuid: str) -> None:
+        """A step committed into ``uuid``'s session (the engine calls
+        this OUTSIDE the store lock): mark it dirty for the checkpoint
+        sweep, or persist it inline in sync mode."""
+        cp = self._checkpointer
+        if cp is not None:
+            cp.on_commit(uuid)
+
+    def _notify_removed(self, uuid: str) -> None:
+        cp = self._checkpointer
+        if cp is not None:
+            cp.on_removed(uuid)
 
     def __len__(self) -> int:
         with self._lock:
@@ -244,7 +281,9 @@ class SessionStore:
         with self._lock:
             s = self._by_uuid.pop(uuid, None)
             G_SESSIONS.set(len(self._by_uuid))
-            return s is not None
+        if s is not None:
+            self._notify_removed(uuid)
+        return s is not None
 
     def pop_wire(self, uuids) -> List[dict]:
         """Atomic remove-and-serialise — the recovery rebalance's exact
@@ -260,6 +299,12 @@ class SessionStore:
                 if s is not None:
                     out.append(s.to_wire())
             G_SESSIONS.set(len(self._by_uuid))
+        for w in out:
+            # the popped copy travels; its checkpoint file must die NOW,
+            # not at the next sweep — a SIGKILL between pop and sweep
+            # would otherwise re-home a session that already moved
+            # (duplicating its ledger)
+            self._notify_removed(str(w.get("uuid")))
         if out:
             C_SESSION_EVENTS.labels("exported").inc(len(out))
         return out
@@ -325,10 +370,23 @@ class SessionStore:
             for s in states:
                 live = self._by_uuid.get(s.uuid)
                 if live is not None:
-                    live.points_total += s.points_total
+                    # merge-DEDUP by raw point identity: a point the dead
+                    # (or draining) replica committed AND the router
+                    # re-dispatched after the failure lives in both
+                    # replays — counting it twice would inflate the fleet
+                    # ledger, replaying it twice would distort the
+                    # rebuilt decode.  Both sides carry the recent raw
+                    # points, so the overlap is exactly computable.
+                    live_keys = {(p.get("time"), p.get("lat"),
+                                  p.get("lon")) for p in live.replay}
+                    fresh = [p for p in s.replay
+                             if (p.get("time"), p.get("lat"),
+                                 p.get("lon")) not in live_keys]
+                    dup = len(s.replay) - len(fresh)
+                    live.points_total += max(0, s.points_total - dup)
                     live.seq += s.seq
-                    if s.replay:
-                        live.replay = list(s.replay) + live.replay
+                    if fresh:
+                        live.replay = fresh + live.replay
                         live.rebuild_pending = True
                     live.imported = True
                     merged += 1
@@ -345,6 +403,10 @@ class SessionStore:
                     rebuild += 1
                 C_SESSION_EVENTS.labels("imported").inc()
             G_SESSIONS.set(len(self._by_uuid))
+        # imported sessions are immediately checkpoint-dirty on their new
+        # home: a preemption right after a handoff must not lose them
+        for u in imported:
+            self.notify_commit(u)
         # imported_uuids (absorbed payloads, merged included) lets the
         # handoff driver DROP the source copies it duplicated (the
         # recovery rebalance), keeping the fleet-wide points_total ledger
@@ -352,6 +414,17 @@ class SessionStore:
         return {"imported": len(imported) - merged, "merged": merged,
                 "skipped": skipped, "rebuild_pending": rebuild,
                 "imported_uuids": imported}
+
+    def wire_of(self, uuid: str) -> Optional[dict]:
+        """One session's wire snapshot under the store lock (None when it
+        is gone) — the checkpointer's consistent read."""
+        with self._lock:
+            s = self._by_uuid.get(uuid)
+            return s.to_wire() if s is not None else None
+
+    def uuids(self) -> List[str]:
+        with self._lock:
+            return list(self._by_uuid)
 
     def summary(self) -> dict:
         with self._lock:
@@ -524,6 +597,9 @@ class SessionEngine:
         # points so the fleet ledger stays exact
         self.store.finalize(sess, step_points=len(pts),
                             step_subs=len(ent["subs"]))
+        # preemption tolerance: the committed step is checkpoint-dirty
+        # (sync mode persists it before the answer leaves the batcher)
+        self.store.notify_commit(sess.uuid)
 
     def _render(self, sess: SessionState, win_recs, win_raw, aux,
                 n_new: int, meta: dict) -> dict:
@@ -610,8 +686,205 @@ class SessionEngine:
         sess.points_total += len(pts)
         C_SESSION_POINTS.inc(len(pts))
         self.store.finalize(sess, step_points=len(pts), step_subs=1)
+        self.store.notify_commit(sess.uuid)
         match["_stream"] = {
             "trace": win_raw,
             "session": dict(sess.meta(), points=len(pts), degraded=True),
         }
         return match
+
+
+class SessionCheckpointer:
+    """Preemption tolerance for the session store (docs/serving-fleet.md
+    "Self-driving fleet"): dirty session wire-state persisted as atomic
+    per-uuid JSON files in a replica-owned directory, so a SIGKILL is a
+    checkpoint restore, not a from-scratch rebuild.
+
+    Two write modes, both behind ``REPORTER_SESSION_CHECKPOINT_S``:
+
+      cadence    a background sweep every ``cadence_s`` seconds writes
+                 every dirty session (one atomic tmp+rename per uuid)
+                 and prunes files whose session left the store — cheap,
+                 with a bounded loss window of one cadence;
+      sync       (``REPORTER_SESSION_CHECKPOINT_SYNC=1``) each commit
+                 additionally writes its session inline BEFORE the
+                 answer leaves the batcher, so an answered point is
+                 always on disk — the zero-lost-answered-points mode the
+                 overload rehearsal gates.
+
+    Removal is prompt where it must be (drop / atomic pop notify the
+    checkpointer directly — a popped beam that already moved must never
+    be re-homed from a stale file) and sweep-based where laziness is
+    safe (TTL expiry, LRU eviction).  ``clear()`` runs at attach time:
+    a respawned replica starts from an empty directory, because the
+    supervisor already re-homed (or deliberately abandoned) whatever the
+    previous process left behind.
+
+    File names are percent-encoded uuids — the uuid is client-supplied
+    wire data and must not traverse the filesystem raw.
+    """
+
+    def __init__(self, store: SessionStore, dirpath: str,
+                 cadence_s: float, sync: bool = False):
+        self.store = store
+        self.dir = dirpath
+        self.cadence_s = float(cadence_s)
+        self.sync = bool(sync)
+        self._dirty: set = set()
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        os.makedirs(self.dir, exist_ok=True)
+        store.attach_checkpointer(self)
+
+    # -- paths ---------------------------------------------------------------
+
+    @staticmethod
+    def _path_name(uuid: str) -> str:
+        return quote(uuid, safe="") + ".json"
+
+    def _path(self, uuid: str) -> str:
+        return os.path.join(self.dir, self._path_name(uuid))
+
+    @staticmethod
+    def _uuid_of(fname: str) -> Optional[str]:
+        if not fname.endswith(".json"):
+            return None
+        return unquote(fname[:-5])
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> None:
+        self.clear()
+        if self.cadence_s > 0:
+            self._thread = threading.Thread(
+                target=self._loop, daemon=True, name="session-checkpoint")
+            self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def clear(self) -> int:
+        """Empty the directory (boot): stale files from a previous
+        process must not be mistaken for this replica's live state."""
+        n = 0
+        try:
+            for fname in os.listdir(self.dir):
+                if self._uuid_of(fname) is None:
+                    continue
+                try:
+                    os.unlink(os.path.join(self.dir, fname))
+                    n += 1
+                except OSError:
+                    pass
+        except OSError:
+            pass
+        if n:
+            C_CKPT.labels("cleared").inc(n)
+        return n
+
+    # -- store hooks ---------------------------------------------------------
+
+    def on_commit(self, uuid: str) -> None:
+        if self.sync:
+            self._write(uuid)
+            return
+        with self._lock:
+            self._dirty.add(uuid)
+
+    def on_removed(self, uuid: str) -> None:
+        with self._lock:
+            self._dirty.discard(uuid)
+        try:
+            os.unlink(self._path(uuid))
+            C_CKPT.labels("pruned").inc()
+        except OSError:
+            pass
+
+    # -- the sweep -----------------------------------------------------------
+
+    def _write(self, uuid: str) -> bool:
+        wire = self.store.wire_of(uuid)
+        if wire is None:
+            return False
+        path = self._path(uuid)
+        tmp = "%s.%d.tmp" % (path, os.getpid())
+        try:
+            with open(tmp, "w") as f:
+                json.dump(wire, f, separators=(",", ":"))
+            os.replace(tmp, path)
+        except OSError:
+            C_CKPT.labels("error").inc()
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            return False
+        C_CKPT.labels("written").inc()
+        return True
+
+    def sweep(self) -> dict:
+        """One pass: flush every dirty session, prune files for sessions
+        no longer in the store.  Returns counters (tests + /statusz)."""
+        with self._lock:
+            dirty = list(self._dirty)
+            self._dirty.clear()
+        written = sum(1 for u in dirty if self._write(u))
+        live = set(self.store.uuids())
+        pruned = 0
+        try:
+            for fname in os.listdir(self.dir):
+                u = self._uuid_of(fname)
+                if u is None or u in live:
+                    continue
+                try:
+                    os.unlink(os.path.join(self.dir, fname))
+                    pruned += 1
+                except OSError:
+                    pass
+        except OSError:
+            pass
+        if pruned:
+            C_CKPT.labels("pruned").inc(pruned)
+        return {"written": written, "pruned": pruned,
+                "dirty_remaining": len(self._dirty)}
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.cadence_s):
+            try:
+                self.sweep()
+            except Exception:  # noqa: BLE001 - checkpointing must not die
+                log.exception("session checkpoint sweep failed")
+
+    def summary(self) -> dict:
+        with self._lock:
+            dirty = len(self._dirty)
+        try:
+            files = sum(1 for f in os.listdir(self.dir)
+                        if self._uuid_of(f) is not None)
+        except OSError:
+            files = None
+        return {"dir": self.dir, "cadence_s": self.cadence_s,
+                "sync": self.sync, "dirty": dirty, "files": files}
+
+
+def read_checkpoints(dirpath: str) -> List[dict]:
+    """Every session wire snapshot under ``dirpath`` (the supervisor's
+    re-home read after a SIGKILL; unreadable files are skipped loudly —
+    a torn write must not abort the rest of the herd's recovery)."""
+    out: List[dict] = []
+    try:
+        names = sorted(os.listdir(dirpath))
+    except OSError:
+        return out
+    for fname in names:
+        if SessionCheckpointer._uuid_of(fname) is None:
+            continue
+        try:
+            with open(os.path.join(dirpath, fname)) as f:
+                out.append(json.load(f))
+        except (OSError, ValueError) as e:
+            obs_log.event(log, "checkpoint_unreadable",
+                          level=logging.WARNING, file=fname,
+                          error=str(e)[:200])
+    return out

@@ -284,6 +284,24 @@ class Federator:
         if self._own_pool:
             self.pool.close()
 
+    def add_target(self, url: str) -> None:
+        """Grow the federation to a scaled-up replica (idempotent); its
+        first pull happens on the next loop tick."""
+        url = url.rstrip("/")
+        with self._lock:
+            if not any(f.url == url for f in self._feeds):
+                self._feeds = self._feeds + [ReplicaFeed(url)]
+
+    def remove_target(self, key: str) -> None:
+        """Drop a scaled-down replica's feed (by url or learned replica
+        id).  Its last-rendered numbers disappear from the federated
+        scrape — deliberate for a scale-DOWN: the replica left the fleet
+        on purpose, unlike a death, which keeps its stale snapshot."""
+        key = str(key).rstrip("/")
+        with self._lock:
+            self._feeds = [f for f in self._feeds
+                           if f.url != key and f.label != key]
+
     def _loop(self) -> None:
         while not self._stop.wait(self.pull_interval_s):
             self.pull_all()
@@ -291,7 +309,9 @@ class Federator:
     # -- pulls --------------------------------------------------------------
 
     def pull_all(self) -> None:
-        for feed in self._feeds:
+        with self._lock:
+            feeds = list(self._feeds)
+        for feed in feeds:
             self._pull_one(feed)
 
     def _pull_one(self, feed: ReplicaFeed) -> None:

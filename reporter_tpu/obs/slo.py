@@ -460,6 +460,24 @@ class SLOEngine:
             return 0.0
         return frac / o.budget_fraction()
 
+    def pair_alerting(self, o: Objective,
+                      now: Optional[float] = None) -> Tuple[bool, Dict[str, float]]:
+        """The multi-window AND gate, reusable outside ``report()`` (the
+        fleet autoscaler steers by exactly this math): for each
+        ``(short, long, factor)`` pair, BOTH windows must burn above the
+        pair's factor for it to page — a burst alone cannot, a slow leak
+        still does.  Returns (alerting, {window_label: burn_rate})."""
+        now = self._clock() if now is None else now
+        burns: Dict[str, float] = {}
+        alerting = False
+        for short_s, long_s, factor in self.burn_pairs:
+            bs = self.burn_rate(o, short_s, now)
+            bl = self.burn_rate(o, long_s, now)
+            burns["%ds" % int(short_s)] = round(bs, 4)
+            burns["%ds" % int(long_s)] = round(bl, 4)
+            alerting = alerting or (bs > factor and bl > factor)
+        return alerting, burns
+
     def _objective_state(self, o: Objective, now: float) -> dict:
         agg = self.window(None, now)
         if o.kind == "latency":
@@ -475,16 +493,7 @@ class SLOEngine:
         else:
             value = self._bad_fraction(o, agg)
             ok = value is None or value <= o.target
-        burns = {}
-        alerting = False
-        for short_s, long_s, factor in self.burn_pairs:
-            bs = self.burn_rate(o, short_s, now)
-            bl = self.burn_rate(o, long_s, now)
-            burns["%ds" % int(short_s)] = round(bs, 4)
-            burns["%ds" % int(long_s)] = round(bl, 4)
-            # multi-window AND gate: both windows must burn above the
-            # pair's factor for this pair to page
-            alerting = alerting or (bs > factor and bl > factor)
+        alerting, burns = self.pair_alerting(o, now)
         budget_remaining = max(0.0, 1.0 - self.burn_rate(o, self.window_s, now))
         out = {
             "name": o.name,

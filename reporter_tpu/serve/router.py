@@ -69,14 +69,18 @@ import argparse
 import hashlib
 import json
 import logging
+import random
+import re
 import threading
 import time as _time
 import urllib.error
+from collections import deque
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional, Tuple
 from urllib.parse import parse_qs, urlencode, urlsplit
 
 from .. import faults
+from ..obs import adaptive as obs_adaptive
 from ..obs import federation as obs_fed
 from ..obs import flight as obs_flight
 from ..obs import log as obs_log
@@ -153,10 +157,20 @@ C_REMAP = obs.counter(
 C_HANDOFF = obs.counter(
     "reporter_router_session_handoffs_total",
     "Per-vehicle session beam handoffs driven by the router (drain "
-    "export -> inheriting-replica import, plus recovery rebalance), by "
-    "outcome (moved / skipped / rebalanced / no_target / export_failed "
-    "/ import_failed; docs/serving-fleet.md \"Beam handoff\")",
+    "export -> inheriting-replica import, plus recovery rebalance and "
+    "the supervisor's checkpoint re-home after a SIGKILL), by outcome "
+    "(moved / skipped / rebalanced / rehomed / no_target / "
+    "export_failed / import_failed; docs/serving-fleet.md \"Beam "
+    "handoff\")",
     ("outcome",))
+C_SCALE = obs.counter(
+    "reporter_fleet_scale_events_total",
+    "Fleet scale events accepted at the router's admin surface (POST "
+    "/fleet {\"add\"|\"remove\"}), by direction (up / down) and the "
+    "caller's reason tag (the autoscaler sends burn_and_queue / idle; "
+    "manual is the default — docs/serving-fleet.md \"Self-driving "
+    "fleet\")",
+    ("direction", "reason"))
 
 
 def rendezvous_score(uuid: str, replica_url: str) -> int:
@@ -189,6 +203,10 @@ class Replica:
         # RECOVERY — the rebalance must fire for it.
         self.handoff_started = False
         self.was_lost = False
+        # per-replica probe schedule (jittered so N replicas are never
+        # probed in lockstep; a draining replica's Retry-After pushes it
+        # back explicitly).  0.0 = due immediately.
+        self.next_probe_at = 0.0
 
     @property
     def label(self) -> str:
@@ -245,6 +263,20 @@ class FleetRouter:
             "REPORTER_ROUTER_EJECT_STREAK", eject_streak, 3)))
         self.eject_s = _resolve_num("REPORTER_ROUTER_EJECT_S", eject_s, 5.0)
         self.hedge_s = _resolve_num("REPORTER_HEDGE_MS", hedge_ms, 0.0) / 1000.0
+        # adaptive hedge threshold (docs/serving-fleet.md "Self-driving
+        # fleet"): with hedging configured AND REPORTER_ADAPTIVE on, the
+        # delay tracks k x the live fleet p95 of the report route
+        # (clamped to [0.1x, 10x] the static knob, hysteresis-damped)
+        # instead of freezing at REPORTER_HEDGE_MS; hedging stays OFF
+        # entirely when the static knob is 0 — the controller retunes a
+        # reflex, it never turns one on
+        self.hedge_k = _resolve_num("REPORTER_ADAPTIVE_HEDGE_K", None, 2.0)
+        self._hedge_ctl = None
+        if self.hedge_s > 0 and obs_adaptive.enabled():
+            self._hedge_ctl = obs_adaptive.Controller(
+                "hedge_s", self.hedge_s,
+                lo=max(0.001, 0.1 * self.hedge_s), hi=10.0 * self.hedge_s,
+                cooldown_s=1.0)
         self.max_inflight = max(1, int(_resolve_num(
             "REPORTER_ROUTER_MAX_INFLIGHT", max_inflight, 256)))
         self.budget_s = _resolve_num(
@@ -274,6 +306,16 @@ class FleetRouter:
         self.federator = obs_fed.Federator(
             [r.url for r in self.replicas], pool=self.pool,
             fleet_engine=self.slo)
+        # probe-phase jitter fraction: each replica's next probe lands at
+        # interval * (1 + U[0, jitter]) so N replicas spread out instead
+        # of being probed in lockstep every tick
+        self.probe_jitter = max(0.0, _resolve_num(
+            "REPORTER_ROUTER_PROBE_JITTER", None, 0.25))
+        self._rng = random.Random()
+        # the autoscale admin ring: every accepted POST /fleet add/remove
+        # (direction, url, reason, epoch), surfaced in /statusz and
+        # tools/fleet_top.py next to the scale-events counter
+        self.scale_events: "deque[dict]" = deque(maxlen=64)
         obs.REGISTRY.register_collect(self._export_fleet_gauges)
 
     def _export_fleet_gauges(self) -> None:
@@ -300,13 +342,31 @@ class FleetRouter:
         self.pool.close()
 
     def _probe_loop(self) -> None:
-        while not self._stop.wait(self.probe_interval_s):
-            self.probe_all()
+        # fine-grained ticks over per-replica schedules: each replica's
+        # next probe is jittered (and a draining Retry-After pushes it
+        # back), so N replicas are probed spread out, never in lockstep
+        tick = max(0.02, self.probe_interval_s / 5.0)
+        while not self._stop.wait(tick):
+            now = _time.monotonic()
+            due = [r for r in self.replicas if now >= r.next_probe_at]
+            for r in due:
+                self._probe_one(r)
+            if due:
+                self._publish_states()
 
     def probe_all(self) -> None:
-        for r in self.replicas:
+        """Probe EVERY replica synchronously, schedules notwithstanding
+        (boot, tests, and admin transitions want a point-in-time view)."""
+        for r in list(self.replicas):
             self._probe_one(r)
         self._publish_states()
+
+    def _schedule_probe(self, r: Replica,
+                        delay_s: Optional[float] = None) -> None:
+        if delay_s is None:
+            delay_s = self.probe_interval_s * (
+                1.0 + self._rng.uniform(0.0, self.probe_jitter))
+        r.next_probe_at = _time.monotonic() + delay_s
 
     def _publish_states(self) -> None:
         counts: Dict[str, int] = {"healthy": 0, "draining": 0,
@@ -317,6 +377,13 @@ class FleetRouter:
             G_REPLICAS.labels(state).set(n)
 
     def _probe_one(self, r: Replica) -> None:
+        """One probe + the next-probe scheduling (jittered default; a
+        draining replica's Retry-After pushes ITS next probe back
+        explicitly instead of ever counting toward the unhealthy
+        streak)."""
+        self._schedule_probe(r, self._probe_once(r))
+
+    def _probe_once(self, r: Replica) -> Optional[float]:
         try:
             status, headers, body = self.pool.request(
                 "GET", r.url + "/health", timeout=self.probe_timeout_s,
@@ -324,7 +391,7 @@ class FleetRouter:
             info = json.loads(body.decode("utf-8")) if body else {}
         except Exception as e:  # noqa: BLE001 - a dead replica is data
             self._probe_failed(r, "unreachable: %s" % (e,))
-            return
+            return None
         rid = headers.get("X-Reporter-Replica") or info.get("replica")
         if rid:
             r.id = str(rid)
@@ -379,13 +446,25 @@ class FleetRouter:
                 r.fail_streak = 0
             return
         if status == 503 and info.get("status") == "draining":
-            # deliberate exit: rotate traffic off, no ejection bookkeeping
+            # deliberate exit: rotate traffic off, no ejection
+            # bookkeeping, no unhealthy streak — and the drainer's
+            # Retry-After is honored as THIS replica's next-probe delay
+            # (it told us when to come back; hammering it mid-drain only
+            # competes with the handoff export)
+            retry_after = None
+            try:
+                raw_ra = headers.get("Retry-After")
+                if raw_ra:
+                    retry_after = max(self.probe_interval_s, float(raw_ra))
+            except (TypeError, ValueError):
+                retry_after = None
             if r.state != "draining":
                 obs_log.event(log, "replica_draining", level=logging.WARNING,
                               replica=r.label, url=r.url)
             r.state = "draining"
             r.was_lost = True
             r.probe_ok_streak = 0
+            r.probe_fail_streak = 0
             if not r.handoff_started:
                 # drain-safe beam handoff: pull the drainer's serialised
                 # sessions while it finishes its inflight work and push
@@ -396,8 +475,9 @@ class FleetRouter:
                 threading.Thread(
                     target=self._handoff_from, args=(r,), daemon=True,
                     name="session-handoff").start()
-            return
+            return retry_after
         self._probe_failed(r, "status %s (%s)" % (status, info.get("status")))
+        return None
 
     def _probe_failed(self, r: Replica, why: str) -> None:
         C_PROBE_FAIL.labels(r.label).inc()
@@ -588,6 +668,133 @@ class FleetRouter:
             obs_log.event(log, "session_rebalance", level=logging.WARNING,
                           replica=r.label, moved=total)
 
+    # -- fleet scaling (docs/serving-fleet.md "Self-driving fleet") ----------
+    #
+    # The router owns the rendezvous ring, so growing/shrinking the fleet
+    # is a router admin operation: the supervisor's autoscaler spawns or
+    # drains the PROCESS and then tells the router via POST /fleet.  A
+    # replica added cold is held out of rotation by the existing warming
+    # hold-out (its /health reports warming until the engine attaches),
+    # so zero requests ever land on an unwarmed replica; its first
+    # healthy transition counts as a recovery, which fires the session
+    # rebalance that pulls its vehicles' beams over.
+
+    @staticmethod
+    def _reason_slug(reason) -> str:
+        return re.sub(r"[^a-zA-Z0-9_.-]", "_",
+                      str(reason or "manual"))[:32] or "manual"
+
+    def add_replica(self, url: str,
+                    reason: str = "manual") -> Tuple[bool, str]:
+        url = url.rstrip("/")
+        with self._lock:
+            if any(r.url == url for r in self.replicas):
+                return False, "replica %s already in the fleet" % url
+            r = Replica(url)
+            # cold entry: not routable until the warming hold-out clears,
+            # and the first healthy transition is a RECOVERY (was_lost)
+            # so the rebalance moves its vehicles' sessions over
+            r.was_lost = True
+            self.replicas = self.replicas + [r]
+        self.federator.add_target(url)
+        reason = self._reason_slug(reason)
+        C_SCALE.labels("up", reason).inc()
+        self.scale_events.append({
+            "t_unix": round(_time.time(), 3), "direction": "up",
+            "url": url, "reason": reason})
+        obs_log.event(log, "fleet_scale", level=logging.WARNING,
+                      direction="up", url=url, reason=reason,
+                      replicas=len(self.replicas))
+        self._probe_one(r)
+        self._publish_states()
+        return True, "added %s (%d replicas)" % (url, len(self.replicas))
+
+    def remove_replica(self, key: str,
+                       reason: str = "manual") -> Tuple[bool, str]:
+        key = str(key).rstrip("/")
+        with self._lock:
+            r = next((x for x in self.replicas
+                      if x.url == key or x.id == key), None)
+            if r is None:
+                return False, "no replica %r in the fleet" % key
+            if len(self.replicas) <= 1:
+                return False, "refusing to remove the last replica"
+            self.replicas = [x for x in self.replicas if x is not r]
+        self.federator.remove_target(r.url)
+        reason = self._reason_slug(reason)
+        C_SCALE.labels("down", reason).inc()
+        self.scale_events.append({
+            "t_unix": round(_time.time(), 3), "direction": "down",
+            "url": r.url, "reason": reason})
+        obs_log.event(log, "fleet_scale", level=logging.WARNING,
+                      direction="down", url=r.url, reason=reason,
+                      replicas=len(self.replicas))
+        self._publish_states()
+        return True, "removed %s (%d replicas)" % (r.url,
+                                                  len(self.replicas))
+
+    def handle_fleet_admin(self, body: dict) -> Tuple[int, dict]:
+        """``POST /fleet``: the scale-event surface.  Body carries
+        ``{"add": "<url>"}`` or ``{"remove": "<url|replica-id>"}`` plus
+        an optional ``"reason"`` tag that rides the scale-events counter
+        and the /statusz ring."""
+        reason = body.get("reason")
+        add = body.get("add")
+        rem = body.get("remove")
+        if isinstance(add, str) and add.strip():
+            ok, msg = self.add_replica(add.strip(), reason)
+        elif isinstance(rem, str) and rem.strip():
+            ok, msg = self.remove_replica(rem.strip(), reason)
+        else:
+            return 400, {"error": "body must carry add: <url> or "
+                                  "remove: <url|replica-id>"}
+        code = 200 if ok else 409
+        _st, fleet = self.fleet()
+        fleet["admin"] = msg
+        fleet["ok"] = ok
+        return code, fleet
+
+    def handle_sessions_import(self, body: dict) -> Tuple[int, dict]:
+        """``POST /sessions`` at the ROUTER: re-home serialised sessions
+        to whichever replica each uuid rendezvous-ranks to now — the
+        supervisor's recovery path for a SIGKILL'd replica's checkpoint
+        files (merge-on-conflict import absorbs any race with the
+        vehicles' own re-streamed points)."""
+        wires = body.get("sessions")
+        if not isinstance(wires, list):
+            return 400, {"error": "sessions must be an array"}
+        # "exclude": the dead replica's id/url — the supervisor calls the
+        # re-home the instant it sees the death, which can be BEFORE the
+        # prober's streak marks the replica unavailable; without the
+        # explicit exclusion the wires would route straight back to the
+        # corpse and stall the whole restore on its timeouts
+        excl = str(body.get("exclude") or "").rstrip("/")
+        groups: Dict[int, Tuple[Replica, List[dict]]] = {}
+        no_target = 0
+        for w in wires:
+            uuid = str((w or {}).get("uuid") or "")
+            order, _ = self.route_order(uuid)
+            if excl:
+                order = [r for r in order
+                         if r.id != excl and r.url != excl]
+            if not order:
+                no_target += 1
+                C_HANDOFF.labels("no_target").inc()
+                continue
+            groups.setdefault(id(order[0]), (order[0], []))[1].append(w)
+        rehomed = 0
+        imported: List[str] = []
+        for target, ws in groups.values():
+            n, us = self._import_sessions_tracked(target, ws, "rehomed")
+            rehomed += n
+            imported.extend(us)
+        if wires:
+            obs_log.event(log, "session_rehome", level=logging.WARNING,
+                          received=len(wires), rehomed=rehomed,
+                          no_target=no_target)
+        return 200, {"received": len(wires), "rehomed": rehomed,
+                     "no_target": no_target, "imported_uuids": imported}
+
     def handle_sessions(self, query: dict) -> Tuple[int, dict]:
         """Router ``GET /sessions``: the fleet's session plane on one
         screen — per-replica store summaries plus fleet totals (the
@@ -664,8 +871,26 @@ class FleetRouter:
             raise_for_status(r.url + path, status, rhdrs, rbody)
         return status, rhdrs, rbody, r
 
+    def current_hedge_s(self) -> float:
+        """The live hedge threshold: static ``REPORTER_HEDGE_MS`` when
+        adaptive control is off (or hedging is off entirely), else k x
+        the fleet's windowed report-route p95 (the router's own
+        client-truth SLO engine, 60 s window), clamped and damped by the
+        controller.  With too little traffic to trust a quantile the
+        controller holds its last value — a thin tail must not yank the
+        reflex around."""
+        ctl = self._hedge_ctl
+        if ctl is None:
+            return self.hedge_s
+        agg = self.slo.window(60.0)
+        if agg.eligible("report") < 32:
+            return ctl.value
+        p95 = agg.quantile(0.95, "report")
+        return ctl.propose(None if p95 is None else self.hedge_k * p95)
+
     def _hedged(self, first: Replica, second: Replica, path: str,
-                body: bytes, headers: dict, note=None):
+                body: bytes, headers: dict, note=None,
+                delay: Optional[float] = None):
         """Race the primary against the next-ranked replica after the
         hedge delay; first SUCCESS wins, a lone failure waits for its
         peer, two failures re-raise the primary's.  ``note`` (the
@@ -707,7 +932,8 @@ class FleetRouter:
         threading.Thread(target=run, args=(first, False), daemon=True,
                          name="hedge-primary").start()
         with cond:
-            cond.wait_for(lambda: results, timeout=self.hedge_s)
+            cond.wait_for(lambda: results,
+                          timeout=self.hedge_s if delay is None else delay)
             if not results:
                 C_HEDGES.inc()
                 threading.Thread(target=run, args=(second, True),
@@ -783,6 +1009,9 @@ class FleetRouter:
         path = "/" + endpoint
         hedge = (self.hedge_s > 0 and len(order) > 1
                  and endpoint == "report")
+        # resolved ONCE per request: the adaptive threshold must not
+        # shift between the race start and its timeout bookkeeping
+        hedge_delay = self.current_hedge_s() if hedge else 0.0
         attempts = {"n": 0}
 
         def attempt(i: int) -> Tuple[int, object, bytes, Replica]:
@@ -790,7 +1019,8 @@ class FleetRouter:
             r = order[i % len(order)]
             if i == 0 and hedge:
                 return self._hedged(order[0], order[1], path, body,
-                                    fwd_headers, note=note_hop)
+                                    fwd_headers, note=note_hop,
+                                    delay=hedge_delay)
             # re-dispatched legs carry the flight-keep hint: the winning
             # replica must retain ITS spans for the stitched trace
             hdrs = fwd_headers if i == 0 else dict(
@@ -882,10 +1112,15 @@ class FleetRouter:
                 "eject_streak": self.eject_streak,
                 "eject_s": self.eject_s,
                 "hedge_ms": round(self.hedge_s * 1000.0, 1),
+                "hedge_effective_ms": round(
+                    self.current_hedge_s() * 1000.0, 1),
+                "adaptive": obs_adaptive.enabled(),
+                "probe_jitter": self.probe_jitter,
                 "max_inflight": self.max_inflight,
                 "budget_s": self.budget_s,
                 "request_timeout_s": self.request_timeout_s,
             },
+            "scale_events": list(self.scale_events),
         }
 
     # -- the fleet observability plane (docs/observability.md) ---------------
@@ -948,6 +1183,16 @@ class FleetRouter:
             "uptime_s": round(_time.time() - self._t_boot, 1),
             "fleet": rows,
             "slo": self.slo.summary(),
+            # the self-driving plane on the one-screen view: current
+            # replica count, the adaptive hedge's live value, and the
+            # recent scale decisions (docs/serving-fleet.md)
+            "autoscale": {
+                "replicas": len(self.replicas),
+                "adaptive": obs_adaptive.enabled(),
+                "hedge_effective_ms": round(
+                    self.current_hedge_s() * 1000.0, 1),
+                "events": list(self.scale_events)[-8:],
+            },
             "masking_debt": self.federator.masking_debt(self.slo),
             "federation": {
                 "pull_interval_s": self.federator.pull_interval_s,
@@ -1222,6 +1467,33 @@ class FleetRouter:
                                   % sorted(ACTIONS)})
                     if action == "health":
                         return self._answer(*router.health())
+                    if action in ("fleet", "sessions") and post:
+                        # the admin surfaces: POST /fleet add/remove (the
+                        # supervisor's scale events) and POST /sessions
+                        # (checkpoint re-home to the inheriting replicas)
+                        n = self._content_length()
+                        if n is None:
+                            return self._answer(
+                                400, {"error": "invalid Content-Length"})
+                        try:
+                            body = json.loads(
+                                self.rfile.read(n).decode("utf-8"))
+                        except OSError as e:
+                            self.close_connection = True
+                            try:
+                                return self._answer(400, {"error": str(e)})
+                            except OSError:
+                                return None
+                        except Exception as e:  # noqa: BLE001
+                            return self._answer(400, {"error": str(e)})
+                        if not isinstance(body, dict):
+                            return self._answer(
+                                400, {"error": "request body must be a "
+                                      "json object"})
+                        handler = (router.handle_fleet_admin
+                                   if action == "fleet"
+                                   else router.handle_sessions_import)
+                        return self._answer(*handler(body))
                     if action == "fleet":
                         return self._answer(*router.fleet())
                     if action == "statusz":

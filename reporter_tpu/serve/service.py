@@ -41,7 +41,8 @@ from urllib.parse import parse_qs, urlsplit
 
 from .. import faults
 from ..matching import MatcherConfig, SegmentMatcher
-from ..matching.session import SessionEngine, SessionStore
+from ..matching.session import SessionCheckpointer, SessionEngine, SessionStore
+from ..obs import adaptive as obs_adaptive
 from ..obs import flight as obs_flight
 from ..obs import log as obs_log
 from ..obs import metrics as obs
@@ -230,7 +231,7 @@ class MicroBatcher:
                  watchdog_s: Optional[float] = None,
                  quarantine_after: Optional[int] = None,
                  quarantine_ttl_s: Optional[float] = None,
-                 on_wedged=None, on_crashed=None):
+                 on_wedged=None, on_crashed=None, name: str = "batch"):
         if max_inflight is None:
             # 4 = measured v5e optimum (hides every dispatch sync quantum
             # and all host association under device compute); when the
@@ -257,6 +258,23 @@ class MicroBatcher:
         # always flow — tracing is always on, one span per request, and
         # ?debug=1 only controls whether the breakdown rides the response
         self._obs = bool(instrument)
+        # adaptive fill window (docs/serving-fleet.md "Self-driving
+        # fleet"): the live windowed p95s of queue wait vs device step
+        # steer max_wait — shrink when queue wait dominates the tail
+        # (holding the window open IS the latency), grow when the device
+        # step dwarfs it and batches still fill (amortisation wins).
+        # Clamped to [0.2x, 4x] the static knob, hysteresis-damped, and
+        # entirely absent with REPORTER_ADAPTIVE=0 (bit-for-bit static).
+        self._wait_ctl = None
+        self._h_qwait = self._h_dstep = None
+        if obs_adaptive.enabled() and self.max_wait > 0:
+            static = self.max_wait
+            self._wait_ctl = obs_adaptive.Controller(
+                "%s_wait_s" % name, static,
+                lo=max(0.0005, 0.2 * static), hi=4.0 * static,
+                cooldown_s=1.0)
+            self._h_qwait = obs_adaptive.WindowedQuantile(window_s=30.0)
+            self._h_dstep = obs_adaptive.WindowedQuantile(window_s=60.0)
         # fault-domain knobs (docs/robustness.md), env-overridable so a
         # deployment can retune without a config rollout.  deadline_ms<=0
         # disables the server default (client-sent deadlines still apply);
@@ -335,6 +353,34 @@ class MicroBatcher:
         futures = [self.submit(t, deadline=deadline) for t in traces]
         return [f.result() for f in futures]
 
+    def _adapt_wait(self, fill: int) -> None:
+        """One adaptive-control tick for the fill window (no-op with
+        REPORTER_ADAPTIVE=0).  Signals are the live windowed p95s:
+
+          * queue wait dominating the device step means holding the
+            window open IS the client-visible tail — shrink it;
+          * a device step that dwarfs both the wait and the queue tail,
+            on batches that actually fill, means per-dispatch
+            amortisation is the win — grow it.
+
+        The Controller clamps to [0.2x, 4x] the static knob, ignores
+        in-deadband noise, and rate-limits moves, so short tests and
+        steady traffic never see the knob move."""
+        ctl = self._wait_ctl
+        if ctl is None:
+            return
+        if self._h_qwait.count() < 32 or self._h_dstep.count() < 8:
+            return  # not enough live signal to steer by
+        q95 = self._h_qwait.quantile(0.95)
+        d95 = self._h_dstep.quantile(0.95)
+        if q95 is None or d95 is None:
+            return
+        if q95 > 2.0 * d95 and q95 > self.max_wait:
+            self.max_wait = ctl.propose(0.7 * self.max_wait)
+        elif d95 > 4.0 * max(q95, self.max_wait) \
+                and fill >= max(2, self.max_batch // 2):
+            self.max_wait = ctl.propose(1.3 * self.max_wait)
+
     def retry_after_s(self) -> int:
         """Backoff hint for shed (429) responses: deeper queue, longer
         hint, capped so clients re-probe within their retry budget."""
@@ -399,11 +445,15 @@ class MicroBatcher:
             # deadline scrub BEFORE dispatch: an entry whose budget died in
             # the queue answers 504 now and never wastes a device slot (its
             # client has already given up; matching it would starve the
-            # still-live requests behind it)
+            # still-live requests behind it).  The chaos clock_skew point
+            # scales each entry's ELAPSED time (factor 1.0 disarmed, so
+            # the comparison is bit-identical without it).
+            skew = faults.scale("clock_skew")
             live = []
             for e_ in batch:
                 dl = e_[4]
-                if dl is not None and now > dl:
+                eff = now if skew == 1.0 else e_[2] + (now - e_[2]) * skew
+                if dl is not None and eff > dl:
                     C_EXPIRED.inc()
                     self._resolve_exc(e_[1], DeadlineExpired(
                         "deadline expired after %.3fs in queue"
@@ -428,9 +478,12 @@ class MicroBatcher:
                 if self._obs:
                     M_QUEUE_WAIT.observe(
                         wait, exemplar=sp.trace_id if sp else None)
+                if self._h_qwait is not None:
+                    self._h_qwait.observe(wait)
                 if sp is not None:
                     sp.mark("queue_wait_s", wait)
                     sp.meta["batch_size"] = len(batch)
+            self._adapt_wait(len(batch))
             try:
                 t_d0 = _time.monotonic()
                 with obs_trace.bind(lead):
@@ -471,6 +524,8 @@ class MicroBatcher:
                 with self._watched(batch):
                     results = finish()
                 step_s = _time.monotonic() - t0
+                if self._h_dstep is not None:
+                    self._h_dstep.observe(step_s)
                 if self._obs:
                     lead = next(
                         (e[3] for e in batch if e[3] is not None), None)
@@ -721,6 +776,24 @@ class ReporterService:
         self._reattach_probe_s = _resolve_num(
             "REPORTER_REATTACH_PROBE_S", rb.pop("reattach_probe_s", None),
             15.0)
+        # preemption-tolerant sessions (docs/serving-fleet.md
+        # "Self-driving fleet"): dirty session wire-state checkpointed to
+        # atomic per-uuid files so a SIGKILL'd replica's beams re-home
+        # from disk.  Off unless a cadence AND a directory are set (the
+        # fleet supervisor sets both for its children).
+        self._ckpt_s = _resolve_num(
+            "REPORTER_SESSION_CHECKPOINT_S",
+            rb.pop("session_checkpoint_s", None), 0.0)
+        sync_raw = os.environ.get("REPORTER_SESSION_CHECKPOINT_SYNC", "")
+        self._ckpt_sync = (sync_raw.strip().lower()
+                           not in ("", "0", "off", "false", "no")
+                           if sync_raw.strip()
+                           else bool(rb.pop("session_checkpoint_sync",
+                                            False)))
+        self._ckpt_dir = (
+            os.environ.get("REPORTER_SESSION_CHECKPOINT_DIR", "").strip()
+            or rb.pop("session_checkpoint_dir", None))
+        self.session_checkpointer: Optional[SessionCheckpointer] = None
         self._robust_params = {
             k: rb[k] for k in ("max_queue", "deadline_ms", "watchdog_s",
                                "quarantine_after", "quarantine_ttl_s")
@@ -757,6 +830,12 @@ class ReporterService:
         self._cpu_matcher = None
         self._cpu_lock = threading.Lock()
         self.unhealthy_reason: Optional[str] = None
+        # stable replica identity resolved BEFORE attach (the session
+        # checkpointer's directory is keyed on it); echoed as
+        # X-Reporter-Replica on every response
+        self.replica_id = (
+            os.environ.get("REPORTER_REPLICA_ID", "").strip()
+            or "%s-%d" % (_socket.gethostname()[:32], os.getpid()))
         if matcher is not None:
             self.attach_matcher(matcher)
         self._t_boot = _time.time()
@@ -777,14 +856,6 @@ class ReporterService:
         # listener down, which is what "finish inflight batches" means
         self._active_lock = threading.Lock()
         self._n_active = 0
-        # stable replica identity, echoed as X-Reporter-Replica on EVERY
-        # response: the router's affinity bookkeeping and loadgen's
-        # per-replica distribution both key on it.  REPORTER_REPLICA_ID
-        # pins it (tools/fleet.py does); the default is unique per process
-        # and stable for its lifetime.
-        self.replica_id = (
-            os.environ.get("REPORTER_REPLICA_ID", "").strip()
-            or "%s-%d" % (_socket.gethostname()[:32], os.getpid()))
 
     def begin_drain(self) -> None:
         """Enter graceful drain (idempotent): refuse new matching work,
@@ -831,6 +902,15 @@ class ReporterService:
             self.session_store = SessionStore(
                 max_sessions=int(getattr(matcher.cfg, "max_sessions", 65536)),
                 ttl_s=float(getattr(matcher.cfg, "session_ttl_s", 3600.0)))
+            if self._ckpt_s > 0 and self._ckpt_dir:
+                # per-replica subdirectory: one shared fleet workdir, one
+                # owned directory per replica id (the supervisor re-homes
+                # exactly the dead replica's files)
+                self.session_checkpointer = SessionCheckpointer(
+                    self.session_store,
+                    os.path.join(self._ckpt_dir, self.replica_id),
+                    cadence_s=self._ckpt_s, sync=self._ckpt_sync)
+                self.session_checkpointer.start()
         self.session_engine = SessionEngine(
             matcher, self.session_store,
             tail_points=int(getattr(matcher.cfg, "session_tail_points", 64)))
@@ -852,7 +932,7 @@ class ReporterService:
         loud loops) over the SessionEngine instead of the raw matcher."""
         return MicroBatcher(
             self.session_engine, **self._session_params,
-            **self._robust_params,
+            **self._robust_params, name="session",
             on_wedged=self._enter_degraded, on_crashed=self._note_crash)
 
     # -- fault domains: degraded mode + re-attach --------------------------
@@ -1339,6 +1419,11 @@ class ReporterService:
                 return 404, {"error": "no session for uuid %r" % uuid}
             return 200, dict(s.meta(), replica=self.replica_id)
         if query.get("export", ["0"])[0] not in ("", "0", "false"):
+            # chaos seam: a crawling drain — the beam-handoff export
+            # stalls while the router's handoff retries wait it out
+            # (docs/robustness.md; the overload rehearsal arms it to
+            # prove scale-down never loses a beam)
+            faults.hang("slow_drain")
             if self.draining:
                 # the handoff race: steps admitted before drain-begin may
                 # still be committing — snapshot only once the report
@@ -1499,6 +1584,21 @@ class ReporterService:
             # the session plane: open per-vehicle sessions + folded points
             "sessions": (self.session_store.summary()
                          if self.session_store is not None else None),
+            # the adaptive-control plane (docs/serving-fleet.md
+            # "Self-driving fleet"): live effective knob values next to
+            # their static configuration; None = that controller is off
+            "adaptive": {
+                "enabled": obs_adaptive.enabled(),
+                "batch_wait_s": (round(b.max_wait, 5)
+                                 if b is not None else None),
+                "session_wait_s": (
+                    round(self.session_batcher.max_wait, 5)
+                    if self.session_batcher is not None else None),
+            },
+            # the preemption plane: checkpoint dir/cadence/dirty backlog
+            "checkpoint": (self.session_checkpointer.summary()
+                           if self.session_checkpointer is not None
+                           else None),
             "metrics": obs.REGISTRY.snapshot(),
         }
 

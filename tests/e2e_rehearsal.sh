@@ -10,9 +10,9 @@
 # Usage: tests/e2e_rehearsal.sh [workdir]
 set -euo pipefail
 
-cd "$(dirname "$0")/.."
-export PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}"
-export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+# shared spawn/trap/cleanup/wait helpers (tests/rehearsal_lib.sh)
+. "$(dirname "$0")/rehearsal_lib.sh"
+reh_init "${1:-}" reporter-e2e
 # the rehearsal service runs the SHARDED matcher (devices=2 in the config
 # below) on a virtual 2-device CPU mesh — the integrated mesh path must
 # survive the full pipeline, not just unit tests (VERDICT r03 next #4)
@@ -20,7 +20,6 @@ if [[ "${XLA_FLAGS:-}" != *xla_force_host_platform_device_count* ]]; then
     export XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=2"
 fi
 
-WORK="${1:-$(mktemp -d /tmp/reporter-e2e.XXXXXX)}"
 PORT=18021
 mkdir -p "$WORK/results" "$WORK/archive" "$WORK/batch_out"
 echo "rehearsal workdir: $WORK"
@@ -67,36 +66,15 @@ EOF
 python -m reporter_tpu.serve "$WORK/config.json" "127.0.0.1:$PORT" \
     > "$WORK/serve.log" 2>&1 &
 SERVE_PID=$!
-# trap-based cleanup on EVERY exit path, with SIGKILL escalation: a
-# failed leg must not strand the listener to poison later CI legs on the
-# same runner
-cleanup() {
-    kill "$SERVE_PID" 2>/dev/null || true
-    for _ in $(seq 1 20); do
-        kill -0 "$SERVE_PID" 2>/dev/null || break
-        sleep 0.5
-    done
-    kill -9 "$SERVE_PID" 2>/dev/null || true
-    wait "$SERVE_PID" 2>/dev/null || true
-}
-trap cleanup EXIT
+# cleanup on EVERY exit path, with SIGKILL escalation, via the shared
+# lib trap: a failed leg must not strand the listener to poison later
+# CI legs on the same runner
+reh_track "$SERVE_PID"
 
-UP=0
-for _ in $(seq 1 120); do
-    # the socket binds before the engine builds (deferred boot): readiness
-    # is /health reporting an attached engine (backend non-null) — NOT
-    # warming false, which would also gate on the full shape-compile set
-    python - <<EOF && UP=1 && break || sleep 1
-import json, sys, urllib.request
-try:
-    h = json.load(urllib.request.urlopen(
-        "http://127.0.0.1:$PORT/health", timeout=2))
-except Exception:
-    sys.exit(1)
-sys.exit(0 if h.get("status") == "ok" and h.get("backend") else 1)
-EOF
-done
-if [ "$UP" != 1 ]; then
+# the socket binds before the engine builds (deferred boot): readiness
+# is /health reporting an attached engine (backend non-null) — NOT
+# warming false, which would also gate on the full shape-compile set
+if ! reh_wait_replica "http://127.0.0.1:$PORT" 120; then
     echo "FAIL: service never started; tail of serve.log:"
     tail -20 "$WORK/serve.log"
     exit 1

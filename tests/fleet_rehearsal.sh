@@ -44,9 +44,8 @@
 # Usage: tests/fleet_rehearsal.sh [workdir]
 set -euo pipefail
 
-cd "$(dirname "$0")/.."
-export PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}"
-export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+# shared spawn/trap/cleanup/wait helpers (tests/rehearsal_lib.sh)
+. "$(dirname "$0")/rehearsal_lib.sh"
 # snappy failover in the router's retry loop (the default backoff base is
 # tuned for WAN egress, not a localhost rehearsal)
 export REPORTER_RETRY_BASE_S="${REPORTER_RETRY_BASE_S:-0.05}"
@@ -74,45 +73,11 @@ export REPORTER_FAULT_ROUTER_CONNECT="refused:1"
 # rotate traffic off so fast that no organic drain refusal ever occurs)
 export REPORTER_FAULT_REPLICA_SHED="1"
 # replicas 2..N replay replica 1's XLA compiles instead of redoing them
-WORK="${1:-$(mktemp -d /tmp/reporter-fleet.XXXXXX)}"
-mkdir -p "$WORK"
+reh_init "${1:-}" reporter-fleet
 export REPORTER_XLA_CACHE_DIR="$WORK/xla-cache"
 ROUTER_PORT=18071
 BASE_PORT=18072
 echo "fleet rehearsal workdir: $WORK"
-
-# ---- trap-based cleanup: NO exit path may strand a listener ---------------
-FLEET_PID=""
-WATCHER_PID=""
-cleanup() {
-    if [ -n "$WATCHER_PID" ]; then
-        kill -9 "$WATCHER_PID" 2>/dev/null || true
-    fi
-    if [ -n "$FLEET_PID" ] && kill -0 "$FLEET_PID" 2>/dev/null; then
-        kill "$FLEET_PID" 2>/dev/null || true
-        for _ in $(seq 1 40); do
-            kill -0 "$FLEET_PID" 2>/dev/null || break
-            sleep 0.5
-        done
-        kill -9 "$FLEET_PID" 2>/dev/null || true
-    fi
-    # belt-and-braces: any replica/router pid still in the state file
-    if [ -f "$WORK/fleet.json" ]; then
-        python - "$WORK/fleet.json" <<'EOF' 2>/dev/null || true
-import json, os, signal, sys
-state = json.load(open(sys.argv[1]))
-pids = [state.get("router", {}).get("pid")] + [
-    r.get("pid") for r in state.get("replicas", [])]
-for pid in pids:
-    if pid:
-        try:
-            os.kill(pid, signal.SIGKILL)
-        except OSError:
-            pass
-EOF
-    fi
-}
-trap cleanup EXIT
 
 # ---- config (grid must match loadgen --grid; one length bucket keeps the
 # --warmup grid small enough for CI) ----------------------------------------
@@ -133,32 +98,12 @@ python tools/fleet.py --config "$WORK/config.json" --replicas 3 \
     --workdir "$WORK" --warmup --cpu-default --drain-grace 20 \
     > "$WORK/fleet.log" 2>&1 &
 FLEET_PID=$!
+reh_track_fleet "$FLEET_PID" "$WORK"
 
-if ! python - <<EOF
-import json, sys, time, urllib.request
-
-def up(url, need_backend):
-    try:
-        h = json.load(urllib.request.urlopen(url + "/health", timeout=2))
-    except Exception:
-        return False
-    if need_backend:
-        # deferred boot answers 200 while the engine is still attaching:
-        # readiness for the LOAD run is an attached backend, else the
-        # replay's head just measures "service initialising" 503s
-        return h.get("status") == "ok" and bool(h.get("backend"))
-    return h.get("available") == 3
-
-deadline = time.monotonic() + 600
-replicas = ["http://127.0.0.1:%d" % ($BASE_PORT + i) for i in range(3)]
-while time.monotonic() < deadline:
-    if (all(up(u, True) for u in replicas)
-            and up("http://127.0.0.1:$ROUTER_PORT", False)):
-        sys.exit(0)
-    time.sleep(1)
-sys.exit(1)
-EOF
-then
+# deferred boot answers 200 while the engine is still attaching:
+# readiness for the LOAD run is an attached backend, else the replay's
+# head just measures "service initialising" 503s
+if ! reh_wait_fleet "http://127.0.0.1:$ROUTER_PORT" 3 "$BASE_PORT" 3 600; then
     echo "FAIL: fleet never reached 3 available replicas; fleet log tail:"
     tail -30 "$WORK/fleet.log"
     for f in "$WORK"/replica-*.log "$WORK"/router.log; do
@@ -310,6 +255,7 @@ while True:
     time.sleep(0.05)
 EOF
 WATCHER_PID=$!
+reh_track_watcher "$WATCHER_PID"
 
 # ---- open-loop replay against the ROUTER, chaos mid-load ------------------
 python tools/loadgen.py --url "http://127.0.0.1:$ROUTER_PORT" \
@@ -359,8 +305,7 @@ echo "loadgen SLO verdict: PASS (rc 0) under kill + rolling restart"
 echo "  (incl. --server-slo: the router's client-truth fleet verdict agrees)"
 
 # ---- fleet plane: staleness, masking debt, stitched failover trace --------
-kill -9 "$WATCHER_PID" 2>/dev/null || true
-WATCHER_PID=""
+reh_untrack_watchers
 python - "$WORK" "http://127.0.0.1:$ROUTER_PORT" <<'EOF'
 import json, sys, urllib.request
 
@@ -452,15 +397,5 @@ print("failover window clean; %d/%d of the dead replica's vehicles "
 EOF
 
 # ---- graceful fleet drain: exit 0, nothing stranded -----------------------
-kill "$FLEET_PID"
-set +e
-wait "$FLEET_PID"
-FLEET_RC=$?
-set -e
-FLEET_PID=""
-if [ "$FLEET_RC" != 0 ]; then
-    echo "FAIL: fleet supervisor exited rc $FLEET_RC on drain; log tail:"
-    tail -30 "$WORK/fleet.log"
-    exit 1
-fi
+reh_stop_fleet
 echo "fleet rehearsal OK (artifacts in $WORK)"

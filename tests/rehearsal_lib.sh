@@ -1,0 +1,166 @@
+# tests/rehearsal_lib.sh — the spawn/trap/cleanup/wait boilerplate every
+# rehearsal shares (fleet / slo / session / e2e / overload), factored so
+# a new leg cannot re-invent a cleanup path that strands a listener.
+#
+# Source AFTER `set -euo pipefail`:
+#
+#   . "$(dirname "$0")/rehearsal_lib.sh"
+#   reh_init "${1:-}" reporter-myleg     # cds to repo root, sets $WORK,
+#                                        # installs the EXIT cleanup trap
+#   reh_track "$PID"                     # plain child: TERM, wait, KILL
+#   reh_track_watcher "$PID"             # sampler loop: KILL immediately
+#   reh_track_fleet "$PID" "$WORK"       # tools/fleet.py supervisor: TERM
+#                                        # + escalation + fleet.json pid
+#                                        # sweep (router/replica strays)
+#   reh_wait_replica URL TRIES [warmed]  # /health 200 + attached backend
+#                                        # (+ warmup finished with arg 3)
+#   reh_wait_fleet ROUTER_URL N BASE_PORT COUNT TRIES [warmed]
+#                                        # every replica attached AND the
+#                                        # router reporting N available
+#
+# Every tracked pid is cleaned on EVERY exit path with SIGKILL
+# escalation — a failed leg must not poison later CI legs on the same
+# runner.
+
+REH_PIDS=()
+REH_WATCHER_PIDS=()
+REH_FLEET_PID=""
+REH_FLEET_WORK=""
+
+reh_init() {
+    cd "$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
+    export PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}"
+    export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+    local prefix="${2:-reporter-rehearsal}"
+    WORK="${1:-$(mktemp -d "/tmp/${prefix}.XXXXXX")}"
+    mkdir -p "$WORK"
+    trap reh_cleanup EXIT
+}
+
+reh_track() { REH_PIDS+=("$1"); }
+reh_track_watcher() { REH_WATCHER_PIDS+=("$1"); }
+reh_track_fleet() { REH_FLEET_PID="$1"; REH_FLEET_WORK="$2"; }
+
+reh_untrack_watchers() {
+    local pid
+    for pid in ${REH_WATCHER_PIDS[@]+"${REH_WATCHER_PIDS[@]}"}; do
+        kill -9 "$pid" 2>/dev/null || true
+    done
+    REH_WATCHER_PIDS=()
+}
+
+# Gracefully stop the tracked fleet supervisor and REQUIRE exit 0
+# (the drain contract); clears the tracking so reh_cleanup skips it.
+reh_stop_fleet() {
+    [ -n "$REH_FLEET_PID" ] || return 0
+    local pid="$REH_FLEET_PID" rc
+    kill "$pid" 2>/dev/null || true
+    set +e
+    wait "$pid"
+    rc=$?
+    set -e
+    REH_FLEET_PID=""
+    if [ "$rc" != 0 ]; then
+        echo "FAIL: fleet supervisor exited rc $rc on drain; log tail:"
+        tail -30 "$REH_FLEET_WORK/fleet.log" 2>/dev/null || true
+        return 1
+    fi
+    return 0
+}
+
+reh_cleanup() {
+    local pid
+    reh_untrack_watchers
+    if [ -n "$REH_FLEET_PID" ] && kill -0 "$REH_FLEET_PID" 2>/dev/null; then
+        kill "$REH_FLEET_PID" 2>/dev/null || true
+        for _ in $(seq 1 40); do
+            kill -0 "$REH_FLEET_PID" 2>/dev/null || break
+            sleep 0.5
+        done
+        kill -9 "$REH_FLEET_PID" 2>/dev/null || true
+    fi
+    # belt-and-braces: any replica/router pid still in the state file
+    if [ -n "$REH_FLEET_WORK" ] && [ -f "$REH_FLEET_WORK/fleet.json" ]; then
+        python - "$REH_FLEET_WORK/fleet.json" <<'EOF' 2>/dev/null || true
+import json, os, signal, sys
+state = json.load(open(sys.argv[1]))
+pids = [state.get("router", {}).get("pid")] + [
+    r.get("pid") for r in state.get("replicas", [])]
+for pid in pids:
+    if pid:
+        try:
+            os.kill(pid, signal.SIGKILL)
+        except OSError:
+            pass
+EOF
+    fi
+    for pid in ${REH_PIDS[@]+"${REH_PIDS[@]}"}; do
+        kill "$pid" 2>/dev/null || true
+    done
+    for pid in ${REH_PIDS[@]+"${REH_PIDS[@]}"}; do
+        for _ in $(seq 1 20); do
+            kill -0 "$pid" 2>/dev/null || break
+            sleep 0.5
+        done
+        kill -9 "$pid" 2>/dev/null || true
+        wait "$pid" 2>/dev/null || true
+    done
+}
+
+# reh_wait_replica URL TRIES [warmed] — /health 200 "ok" with an
+# attached backend; pass a third arg to also require the warmup pass to
+# have finished (warming false)
+reh_wait_replica() {
+    local url="$1" tries="$2" warmed="${3:-}"
+    for _ in $(seq 1 "$tries"); do
+        REH_URL="$url" REH_WARMED="$warmed" python - <<'EOF' && return 0 || sleep 1
+import json, os, sys, urllib.request
+try:
+    h = json.load(urllib.request.urlopen(
+        os.environ["REH_URL"] + "/health", timeout=2))
+except Exception:
+    sys.exit(1)
+ok = h.get("status") == "ok" and bool(h.get("backend"))
+if os.environ.get("REH_WARMED"):
+    ok = ok and not h.get("warming")
+sys.exit(0 if ok else 1)
+EOF
+    done
+    return 1
+}
+
+# reh_wait_fleet ROUTER_URL N_AVAILABLE BASE_PORT COUNT TRIES [warmed]
+# — every replica on BASE_PORT..BASE_PORT+COUNT-1 attached (and warmed
+# with arg 6), and the router reporting N_AVAILABLE available
+reh_wait_fleet() {
+    local router="$1" n="$2" base="$3" count="$4" tries="$5" warmed="${6:-}"
+    REH_ROUTER="$router" REH_N="$n" REH_BASE="$base" REH_COUNT="$count" \
+        REH_TRIES="$tries" REH_WARMED="$warmed" python - <<'EOF'
+import json, os, sys, time, urllib.request
+
+router = os.environ["REH_ROUTER"]
+n = int(os.environ["REH_N"])
+base = int(os.environ["REH_BASE"])
+count = int(os.environ["REH_COUNT"])
+tries = int(os.environ["REH_TRIES"])
+warmed = bool(os.environ.get("REH_WARMED"))
+
+def up(url, need_backend):
+    try:
+        h = json.load(urllib.request.urlopen(url + "/health", timeout=2))
+    except Exception:
+        return False
+    if need_backend:
+        ok = h.get("status") == "ok" and bool(h.get("backend"))
+        return ok and not (warmed and h.get("warming"))
+    return h.get("available") == n
+
+replicas = ["http://127.0.0.1:%d" % (base + i) for i in range(count)]
+deadline = time.monotonic() + tries
+while time.monotonic() < deadline:
+    if all(up(u, True) for u in replicas) and up(router, False):
+        sys.exit(0)
+    time.sleep(1)
+sys.exit(1)
+EOF
+}

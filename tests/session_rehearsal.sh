@@ -39,9 +39,8 @@
 # Usage: tests/session_rehearsal.sh [workdir]
 set -euo pipefail
 
-cd "$(dirname "$0")/.."
-export PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}"
-export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+# shared spawn/trap/cleanup/wait helpers (tests/rehearsal_lib.sh)
+. "$(dirname "$0")/rehearsal_lib.sh"
 export REPORTER_RETRY_BASE_S="${REPORTER_RETRY_BASE_S:-0.05}"
 # snappy probing: the drain window is short, the handoff rides the probe
 export REPORTER_ROUTER_PROBE_S="${REPORTER_ROUTER_PROBE_S:-0.25}"
@@ -55,39 +54,11 @@ export REPORTER_SLO_P99_MS=8000
 export REPORTER_SLO_P999_MS=0
 export REPORTER_SLO_DEGRADED_FRAC=0
 export REPORTER_SLO_STREAM_P99_MS=2500
-WORK="${1:-$(mktemp -d /tmp/reporter-session.XXXXXX)}"
-mkdir -p "$WORK"
+reh_init "${1:-}" reporter-session
 export REPORTER_XLA_CACHE_DIR="$WORK/xla-cache"
 ROUTER_PORT=18081
 BASE_PORT=18082
 echo "session rehearsal workdir: $WORK"
-
-FLEET_PID=""
-cleanup() {
-    if [ -n "$FLEET_PID" ] && kill -0 "$FLEET_PID" 2>/dev/null; then
-        kill "$FLEET_PID" 2>/dev/null || true
-        for _ in $(seq 1 40); do
-            kill -0 "$FLEET_PID" 2>/dev/null || break
-            sleep 0.5
-        done
-        kill -9 "$FLEET_PID" 2>/dev/null || true
-    fi
-    if [ -f "$WORK/fleet.json" ]; then
-        python - "$WORK/fleet.json" <<'EOF' 2>/dev/null || true
-import json, os, signal, sys
-state = json.load(open(sys.argv[1]))
-pids = [state.get("router", {}).get("pid")] + [
-    r.get("pid") for r in state.get("replicas", [])]
-for pid in pids:
-    if pid:
-        try:
-            os.kill(pid, signal.SIGKILL)
-        except OSError:
-            pass
-EOF
-    fi
-}
-trap cleanup EXIT
 
 cat > "$WORK/config.json" <<EOF
 {
@@ -108,30 +79,9 @@ python tools/fleet.py --config "$WORK/config.json" --replicas 3 \
     --workdir "$WORK" --warmup --cpu-default --drain-grace 20 \
     > "$WORK/fleet.log" 2>&1 &
 FLEET_PID=$!
+reh_track_fleet "$FLEET_PID" "$WORK"
 
-if ! python - <<EOF
-import json, sys, time, urllib.request
-
-def up(url, need_backend):
-    try:
-        h = json.load(urllib.request.urlopen(url + "/health", timeout=2))
-    except Exception:
-        return False
-    if need_backend:
-        return h.get("status") == "ok" and bool(h.get("backend")) \
-            and not h.get("warming")
-    return h.get("available") == 3
-
-deadline = time.monotonic() + 600
-replicas = ["http://127.0.0.1:%d" % ($BASE_PORT + i) for i in range(3)]
-while time.monotonic() < deadline:
-    if (all(up(u, True) for u in replicas)
-            and up("http://127.0.0.1:$ROUTER_PORT", False)):
-        sys.exit(0)
-    time.sleep(1)
-sys.exit(1)
-EOF
-then
+if ! reh_wait_fleet "http://127.0.0.1:$ROUTER_PORT" 3 "$BASE_PORT" 3 600 warmed; then
     echo "FAIL: fleet never reached 3 warmed replicas; fleet log tail:"
     tail -30 "$WORK/fleet.log"
     for f in "$WORK"/replica-*.log "$WORK"/router.log; do
@@ -262,15 +212,5 @@ print("per-point p99: stream %.1f ms vs windowed-rebatch %.1f ms "
 EOF
 
 # ---- graceful fleet drain: exit 0, nothing stranded -----------------------
-kill "$FLEET_PID"
-set +e
-wait "$FLEET_PID"
-FLEET_RC=$?
-set -e
-FLEET_PID=""
-if [ "$FLEET_RC" != 0 ]; then
-    echo "FAIL: fleet supervisor exited rc $FLEET_RC on drain; log tail:"
-    tail -30 "$WORK/fleet.log"
-    exit 1
-fi
+reh_stop_fleet
 echo "session rehearsal OK (artifacts in $WORK)"

@@ -24,35 +24,15 @@
 # Usage: tests/slo_rehearsal.sh [workdir]
 set -euo pipefail
 
-cd "$(dirname "$0")/.."
-export PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}"
-export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
-
-WORK="${1:-$(mktemp -d /tmp/reporter-slo.XXXXXX)}"
-mkdir -p "$WORK"
+# shared spawn/trap/cleanup/wait helpers (tests/rehearsal_lib.sh): every
+# spawned server is tracked and cleaned on EVERY exit path with SIGKILL
+# escalation — a failed leg must not strand a listener that poisons
+# later CI legs on the same runner
+. "$(dirname "$0")/rehearsal_lib.sh"
+reh_init "${1:-}" reporter-slo
 PORT=18061
 PORT2=18062
 echo "slo rehearsal workdir: $WORK"
-
-# trap-based cleanup covering EVERY spawned server on EVERY exit path: a
-# failed leg must not strand a listener that poisons later CI legs on the
-# same runner (the old single-variable trap only covered the most recent
-# server, and never escalated past SIGTERM)
-PIDS=()
-cleanup() {
-    for pid in "${PIDS[@]}"; do
-        kill "$pid" 2>/dev/null || true
-    done
-    for pid in "${PIDS[@]}"; do
-        for _ in $(seq 1 20); do
-            kill -0 "$pid" 2>/dev/null || break
-            sleep 0.5
-        done
-        kill -9 "$pid" 2>/dev/null || true
-        wait "$pid" 2>/dev/null || true
-    done
-}
-trap cleanup EXIT
 
 # one length bucket (every loadgen window is 16 points) keeps the warmup
 # grid small enough that --warmup boots in CI time
@@ -74,29 +54,13 @@ LOADGEN_ARGS=(
     --slo-availability 0.95 --slo-p99-ms 8000
 )
 
-wait_up() {
-    local port=$1 tries=$2
-    for _ in $(seq 1 "$tries"); do
-        python - <<EOF && return 0 || sleep 1
-import json, sys, urllib.request
-try:
-    h = json.load(urllib.request.urlopen(
-        "http://127.0.0.1:$port/health", timeout=2))
-except Exception:
-    sys.exit(1)
-sys.exit(0 if h.get("status") == "ok" and h.get("backend") else 1)
-EOF
-    done
-    return 1
-}
-
 # ---- leg 1: no fault — objectives hold, verdicts agree -------------------
 echo "== leg 1: no-fault (warmed serve, verdicts must agree) =="
 python -m reporter_tpu.serve --warmup "$WORK/config.json" "127.0.0.1:$PORT" \
     > "$WORK/serve_nofault.log" 2>&1 &
 SERVE_PID=$!
-PIDS+=("$SERVE_PID")
-if ! wait_up "$PORT" 240; then
+reh_track "$SERVE_PID"
+if ! reh_wait_replica "http://127.0.0.1:$PORT" 240; then
     echo "FAIL: no-fault service never came up; tail of serve log:"
     tail -20 "$WORK/serve_nofault.log"
     exit 1
@@ -122,8 +86,8 @@ REPORTER_FAULT_DEVICE_HANG="2.5" \
 python -m reporter_tpu.serve "$WORK/config.json" "127.0.0.1:$PORT2" \
     > "$WORK/serve_hang.log" 2>&1 &
 SERVE_PID=$!
-PIDS+=("$SERVE_PID")
-if ! wait_up "$PORT2" 240; then
+reh_track "$SERVE_PID"
+if ! reh_wait_replica "http://127.0.0.1:$PORT2" 240; then
     echo "FAIL: hang-leg service never came up; tail of serve log:"
     tail -20 "$WORK/serve_hang.log"
     exit 1

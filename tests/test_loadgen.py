@@ -425,3 +425,67 @@ def test_main_stream_scenario_per_point_samples(loadgen, tmp_path):
     # the baseline's per-point latency includes the window-fill wait, so
     # its p50 must exceed the per-point path's against the same stub
     assert art2["quantiles"]["p50_ms"] > art["quantiles"]["p50_ms"]
+
+
+def test_profile_schedules_flash_and_diurnal(loadgen):
+    import random
+
+    rng = random.Random(1)
+    # flash: the burst window carries ~mult x the baseline arrival rate
+    s = loadgen.profile_schedule(20.0, 10.0, "flash:0.3:0.7:5",
+                                 "poisson", rng)
+    mid = sum(1 for t in s if 3.0 <= t < 7.0) / 4.0
+    edge = sum(1 for t in s if t < 3.0 or t >= 7.0) / 6.0
+    assert mid > 3.0 * edge
+    assert all(0.0 <= t < 10.0 for t in s)
+    # diurnal: a deterministic (uniform) schedule starts at the trough
+    # (sparse arrivals) and peaks mid-run (dense arrivals)
+    d = loadgen.profile_schedule(20.0, 10.0, "diurnal", "uniform", rng)
+    gaps_start = d[1] - d[0]
+    mid_i = min(range(len(d)), key=lambda i: abs(d[i] - 5.0))
+    gaps_mid = d[mid_i + 1] - d[mid_i]
+    assert gaps_mid < gaps_start / 2.0
+    with pytest.raises(ValueError):
+        loadgen.profile_rate_fn("flash:bad", 1.0, 1.0)
+    with pytest.raises(ValueError):
+        loadgen.profile_rate_fn("nope", 1.0, 1.0)
+
+
+def test_skewed_requests_concentrate_and_preserve_order(loadgen):
+    import random
+
+    rng = random.Random(2)
+    per_uuid = [("veh-%d" % i,
+                 [{"uuid": "veh-%d" % i, "trace": [j]} for j in range(4)])
+                for i in range(10)]
+    reqs = loadgen.skewed_requests(per_uuid, 400, share=0.8,
+                                   hot_frac=0.1, rng=rng, stream=False)
+    assert len(reqs) == 400
+    counts = {}
+    for r in reqs:
+        counts[r["uuid"]] = counts.get(r["uuid"], 0) + 1
+    # ~80% of traffic on the single hot vehicle (hot_frac 0.1 of 10)
+    assert counts["veh-0"] > 0.6 * 400
+    # per-vehicle order preserved within each recycle
+    for u in counts:
+        seq = [r["trace"][0] for r in reqs if r["uuid"] == u]
+        for k in range(1, len(seq)):
+            assert seq[k] == (seq[k - 1] + 1) % 4
+    # stream recycles rename the uuid so an open session never rewinds
+    sreqs = loadgen.skewed_requests(
+        [("veh-s", [{"uuid": "veh-s", "trace": [0]},
+                    {"uuid": "veh-s", "trace": [1]}])],
+        5, share=1.0, hot_frac=1.0, rng=rng, stream=True)
+    assert [r["uuid"] for r in sreqs] == [
+        "veh-s", "veh-s", "veh-s~c1", "veh-s~c1", "veh-s~c2"]
+
+
+def test_step_stats_admitted_view(loadgen):
+    mk = loadgen.Sample
+    samples = [mk(0.0, 0.0, 0.1, 200, False)] * 8 + \
+              [mk(0.0, 0.0, 0.01, 429, False)] * 2
+    st = loadgen.step_stats(samples, offered_rate=10.0)
+    assert st["shed_fraction"] == pytest.approx(0.2)
+    assert st["admitted_quantiles"]["p99_ms"] is not None
+    # the admitted tail excludes the fast sheds entirely
+    assert st["admitted_quantiles"]["p50_ms"] > 50.0

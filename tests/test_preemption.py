@@ -1,0 +1,324 @@
+"""Preemption-tolerant sessions (docs/serving-fleet.md "Self-driving
+fleet"): the SessionCheckpointer's write/prune/clear semantics, the
+merge-DEDUP import that keeps the fleet points ledger exact under
+re-dispatch races, and the PR-12 gap closed end to end — a SIGKILL'd
+replica holding live session beams.
+
+The chaos test pins BOTH sides of the contract:
+
+  baseline   (today's behaviour) remapped vehicles re-stream and
+             rebuild from scratch on the survivor; the fleet ledger
+             accounts the dead replica's points as LOST — exactly,
+             not approximately;
+  tightened  re-homing the victim's sync-mode checkpoint through the
+             router restores every lost point: the ledger equals every
+             200-answered point EXACTLY (zero lost, zero duplicated).
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from reporter_tpu import faults
+from reporter_tpu.matching.session import (SessionCheckpointer,
+                                           SessionState, SessionStore,
+                                           read_checkpoints)
+from reporter_tpu.serve.router import FleetRouter
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults(monkeypatch):
+    for p in faults.POINTS:
+        monkeypatch.delenv("REPORTER_FAULT_" + p.upper(), raising=False)
+    faults.reset()
+    yield
+    faults.reset()
+
+
+def _open_session(store, uuid, points):
+    s = store.get_or_open(uuid, t0=1000.0)
+    s.replay = [{"lat": 37.75, "lon": -122.45, "time": 1000 + i}
+                for i in range(points)]
+    s.points_total = points
+    s.seq = 1
+    return s
+
+
+# -- the checkpointer --------------------------------------------------------
+
+
+def test_checkpoint_sweep_writes_dirty_and_prunes_dead(tmp_path):
+    store = SessionStore()
+    cp = SessionCheckpointer(store, str(tmp_path / "ckpt"),
+                             cadence_s=3600.0, sync=False)
+    cp.start()  # cadence thread irrelevant at 1 h; sweeps driven by hand
+    _open_session(store, "veh-a", 3)
+    _open_session(store, "veh/b:weird uuid", 2)
+    store.notify_commit("veh-a")
+    store.notify_commit("veh/b:weird uuid")
+    res = cp.sweep()
+    assert res["written"] == 2
+    wires = read_checkpoints(cp.dir)
+    assert sorted(w["uuid"] for w in wires) == ["veh-a", "veh/b:weird uuid"]
+    assert next(w for w in wires
+                if w["uuid"] == "veh-a")["points_total"] == 3
+    # a clean sweep writes nothing new
+    assert cp.sweep()["written"] == 0
+    # a session leaving the store has its file pruned at the next sweep
+    with store._lock:
+        del store._by_uuid["veh-a"]
+    res = cp.sweep()
+    assert res["pruned"] == 1
+    assert [w["uuid"] for w in read_checkpoints(cp.dir)] \
+        == ["veh/b:weird uuid"]
+
+
+def test_checkpoint_sync_mode_persists_each_commit(tmp_path):
+    store = SessionStore()
+    cp = SessionCheckpointer(store, str(tmp_path / "ckpt"),
+                             cadence_s=3600.0, sync=True)
+    cp.start()
+    _open_session(store, "veh-s", 4)
+    store.notify_commit("veh-s")  # the commit itself wrote the file
+    wires = read_checkpoints(cp.dir)
+    assert len(wires) == 1 and wires[0]["points_total"] == 4
+
+
+def test_pop_and_drop_remove_files_promptly(tmp_path):
+    store = SessionStore()
+    cp = SessionCheckpointer(store, str(tmp_path / "ckpt"),
+                             cadence_s=3600.0, sync=True)
+    cp.start()
+    _open_session(store, "veh-pop", 2)
+    _open_session(store, "veh-drop", 2)
+    store.notify_commit("veh-pop")
+    store.notify_commit("veh-drop")
+    assert len(read_checkpoints(cp.dir)) == 2
+    # a popped beam MOVED: its file must die with the pop, not at the
+    # next sweep — a SIGKILL in between must not re-home a duplicate
+    assert len(store.pop_wire(["veh-pop"])) == 1
+    assert [w["uuid"] for w in read_checkpoints(cp.dir)] == ["veh-drop"]
+    store.drop("veh-drop")
+    assert read_checkpoints(cp.dir) == []
+
+
+def test_checkpoint_clear_on_start_and_unreadable_skipped(tmp_path):
+    d = tmp_path / "ckpt"
+    d.mkdir()
+    (d / "stale.json").write_text(json.dumps(
+        SessionState("veh-stale", 0.0).to_wire()))
+    (d / "garbage.json").write_text("{not json")
+    (d / "ignored.txt").write_text("not a checkpoint")
+    # read skips the torn file loudly, keeps the rest
+    wires = read_checkpoints(str(d))
+    assert [w["uuid"] for w in wires] == ["veh-stale"]
+    # a fresh checkpointer CLEARS leftovers: the supervisor already had
+    # its chance to re-home them; resurrecting them here would duplicate
+    store = SessionStore()
+    cp = SessionCheckpointer(store, str(d), cadence_s=3600.0)
+    cp.start()
+    assert read_checkpoints(str(d)) == []
+    assert (d / "ignored.txt").exists()  # only checkpoint files die
+
+
+def test_import_merge_dedups_shared_replay_points():
+    store = SessionStore()
+    live = _open_session(store, "veh-m", 2)
+    live.replay = [{"lat": 1.0, "lon": 2.0, "time": 1003},
+                   {"lat": 1.0, "lon": 2.0, "time": 1004}]
+    # the incoming wire shares one point with the live replay (the
+    # re-dispatched point the dead replica also committed)
+    s = SessionState("veh-m", 1000.0)
+    s.points_total = 3
+    s.replay = [{"lat": 1.0, "lon": 2.0, "time": 1001},
+                {"lat": 1.0, "lon": 2.0, "time": 1002},
+                {"lat": 1.0, "lon": 2.0, "time": 1003}]
+    res = store.import_wire([s.to_wire()])
+    assert res["merged"] == 1
+    assert live.points_total == 2 + (3 - 1)  # the shared point once
+    # only the genuinely-new history prepends the replay
+    assert [p["time"] for p in live.replay] == [1001, 1002, 1003, 1004]
+    assert live.rebuild_pending
+
+
+# -- the chaos arc: SIGKILL with live beams ----------------------------------
+
+
+def _spawn_replica(tmp_path, rid, ckpt_dir):
+    conf = {
+        "network": {"type": "grid", "rows": 5, "cols": 5,
+                    "spacing_m": 150.0},
+        "matcher": {"search_radius": 50.0},
+        "backend": "cpu",
+        "batch": {"max_batch": 16, "max_wait_ms": 2,
+                  "session_wait_ms": 1},
+        "warmup": False,
+    }
+    conf_path = tmp_path / ("config-%s.json" % rid)
+    conf_path.write_text(json.dumps(conf))
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu",
+               REPORTER_REPLICA_ID=rid,
+               REPORTER_SESSION_CHECKPOINT_S="60",
+               REPORTER_SESSION_CHECKPOINT_SYNC="1",
+               REPORTER_SESSION_CHECKPOINT_DIR=str(ckpt_dir))
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "reporter_tpu.serve", str(conf_path),
+         "127.0.0.1:0"],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+    return proc
+
+
+def _bound_port(proc, deadline_s=60):
+    deadline = time.monotonic() + deadline_s
+    buf = b""
+    while time.monotonic() < deadline:
+        line = proc.stdout.readline()
+        if not line:
+            time.sleep(0.05)
+            continue
+        buf += line
+        if b"service on 127.0.0.1:" in line:
+            return int(line.split(b"127.0.0.1:")[1].split()[0])
+    raise AssertionError("no bind line in serve output: %r" % buf)
+
+
+def _wait_backend(url, deadline_s=120):
+    deadline = time.monotonic() + deadline_s
+    while time.monotonic() < deadline:
+        try:
+            with urllib.request.urlopen(url + "/health", timeout=2) as r:
+                h = json.loads(r.read().decode())
+            if h.get("backend"):
+                return
+        except Exception:  # noqa: BLE001 - still booting
+            pass
+        time.sleep(0.25)
+    raise AssertionError("replica %s never attached" % url)
+
+
+def _post(url, payload, timeout=30):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, dict(r.headers), json.loads(r.read().decode())
+    except urllib.error.HTTPError as e:
+        return e.code, dict(e.headers), json.loads(e.read().decode())
+
+
+def _street_points(i0, n):
+    # a short straight walk on the 5x5 grid near the row-2 street
+    return [{"lat": 37.75 + 0.00012 * (i0 + i),
+             "lon": -122.45 + 0.00012 * (i0 + i),
+             "time": 1000 + 15 * (i0 + i)} for i in range(n)]
+
+
+def test_sigkill_baseline_loss_then_checkpoint_rehome_exact(tmp_path):
+    """The PR-12 gap, then the tentpole closing it: SIGKILL a replica
+    holding live sessions (no drain, no export).  Baseline: the fleet
+    ledger accounts the victim's answered points as lost — exactly.
+    Tightened: re-homing the victim's sync checkpoint restores the
+    ledger to EVERY answered point, zero lost, zero duplicated."""
+    ckpt_dir = tmp_path / "session-ckpt"
+    procs = [_spawn_replica(tmp_path, "rep-%d" % i, ckpt_dir)
+             for i in range(2)]
+    router = httpd = None
+    try:
+        ports = [_bound_port(p) for p in procs]
+        urls = ["http://127.0.0.1:%d" % p for p in ports]
+        for u in urls:
+            _wait_backend(u)
+        router = FleetRouter(urls, probe_interval_s=0.15,
+                             unhealthy_after=2)
+        router.start()
+        httpd = router.make_server("127.0.0.1", 0)
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        rurl = "http://127.0.0.1:%d" % httpd.server_port
+        time.sleep(0.4)  # first probes: both healthy
+
+        # stream 2-point session steps for a fleet of vehicles,
+        # synchronously (no in-flight request at the kill, so the
+        # answered-point ledger is exactly countable)
+        uuids = ["veh-pre-%02d" % k for k in range(10)]
+        answered = {}  # uuid -> (pre_kill, post_kill, replica_pre)
+        for step in range(2):
+            for u in uuids:
+                body = {"uuid": u, "stream": True,
+                        "trace": _street_points(2 * step, 2),
+                        "match_options": {"mode": "auto",
+                                          "report_levels": [0, 1],
+                                          "transition_levels": [0, 1]}}
+                st, hd, _b = _post(rurl + "/report", body)
+                assert st == 200, _b
+                pre, post, rep = answered.get(u, (0, 0, None))
+                answered[u] = (pre + 2, post, hd.get("X-Reporter-Replica"))
+        n_pre = sum(p for p, _q, _r in answered.values())
+        with urllib.request.urlopen(rurl + "/sessions", timeout=10) as r:
+            fleet = json.loads(r.read().decode())
+        assert fleet["points_total"] == n_pre
+
+        # SIGKILL the replica that owns the most vehicles
+        by_rep = {}
+        for _u, (_p, _q, rep) in answered.items():
+            by_rep[rep] = by_rep.get(rep, 0) + 1
+        victim_rid = max(by_rep, key=by_rep.get)
+        victim_idx = int(victim_rid.split("-")[1])
+        procs[victim_idx].send_signal(signal.SIGKILL)
+        procs[victim_idx].wait(timeout=10)
+        victim_points = sum(p for _u, (p, _q, rep) in answered.items()
+                            if rep == victim_rid)
+        assert victim_points > 0
+
+        # vehicles keep streaming: the router fails them over to the
+        # survivor, which opens FRESH sessions (rebuild from scratch)
+        for u in uuids:
+            body = {"uuid": u, "stream": True,
+                    "trace": _street_points(4, 2),
+                    "match_options": {"mode": "auto",
+                                      "report_levels": [0, 1],
+                                      "transition_levels": [0, 1]}}
+            st, hd, _b = _post(rurl + "/report", body)
+            assert st == 200, _b
+            assert hd.get("X-Reporter-Replica") != victim_rid
+            pre, post, rep = answered[u]
+            answered[u] = (pre, post + 2, rep)
+        n_all = sum(p + q for p, q, _r in answered.values())
+
+        # BASELINE (today's behaviour): the ledger accounts the loss —
+        # exactly the victim's answered points are missing
+        with urllib.request.urlopen(rurl + "/sessions", timeout=10) as r:
+            fleet = json.loads(r.read().decode())
+        assert fleet["points_total"] == n_all - victim_points
+
+        # TIGHTENED (the tentpole): re-home the victim's sync-mode
+        # checkpoint through the router — the supervisor's exact path
+        wires = read_checkpoints(str(ckpt_dir / victim_rid))
+        assert wires, "sync checkpointing left no files for the victim"
+        st, _h, res = _post(rurl + "/sessions", {"sessions": wires})
+        assert st == 200 and res["rehomed"] == len(wires)
+
+        with urllib.request.urlopen(rurl + "/sessions", timeout=10) as r:
+            fleet = json.loads(r.read().decode())
+        assert fleet["points_total"] == n_all, (
+            "ledger %d != %d answered points after checkpoint re-home"
+            % (fleet["points_total"], n_all))
+    finally:
+        if httpd is not None:
+            httpd.shutdown()
+            httpd.server_close()
+        if router is not None:
+            router.stop()
+        for p in procs:
+            if p.poll() is None:
+                p.kill()

@@ -1,0 +1,572 @@
+"""The self-driving fleet's control plane (docs/serving-fleet.md
+"Self-driving fleet"): the autoscaler's AND-gated decisions, the
+router's dynamic replica set + admin surface, adaptive tail control
+(hedge threshold + micro-batch fill window), the prober's phase jitter
++ Retry-After honoring, and the new chaos points (clock_skew,
+slow_drain)."""
+
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from reporter_tpu import faults
+from reporter_tpu.matching import MatcherConfig, SegmentMatcher
+from reporter_tpu.matching.session import SessionState, SessionStore
+from reporter_tpu.obs import adaptive as obs_adaptive
+from reporter_tpu.serve.autoscale import Autoscaler, RespawnBackoff
+from reporter_tpu.serve.router import FleetRouter
+from reporter_tpu.serve.service import (DeadlineExpired, MicroBatcher,
+                                        ReporterService)
+from reporter_tpu.tiles.arrays import build_graph_arrays
+from reporter_tpu.tiles.network import grid_city
+from reporter_tpu.tiles.ubodt import build_ubodt
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults(monkeypatch):
+    for p in faults.POINTS:
+        monkeypatch.delenv("REPORTER_FAULT_" + p.upper(), raising=False)
+    monkeypatch.delenv("REPORTER_ADAPTIVE", raising=False)
+    faults.reset()
+    yield
+    faults.reset()
+
+
+@pytest.fixture(scope="module")
+def engine():
+    city = grid_city(rows=5, cols=5, spacing_m=150.0)
+    arrays = build_graph_arrays(city, cell_size=100.0)
+    ubodt = build_ubodt(arrays, delta=2000.0)
+    return arrays, ubodt
+
+
+class _Replica:
+    """One in-process serve replica with a pinned replica id."""
+
+    def __init__(self, arrays, ubodt, rid, deferred=False, **svc_kw):
+        self.rid = rid
+        prev = os.environ.get("REPORTER_REPLICA_ID")
+        os.environ["REPORTER_REPLICA_ID"] = rid
+        try:
+            if deferred:
+                self.svc = ReporterService(None, **svc_kw)
+            else:
+                matcher = SegmentMatcher(arrays=arrays, ubodt=ubodt,
+                                         config=MatcherConfig(),
+                                         backend="cpu")
+                self.svc = ReporterService(matcher, max_wait_ms=2.0,
+                                           **svc_kw)
+        finally:
+            if prev is None:
+                os.environ.pop("REPORTER_REPLICA_ID", None)
+            else:
+                os.environ["REPORTER_REPLICA_ID"] = prev
+        self.httpd = self.svc.make_server("127.0.0.1", 0)
+        self.port = self.httpd.server_port
+        threading.Thread(target=self.httpd.serve_forever,
+                         daemon=True).start()
+        self.url = "http://127.0.0.1:%d" % self.port
+
+    def close(self):
+        try:
+            self.httpd.shutdown()
+            self.httpd.server_close()
+        except Exception:  # noqa: BLE001
+            pass
+
+
+def post_json(url, payload, timeout=30):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, dict(r.headers), json.loads(r.read().decode())
+    except urllib.error.HTTPError as e:
+        return e.code, dict(e.headers), json.loads(e.read().decode())
+
+
+def get_json(url, timeout=10):
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            return r.status, dict(r.headers), json.loads(r.read().decode())
+    except urllib.error.HTTPError as e:
+        return e.code, dict(e.headers), json.loads(e.read().decode())
+
+
+# -- the adaptive primitives -------------------------------------------------
+
+
+def test_controller_deadband_step_clamp_cooldown():
+    clock = {"t": 0.0}
+    c = obs_adaptive.Controller("test_ctl", 0.010, lo=0.002, hi=0.040,
+                                deadband=0.10, max_step=0.30,
+                                cooldown_s=1.0, clock=lambda: clock["t"])
+    # in-deadband targets never move the knob
+    assert c.propose(0.0101) == pytest.approx(0.010)
+    # an accepted move is step-limited (30% per move)...
+    clock["t"] = 2.0
+    assert c.propose(0.002) == pytest.approx(0.007)
+    # ...and rate-limited: a second move inside the cooldown is ignored
+    assert c.propose(0.002) == pytest.approx(0.007)
+    clock["t"] = 4.0
+    # clamped at the envelope regardless of target
+    assert c.propose(0.0001) == pytest.approx(0.0049)
+    for i in range(20):
+        clock["t"] += 2.0
+        c.propose(0.0001)
+    assert c.value == pytest.approx(0.002)
+    for i in range(40):
+        clock["t"] += 2.0
+        c.propose(10.0)
+    assert c.value == pytest.approx(0.040)
+    assert c.revert() == pytest.approx(0.010)
+
+
+def test_windowed_quantile_rolls_off():
+    clock = {"t": 100.0}
+    w = obs_adaptive.WindowedQuantile(window_s=10.0,
+                                      clock=lambda: clock["t"])
+    for _ in range(50):
+        w.observe(0.5)
+    assert w.count() == 50
+    assert w.quantile(0.95) == pytest.approx(0.5, rel=0.25)
+    clock["t"] = 120.0  # past the window: the old epoch no longer counts
+    assert w.count() == 0
+    assert w.quantile(0.95) is None
+
+
+def test_adaptive_disabled_is_static(monkeypatch):
+    monkeypatch.setenv("REPORTER_ADAPTIVE", "0")
+    assert not obs_adaptive.enabled()
+
+    class _Stub:
+        backend = "cpu"
+
+        def match_many_async(self, traces):
+            return lambda: [{"segments": []} for _ in traces]
+
+    b = MicroBatcher(_Stub(), max_wait_ms=10.0, watchdog_s=0)
+    assert b._wait_ctl is None
+    b._adapt_wait(64)  # no-op, no controller state at all
+    assert b.max_wait == pytest.approx(0.010)
+
+
+def test_batcher_wait_shrinks_when_queue_wait_dominates():
+    class _Stub:
+        backend = "cpu"
+
+        def match_many_async(self, traces):
+            return lambda: [{"segments": []} for _ in traces]
+
+    b = MicroBatcher(_Stub(), max_wait_ms=10.0, watchdog_s=0)
+    assert b._wait_ctl is not None
+    b._wait_ctl.cooldown_s = 0.0
+    # queue wait p95 far above the device step p95: holding the fill
+    # window open is the tail — the controller shrinks it
+    for _ in range(64):
+        b._h_qwait.observe(0.200)
+    for _ in range(16):
+        b._h_dstep.observe(0.005)
+    w0 = b.max_wait
+    for _ in range(30):
+        b._adapt_wait(fill=1)
+    assert b.max_wait < w0
+    assert b.max_wait == pytest.approx(b._wait_ctl.lo)
+    # device step dominating on full batches: amortisation wins, grow
+    b2 = MicroBatcher(_Stub(), max_wait_ms=10.0, watchdog_s=0)
+    b2._wait_ctl.cooldown_s = 0.0
+    for _ in range(64):
+        b2._h_qwait.observe(0.001)
+    for _ in range(16):
+        b2._h_dstep.observe(0.500)
+    for _ in range(40):
+        b2._adapt_wait(fill=b2.max_batch)
+    assert b2.max_wait > 0.010
+    # converges into the deadband around the clamp ceiling
+    assert b2.max_wait >= 0.9 * b2._wait_ctl.hi
+
+
+def test_hedge_threshold_tracks_live_p95(monkeypatch):
+    router = FleetRouter(["http://127.0.0.1:1"], hedge_ms=100.0,
+                         probe_interval_s=3600.0)
+    try:
+        assert router._hedge_ctl is not None
+        router._hedge_ctl.cooldown_s = 0.0
+        # thin traffic: the controller holds (no quantile yanking)
+        assert router.current_hedge_s() == pytest.approx(0.1)
+        for _ in range(100):
+            router.slo.observe("report", 200, 0.400)
+        for _ in range(40):
+            router.current_hedge_s()
+        # k=2 x p95(~0.4s) = 0.8s, inside the [0.01, 1.0] clamp
+        assert router.current_hedge_s() == pytest.approx(0.8, rel=0.2)
+    finally:
+        router.stop()
+
+
+def test_hedge_threshold_static_without_adaptive(monkeypatch):
+    monkeypatch.setenv("REPORTER_ADAPTIVE", "0")
+    router = FleetRouter(["http://127.0.0.1:1"], hedge_ms=100.0,
+                         probe_interval_s=3600.0)
+    try:
+        assert router._hedge_ctl is None
+        for _ in range(100):
+            router.slo.observe("report", 200, 0.400)
+        assert router.current_hedge_s() == pytest.approx(0.1)
+    finally:
+        router.stop()
+
+
+# -- the autoscaler's decision core ------------------------------------------
+
+
+def _mk_autoscaler(clock, **kw):
+    sig = {"replicas": 2, "queue_depth": 0.0, "burn_alerting": False,
+           "max_burn": 0.0}
+    actions = {"up": 0, "down": 0}
+
+    def scale_up(reason):
+        actions["up"] += 1
+        sig["replicas"] += 1
+        return True
+
+    def scale_down(reason):
+        actions["down"] += 1
+        sig["replicas"] -= 1
+        return True
+
+    a = Autoscaler(lambda: dict(sig), scale_up, scale_down,
+                   min_replicas=1, max_replicas=3, poll_s=1.0,
+                   cooldown_s=5.0, queue_high=8.0, window_s=12.0,
+                   down_after_s=30.0, clock=lambda: clock["t"])
+    return a, sig, actions
+
+
+def test_burst_alone_cannot_scale_up():
+    clock = {"t": 1000.0}
+    a, sig, actions = _mk_autoscaler(clock)
+    # a 2-second queue burst + burn alert: the fast window fires, the
+    # slow window does not — the AND gate holds the fleet steady
+    for i in range(120):
+        clock["t"] += 1.0
+        sig["queue_depth"] = 50.0 if i in (60, 61) else 0.0
+        sig["burn_alerting"] = i in (60, 61)
+        a.tick()
+    # the burst never grew the fleet (the calm stretches legitimately
+    # shrink it toward min_replicas — that is the idle path, not a flap)
+    assert actions["up"] == 0
+    assert sig["replicas"] >= 1
+
+
+def test_sustained_burn_and_queue_scales_up_once_per_cooldown():
+    clock = {"t": 1000.0}
+    a, sig, actions = _mk_autoscaler(clock)
+    sig["queue_depth"] = 50.0
+    sig["burn_alerting"] = True
+    sig["max_burn"] = 3.0
+    for _ in range(60):
+        clock["t"] += 1.0
+        a.tick()
+    # sustained pressure: scaled up, but never twice inside one cooldown
+    assert actions["up"] >= 1
+    assert actions["up"] <= 60 / 5.0 + 1
+    # and never past max_replicas
+    assert sig["replicas"] <= 3
+
+
+def test_burn_without_queue_pressure_does_not_scale():
+    clock = {"t": 1000.0}
+    a, sig, actions = _mk_autoscaler(clock)
+    sig["burn_alerting"] = True   # latency pain, empty queues: a traffic
+    sig["max_burn"] = 5.0         # mix problem a replica cannot fix
+    for _ in range(60):
+        clock["t"] += 1.0
+        a.tick()
+    assert actions["up"] == 0
+
+
+def test_sustained_calm_scales_down_to_min():
+    clock = {"t": 1000.0}
+    a, sig, actions = _mk_autoscaler(clock)
+    sig["replicas"] = 3
+    for _ in range(120):
+        clock["t"] += 1.0
+        a.tick()
+    assert actions["down"] >= 1
+    assert sig["replicas"] == 1  # and never below min_replicas
+    n_down = actions["down"]
+    for _ in range(60):
+        clock["t"] += 1.0
+        a.tick()
+    assert actions["down"] == n_down
+
+
+def test_unreachable_router_makes_no_decisions():
+    clock = {"t": 1000.0}
+    calls = {"n": 0}
+
+    def boom(reason):
+        calls["n"] += 1
+        return True
+
+    a = Autoscaler(lambda: None, boom, boom, clock=lambda: clock["t"],
+                   cooldown_s=0.0)
+    for _ in range(50):
+        clock["t"] += 1.0
+        assert a.tick() is None
+    assert calls["n"] == 0
+
+
+def test_respawn_backoff_doubles_and_resets():
+    backoff = RespawnBackoff(base_s=0.5, max_s=8.0, healthy_reset_s=30.0)
+    # a one-off death respawns immediately (today's fast recovery)
+    assert backoff.next_delay("rep-0", uptime_s=2.0) == 0.0
+    d1 = backoff.next_delay("rep-0", uptime_s=0.5)
+    d2 = backoff.next_delay("rep-0", uptime_s=0.5)
+    d3 = backoff.next_delay("rep-0", uptime_s=0.5)
+    assert 0.5 <= d1 <= 1.0          # base x [1, 2) full jitter
+    assert 1.0 <= d2 <= 2.0
+    assert 2.0 <= d3 <= 4.0
+    # a long healthy life resets the streak
+    assert backoff.next_delay("rep-0", uptime_s=120.0) == 0.0
+    # independent per child
+    assert backoff.next_delay("rep-1", uptime_s=0.1) == 0.0
+
+
+# -- the router's dynamic replica set ----------------------------------------
+
+
+def test_router_admin_add_remove_and_scale_events(engine):
+    arrays, ubodt = engine
+    reps = [_Replica(arrays, ubodt, "rep-%d" % i) for i in range(2)]
+    extra = _Replica(arrays, ubodt, "rep-2")
+    router = FleetRouter([r.url for r in reps], probe_interval_s=0.2)
+    router.start()
+    httpd = router.make_server("127.0.0.1", 0)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    url = "http://127.0.0.1:%d" % httpd.server_port
+    try:
+        st, _h, body = post_json(url + "/fleet",
+                                 {"add": extra.url,
+                                  "reason": "burn_and_queue"})
+        assert st == 200 and body["ok"]
+        assert len(router.replicas) == 3
+        # idempotent: adding the same url again conflicts, no dup
+        st, _h, body = post_json(url + "/fleet", {"add": extra.url})
+        assert st == 409 and len(router.replicas) == 3
+        # the event ring + counter surface on /statusz
+        st, _h, sz = get_json(url + "/statusz")
+        assert st == 200
+        events = sz["autoscale"]["events"]
+        assert any(e["direction"] == "up"
+                   and e["reason"] == "burn_and_queue" for e in events)
+        fam = sz["metrics"]["reporter_fleet_scale_events_total"]
+        assert any(lv == ["up", "burn_and_queue"] and v >= 1
+                   for lv, v in fam["samples"])
+        # the added replica becomes routable (probe marks it healthy)
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            if any(r.url == extra.url and r.available()
+                   for r in router.replicas):
+                break
+            time.sleep(0.1)
+        else:
+            pytest.fail("added replica never became available")
+        # remove by replica id
+        st, _h, body = post_json(url + "/fleet",
+                                 {"remove": "rep-2", "reason": "idle"})
+        assert st == 200 and body["ok"]
+        assert len(router.replicas) == 2
+        # the last replica can never be removed
+        post_json(url + "/fleet", {"remove": reps[0].rid})
+        st, _h, body = post_json(url + "/fleet", {"remove": reps[1].rid})
+        assert st == 409 and "last replica" in body["admin"]
+        # malformed admin bodies are 400
+        st, _h, body = post_json(url + "/fleet", {"nope": 1})
+        assert st == 400
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        router.stop()
+        for r in reps + [extra]:
+            r.close()
+
+
+def test_added_replica_warming_holdout_serves_nothing_cold(engine):
+    arrays, ubodt = engine
+    warm = _Replica(arrays, ubodt, "rep-warm")
+    cold = _Replica(arrays, ubodt, "rep-cold", deferred=True)
+    router = FleetRouter([warm.url], probe_interval_s=0.1)
+    router.start()
+    try:
+        ok, _msg = router.add_replica(cold.url, "burn_and_queue")
+        assert ok
+        time.sleep(0.5)
+        cold_rep = next(r for r in router.replicas if r.url == cold.url)
+        # the warming hold-out: in the ring, NOT routable
+        assert cold_rep.state == "init"
+        assert not cold_rep.available()
+        for k in range(8):
+            order, _ = router.route_order("veh-%d" % k)
+            assert all(r.url != cold.url for r in order)
+        # engine attaches -> the probe admits it (and, was_lost being
+        # set, the first healthy transition counts as a recovery so the
+        # session rebalance will pull its vehicles' beams over)
+        assert cold_rep.was_lost
+        matcher = SegmentMatcher(arrays=arrays, ubodt=ubodt,
+                                 config=MatcherConfig(), backend="cpu")
+        cold.svc.attach_matcher(matcher)
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and not cold_rep.available():
+            time.sleep(0.1)
+        assert cold_rep.available()
+    finally:
+        router.stop()
+        warm.close()
+        cold.close()
+
+
+def test_router_rehomes_checkpointed_sessions(engine):
+    arrays, ubodt = engine
+    reps = [_Replica(arrays, ubodt, "rep-%d" % i) for i in range(2)]
+    router = FleetRouter([r.url for r in reps], probe_interval_s=0.2)
+    router.start()
+    httpd = router.make_server("127.0.0.1", 0)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    url = "http://127.0.0.1:%d" % httpd.server_port
+    try:
+        time.sleep(0.3)  # first probes
+        wires = []
+        for k in range(6):
+            s = SessionState("veh-re-%d" % k, t0=1000.0)
+            s.points_total = 3
+            s.replay = [{"lat": 37.75, "lon": -122.45, "time": 1000 + i}
+                        for i in range(3)]
+            s.seq = 1
+            wires.append(s.to_wire())
+        st, _h, body = post_json(url + "/sessions", {"sessions": wires})
+        assert st == 200
+        assert body["rehomed"] == 6 and body["no_target"] == 0
+        assert sorted(body["imported_uuids"]) == sorted(
+            w["uuid"] for w in wires)
+        # every session landed on its uuid's rendezvous primary
+        for w in wires:
+            order, _ = router.route_order(w["uuid"])
+            primary = next(r for r in reps
+                           if r.url == order[0].url)
+            assert primary.svc.session_store.peek(w["uuid"]) is not None
+        # ...and the ledger carried over exactly
+        total = sum(
+            r.svc.session_store.summary()["points_total"] for r in reps)
+        assert total == 18
+        # "exclude" reroutes around a replica the caller knows is dead
+        # (the supervisor's re-home fires before the probe streak does)
+        s = SessionState("veh-excl", t0=1000.0)
+        s.points_total = 1
+        s.replay = [{"lat": 37.75, "lon": -122.45, "time": 2000}]
+        order, _ = router.route_order("veh-excl")
+        primary_rid = next(r.rid for r in reps if r.url == order[0].url)
+        other = next(r for r in reps if r.rid != primary_rid)
+        st, _h, body = post_json(url + "/sessions",
+                                 {"sessions": [s.to_wire()],
+                                  "exclude": primary_rid})
+        assert st == 200 and body["rehomed"] == 1
+        assert other.svc.session_store.peek("veh-excl") is not None
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        router.stop()
+        for r in reps:
+            r.close()
+
+
+# -- prober: phase jitter + Retry-After --------------------------------------
+
+
+def test_probe_schedule_jitter_spreads_phases():
+    router = FleetRouter(["http://127.0.0.1:1"], probe_interval_s=1.0)
+    try:
+        r = router.replicas[0]
+        delays = []
+        for _ in range(200):
+            router._schedule_probe(r)
+            delays.append(r.next_probe_at - time.monotonic())
+        assert min(delays) >= 0.99
+        assert max(delays) <= 1.0 + router.probe_jitter + 0.01
+        assert max(delays) - min(delays) > 0.05  # actually jittered
+    finally:
+        router.stop()
+
+
+def test_draining_probe_honors_retry_after_no_streak(engine):
+    arrays, ubodt = engine
+    rep = _Replica(arrays, ubodt, "rep-drn")
+    router = FleetRouter([rep.url], probe_interval_s=0.2,
+                         unhealthy_after=2)
+    try:
+        router.probe_all()
+        r = router.replicas[0]
+        assert r.state == "healthy"
+        rep.svc.begin_drain()
+        t0 = time.monotonic()
+        router.probe_all()
+        assert r.state == "draining"
+        # 503-draining never counts toward the unhealthy streak...
+        assert r.probe_fail_streak == 0
+        assert r.state != "unhealthy"
+        # ...and its Retry-After (1 s on the drain responses) pushes the
+        # NEXT probe of this replica back past the normal 0.2 s interval
+        assert r.next_probe_at - t0 >= 0.9
+    finally:
+        router.stop()
+        rep.close()
+
+
+# -- new chaos points --------------------------------------------------------
+
+
+def test_clock_skew_expires_queued_deadlines(monkeypatch):
+    class _Stub:
+        backend = "cpu"
+
+        def match_many_async(self, traces):
+            return lambda: [{"segments": []} for _ in traces]
+
+    b = MicroBatcher(_Stub(), max_wait_ms=50.0, watchdog_s=0)
+    # untouched: a generous deadline survives the queue
+    f = b.submit({"uuid": "v"}, deadline=time.monotonic() + 5.0)
+    assert f.result(timeout=10) == {"segments": []}
+    # armed at 1000x (decimal form — a bare integer is the raise-N
+    # grammar): the ~50 ms batch-fill wait scales to ~50 s of effective
+    # queue time and the same deadline expires pre-dispatch
+    monkeypatch.setenv("REPORTER_FAULT_CLOCK_SKEW", "1000.0")
+    faults.reset()
+    f = b.submit({"uuid": "v"}, deadline=time.monotonic() + 5.0)
+    with pytest.raises(DeadlineExpired):
+        f.result(timeout=10)
+
+
+def test_slow_drain_stalls_session_export(monkeypatch, engine):
+    arrays, ubodt = engine
+    rep = _Replica(arrays, ubodt, "rep-slow")
+    try:
+        monkeypatch.setenv("REPORTER_FAULT_SLOW_DRAIN", "0.4:1")
+        faults.reset()
+        t0 = time.monotonic()
+        code, body = rep.svc.handle_sessions({"export": ["1"]})
+        assert code == 200 and "sessions" in body
+        assert time.monotonic() - t0 >= 0.4
+        # the count-limited spec disarms after one firing
+        t0 = time.monotonic()
+        rep.svc.handle_sessions({"export": ["1"]})
+        assert time.monotonic() - t0 < 0.3
+    finally:
+        rep.close()

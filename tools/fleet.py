@@ -20,6 +20,34 @@ gets the one-pane-of-glass view, and a SIGKILLed replica's final
 snapshot survives in the file, labeled stale (`--federate-every 0`
 disables).
 
+Self-driving extensions (docs/serving-fleet.md "Self-driving fleet"):
+
+  --autoscale      a control thread (reporter_tpu/serve/autoscale.py)
+                   grows the fleet when the router's client-truth SLO
+                   burn alert AND a sustained-queue gate both fire
+                   (multi-window AND-gated, the obs/slo.py math), and
+                   shrinks it after a sustained calm window — scale-up
+                   spawns a --warmup replica that the router holds out
+                   of the ring until /health reports attached+warmed;
+                   scale-down is strictly SIGTERM drain + beam handoff.
+                   Every decision lands in the router's
+                   reporter_fleet_scale_events_total counter, the
+                   /statusz autoscale ring, and
+                   <workdir>/scale_events.jsonl.
+
+  crash-loop backoff   consecutive quick deaths of one child back its
+                   respawn off exponentially with full jitter
+                   (reporter_fleet_respawn_backoff_seconds; a one-off
+                   death still respawns immediately).
+
+  checkpoint re-home   with --session-checkpoint S the replicas persist
+                   dirty session state to <workdir>/session-ckpt/<rid>/
+                   (REPORTER_SESSION_CHECKPOINT_*); when a replica dies
+                   WITHOUT draining, the supervisor re-homes its last
+                   checkpoint through the router (POST /sessions) before
+                   the respawn — a SIGKILL becomes a restore, not an
+                   incident.
+
 Lifecycle signals (to THIS process):
 
   SIGUSR1   rolling restart: each replica in turn is SIGTERM'd (graceful
@@ -34,7 +62,9 @@ Lifecycle signals (to THIS process):
 Usage:
     python tools/fleet.py --config service.json --replicas 3 \
         --base-port 19010 --router-port 19009 --workdir /tmp/fleet \
-        [--warmup] [--rolling-restart-after 20]
+        [--warmup] [--rolling-restart-after 20] \
+        [--autoscale --min-replicas 1 --max-replicas 6] \
+        [--session-checkpoint 1.0 [--session-checkpoint-sync]]
 """
 
 from __future__ import annotations
@@ -50,12 +80,17 @@ import threading
 import time
 import urllib.request
 
+sys.path.insert(0, os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+
 log = logging.getLogger("fleet")
 
 
-def wait_healthy(url: str, timeout_s: float, want_status: str = "ok") -> bool:
+def wait_healthy(url: str, timeout_s: float, want_status: str = "ok",
+                 want_warmed: bool = False) -> bool:
     """Poll /health until it answers 200 with the wanted status (and, for
-    replicas, an attached backend) or the timeout lapses."""
+    replicas, an attached backend; ``want_warmed`` additionally requires
+    the warmup pass to have finished) or the timeout lapses."""
     deadline = time.monotonic() + timeout_s
     while time.monotonic() < deadline:
         try:
@@ -63,25 +98,43 @@ def wait_healthy(url: str, timeout_s: float, want_status: str = "ok") -> bool:
                 h = json.loads(r.read().decode())
             if h.get("status") == want_status and (
                     h.get("role") == "router" or h.get("backend")):
-                return True
+                if not (want_warmed and h.get("warming")):
+                    return True
         except Exception:  # noqa: BLE001 - not up yet
             pass
         time.sleep(0.5)
     return False
 
 
+def _get_json(url: str, timeout: float = 5.0):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return json.loads(r.read().decode())
+
+
+def _post_json(url: str, payload: dict, timeout: float = 30.0):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.loads(r.read().decode())
+
+
 class Child:
     """One supervised process (replica or router)."""
 
-    def __init__(self, name: str, cmd, env: dict, log_path: str, url: str):
+    def __init__(self, name: str, cmd, env: dict, log_path: str, url: str,
+                 rid=None):
         self.name = name
         self.cmd = cmd
         self.env = env
         self.log_path = log_path
         self.url = url
+        self.rid = rid                  # replica id (None for the router)
         self.proc: subprocess.Popen = None
         self.restarts = 0
         self.expected_exit = False  # set around intentional drains
+        self.t_spawn = 0.0
+        self.respawn_at = 0.0       # crash-loop backoff: due time
 
     def spawn(self) -> None:
         logf = open(self.log_path, "ab")
@@ -89,6 +142,8 @@ class Child:
             self.cmd, env=self.env, stdout=logf, stderr=subprocess.STDOUT)
         logf.close()
         self.expected_exit = False
+        self.t_spawn = time.monotonic()
+        self.respawn_at = 0.0
         log.info("%s: pid %d on %s", self.name, self.proc.pid, self.url)
 
     def alive(self) -> bool:
@@ -119,19 +174,23 @@ class Fleet:
         base = os.environ.copy()
         if args.cpu_default:
             base.setdefault("JAX_PLATFORMS", "cpu")
+        # preemption-tolerant sessions: every replica checkpoints dirty
+        # session wire-state under one shared workdir tree, one owned
+        # subdirectory per replica id (docs/serving-fleet.md)
+        self.ckpt_dir = None
+        if args.session_checkpoint > 0:
+            self.ckpt_dir = os.path.join(self.workdir, "session-ckpt")
+            base["REPORTER_SESSION_CHECKPOINT_S"] = str(
+                args.session_checkpoint)
+            base["REPORTER_SESSION_CHECKPOINT_DIR"] = self.ckpt_dir
+            if args.session_checkpoint_sync:
+                base["REPORTER_SESSION_CHECKPOINT_SYNC"] = "1"
+        self._base_env = base
         self.replicas = []
-        serve_cmd = [sys.executable, "-m", "reporter_tpu.serve"]
-        if args.warmup:
-            serve_cmd.append("--warmup")
-        for i in range(args.replicas):
-            port = args.base_port + i
-            env = dict(base)
-            env["REPORTER_REPLICA_ID"] = "rep-%d" % i
-            self.replicas.append(Child(
-                "rep-%d" % i,
-                serve_cmd + [args.config, "%s:%d" % (self.host, port)],
-                env, os.path.join(self.workdir, "replica-%d.log" % i),
-                "http://%s:%d" % (self.host, port)))
+        self._next_idx = 0
+        self._next_port = args.base_port
+        for _ in range(args.replicas):
+            self.replicas.append(self._make_replica())
         urls = ",".join(c.url for c in self.replicas)
         router_env = dict(base)
         # the router's shutdown dumps (hop spans) get their own tag so
@@ -147,7 +206,34 @@ class Fleet:
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._rolling = threading.Event()
+        self._scaling = threading.Lock()   # one scale action at a time
         self._federator = None
+        self.autoscaler = None
+        # crash-loop backoff (reporter_tpu/serve/autoscale.py): imported
+        # lazily with the path fallback so `python tools/fleet.py` works
+        # from anywhere
+        from reporter_tpu.serve.autoscale import RespawnBackoff
+
+        self.backoff = RespawnBackoff(
+            base_s=args.respawn_backoff_base,
+            max_s=args.respawn_backoff_max)
+
+    def _make_replica(self) -> Child:
+        i = self._next_idx
+        self._next_idx += 1
+        port = self._next_port
+        self._next_port += 1
+        serve_cmd = [sys.executable, "-m", "reporter_tpu.serve"]
+        if self.args.warmup:
+            serve_cmd.append("--warmup")
+        rid = "rep-%d" % i
+        env = dict(self._base_env)
+        env["REPORTER_REPLICA_ID"] = rid
+        return Child(
+            rid,
+            serve_cmd + [self.args.config, "%s:%d" % (self.host, port)],
+            env, os.path.join(self.workdir, "replica-%d.log" % i),
+            "http://%s:%d" % (self.host, port), rid=rid)
 
     # -- state file ---------------------------------------------------------
 
@@ -156,16 +242,29 @@ class Fleet:
             "router": {"url": self.router.url,
                        "pid": self.router.proc.pid if self.router.proc else None},
             "replicas": [
-                {"id": "rep-%d" % i, "url": c.url,
+                {"id": c.rid, "url": c.url,
                  "pid": c.proc.pid if c.proc else None,
-                 "restarts": c.restarts, "log": c.log_path}
-                for i, c in enumerate(self.replicas)],
+                 "restarts": c.restarts, "log": c.log_path,
+                 "backoff_streak": self.backoff.streak(c.name)}
+                for c in self.replicas],
+            "autoscale": (self.autoscaler.state()
+                          if self.autoscaler is not None else None),
+            "session_checkpoint_dir": self.ckpt_dir,
         }
         path = os.path.join(self.workdir, "fleet.json")
         tmp = path + ".tmp"
         with open(tmp, "w") as f:
             json.dump(state, f, indent=1)
         os.replace(tmp, path)
+
+    def _scale_event(self, **kw) -> None:
+        kw.setdefault("t_unix", round(time.time(), 3))
+        path = os.path.join(self.workdir, "scale_events.jsonl")
+        try:
+            with open(path, "a") as f:
+                f.write(json.dumps(kw, separators=(",", ":")) + "\n")
+        except OSError:
+            pass
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -193,7 +292,9 @@ class Fleet:
         exit 0, respawn, wait healthy, move on.  The fleet never has
         more than one replica out at once."""
         ok = True
-        for c in self.replicas:
+        with self._lock:
+            replicas = list(self.replicas)
+        for c in replicas:
             if self._stop.is_set():
                 break
             log.info("rolling restart: draining %s", c.name)
@@ -219,40 +320,229 @@ class Fleet:
         atomically on each tick.  A dead replica's last snapshot stays
         in the file, labeled stale — the supervisor keeps the herd's
         numbers even when the router is the thing that died."""
-        try:
-            from reporter_tpu.obs.federation import Federator
-        except ImportError:  # run from anywhere: tools/ sits next to it
-            sys.path.insert(0, os.path.dirname(
-                os.path.dirname(os.path.abspath(__file__))))
-            from reporter_tpu.obs.federation import Federator
+        from reporter_tpu.obs.federation import Federator
 
         fed = Federator([c.url for c in self.replicas],
                         pull_interval_s=self.args.federate_every)
         self._federator = fed
         path = os.path.join(self.workdir, "federation.json")
         while not self._stop.wait(fed.pull_interval_s):
+            with self._lock:
+                urls = {c.url for c in self.replicas}
+            for u in urls:
+                fed.add_target(u)
             fed.pull_all()
             try:
                 fed.dump(path, extra={"router": self.router.url})
             except OSError as e:
                 log.warning("federation dump failed: %s", e)
 
+    # -- preemption re-home (docs/serving-fleet.md) --------------------------
+
+    def _rehome_checkpoints(self, c: Child) -> None:
+        """A replica died WITHOUT draining: push its last checkpointed
+        sessions through the router to whichever replicas its vehicles
+        rendezvous-rank to now.  Imported files are removed; anything
+        that could not travel stays on disk for the next attempt (the
+        respawned replica clears its own dir at boot, so this runs
+        BEFORE the respawn)."""
+        if self.ckpt_dir is None or c.rid is None:
+            return
+        d = os.path.join(self.ckpt_dir, c.rid)
+        from reporter_tpu.matching.session import SessionCheckpointer, \
+            read_checkpoints
+
+        wires = read_checkpoints(d)
+        if not wires:
+            return
+        try:
+            # exclude the corpse explicitly: this runs the instant the
+            # death is seen, often BEFORE the router's probe streak has
+            # marked the replica unavailable
+            res = _post_json(self.router.url + "/sessions",
+                             {"sessions": wires, "exclude": c.rid},
+                             timeout=90.0)
+        except Exception as e:  # noqa: BLE001 - files stay for a retry
+            log.warning("%s: checkpoint re-home failed: %s", c.name, e)
+            self._scale_event(event="rehome_failed", replica=c.rid,
+                              sessions=len(wires), error=str(e)[:200])
+            return
+        imported = set(res.get("imported_uuids") or ())
+        for w in wires:
+            u = str(w.get("uuid") or "")
+            if u in imported:
+                try:
+                    os.unlink(os.path.join(
+                        d, SessionCheckpointer._path_name(u)))
+                except OSError:
+                    pass
+        log.warning("%s: re-homed %d/%d checkpointed sessions "
+                    "(no_target=%s)", c.name, res.get("rehomed"),
+                    len(wires), res.get("no_target"))
+        self._scale_event(event="rehome", replica=c.rid,
+                          sessions=len(wires),
+                          rehomed=res.get("rehomed"),
+                          no_target=res.get("no_target"))
+
     def monitor(self) -> None:
         """Respawn unexpected deaths (crash-only replicas are the fault
         posture: the router keeps serving around the hole while the
-        supervisor refills it)."""
-        while not self._stop.wait(0.5):
+        supervisor refills it) — with crash-loop backoff + jitter, and a
+        checkpoint re-home before a dead replica's slot is refilled."""
+        while not self._stop.wait(0.25):
             if self._rolling.is_set():
                 continue  # the rolling-restart thread owns lifecycle now
+            now = time.monotonic()
             with self._lock:
-                for c in self.replicas + [self.router]:
-                    if c.proc is not None and not c.alive() \
-                            and not c.expected_exit:
-                        rc = c.proc.returncode
-                        log.warning("%s died rc=%s; respawning", c.name, rc)
+                children = list(self.replicas) + [self.router]
+            for c in children:
+                if c.proc is None or c.alive() or c.expected_exit:
+                    continue
+                if c.respawn_at == 0.0:
+                    # first sight of this death: back off, re-home
+                    rc = c.proc.returncode
+                    uptime = now - c.t_spawn
+                    delay = self.backoff.next_delay(c.name, uptime)
+                    log.warning("%s died rc=%s after %.1fs; respawn in "
+                                "%.2fs", c.name, rc, uptime, delay)
+                    if rc != 0:
+                        # a PREEMPTION (SIGKILL/crash): restore its last
+                        # checkpointed sessions through the router.  An
+                        # rc-0 exit was a graceful drain — the router's
+                        # handoff already moved those beams; re-homing
+                        # the leftover files would race the live copies.
+                        # Backgrounded: an import retrying through a
+                        # churning fleet must not freeze the monitor
+                        # (the files are read before the respawned
+                        # process clears its directory at attach)
+                        threading.Thread(
+                            target=self._rehome_checkpoints, args=(c,),
+                            daemon=True, name="rehome-%s" % c.name,
+                        ).start()
+                    c.respawn_at = now + delay if delay > 0 else -1.0
+                    with self._lock:
+                        self.write_state()
+                if c.respawn_at <= now or c.respawn_at < 0:
+                    with self._lock:
                         c.restarts += 1
                         c.spawn()
                         self.write_state()
+
+    # -- autoscaling (reporter_tpu/serve/autoscale.py) -----------------------
+
+    def _read_signals(self):
+        try:
+            statusz = _get_json(self.router.url + "/statusz", timeout=5.0)
+            slo = _get_json(self.router.url + "/debug/slo", timeout=5.0)
+        except Exception:  # noqa: BLE001 - blind polls make no decisions
+            return None
+        depth = 0.0
+        for row in statusz.get("fleet", ()):
+            try:
+                depth += float(row.get("queue_depth") or 0.0)
+            except (TypeError, ValueError):
+                pass
+        alerting = False
+        max_burn = 0.0
+        for o in slo.get("objectives", ()):
+            if o.get("kind") not in ("availability", "latency"):
+                continue
+            alerting = alerting or bool(o.get("alerting"))
+            for v in (o.get("burn") or {}).values():
+                try:
+                    max_burn = max(max_burn, float(v))
+                except (TypeError, ValueError):
+                    pass
+        with self._lock:
+            n = len(self.replicas)
+        return {"replicas": n, "queue_depth": depth,
+                "burn_alerting": alerting, "max_burn": max_burn}
+
+    def scale_up(self, reason: str) -> bool:
+        """Spawn one --warmup replica and register it with the router:
+        the router's warming hold-out keeps it OUT of the rendezvous
+        ring until /health reports attached+warmed, so no request is
+        ever served by a cold replica.  Blocks until admission (the
+        cooldown must start from a fleet that is actually bigger)."""
+        with self._scaling:
+            with self._lock:
+                c = self._make_replica()
+                self.replicas.append(c)
+                c.spawn()
+                self.write_state()
+            self._scale_event(event="spawned", direction="up",
+                              replica=c.rid, url=c.url, reason=reason)
+            try:
+                _post_json(self.router.url + "/fleet",
+                           {"add": c.url, "reason": reason}, timeout=15.0)
+            except Exception as e:  # noqa: BLE001
+                log.error("router add %s failed: %s", c.url, e)
+            warmed = wait_healthy(c.url, self.args.up_timeout,
+                                  want_warmed=True)
+            self._scale_event(event="admitted" if warmed else
+                              "admission_timeout", direction="up",
+                              replica=c.rid, url=c.url, reason=reason)
+            log.warning("scale-up %s: %s (%s)", c.rid,
+                        "admitted" if warmed else "ADMISSION TIMED OUT",
+                        reason)
+            return warmed
+
+    def scale_down(self, reason: str) -> bool:
+        """Drain the newest replica (SIGTERM -> graceful drain -> beam
+        handoff at the router), wait for the clean exit, then drop it
+        from the router's ring and the supervised set."""
+        with self._scaling:
+            with self._lock:
+                if len(self.replicas) <= 1:
+                    return False
+                c = self.replicas[-1]
+            self._scale_event(event="draining", direction="down",
+                              replica=c.rid, url=c.url, reason=reason)
+            rc = c.drain(self.args.drain_grace + 10.0)
+            try:
+                _post_json(self.router.url + "/fleet",
+                           {"remove": c.url, "reason": reason},
+                           timeout=15.0)
+            except Exception as e:  # noqa: BLE001
+                log.error("router remove %s failed: %s", c.url, e)
+            with self._lock:
+                self.replicas = [x for x in self.replicas if x is not c]
+                self.write_state()
+            if self._federator is not None:
+                # a scale-down leaves the fleet on purpose: drop its feed
+                # (unlike a death, whose stale snapshot is kept)
+                self._federator.remove_target(c.url)
+            self._scale_event(event="removed", direction="down",
+                              replica=c.rid, url=c.url, reason=reason,
+                              exit_rc=rc)
+            log.warning("scale-down %s: drained rc=%s (%s)",
+                        c.rid, rc, reason)
+            return rc == 0
+
+    def start_autoscaler(self) -> None:
+        from reporter_tpu.serve.autoscale import (Autoscaler,
+                                                  G_AUTOSCALE_REPLICAS)
+
+        a = self.args
+
+        def signals():
+            sig = self._read_signals()
+            if sig is not None:
+                G_AUTOSCALE_REPLICAS.set(sig["replicas"])
+            return sig
+
+        self.autoscaler = Autoscaler(
+            signals, self.scale_up, self.scale_down,
+            min_replicas=a.min_replicas, max_replicas=a.max_replicas,
+            poll_s=a.scale_poll, cooldown_s=a.scale_cooldown,
+            queue_high=a.scale_queue_high, window_s=a.scale_window,
+            down_after_s=(a.scale_down_after or None))
+        threading.Thread(target=self.autoscaler.run, args=(self._stop,),
+                         daemon=True, name="autoscaler").start()
+        log.info("autoscaler on: %d..%d replicas, queue_high=%.0f, "
+                 "window=%.0fs, cooldown=%.0fs", a.min_replicas,
+                 a.max_replicas, a.scale_queue_high, a.scale_window,
+                 a.scale_cooldown)
 
     def shutdown(self) -> int:
         self._stop.set()
@@ -297,6 +587,8 @@ class Fleet:
         if self.args.federate_every > 0:
             threading.Thread(target=self.federate, daemon=True,
                              name="fleet-federation").start()
+        if self.args.autoscale:
+            self.start_autoscaler()
         if self.args.rolling_restart_after > 0:
             def _timed():
                 if not self._stop.wait(self.args.rolling_restart_after):
@@ -344,6 +636,36 @@ def main(argv=None) -> int:
                          "<workdir>/federation.json (0 disables)")
     ap.add_argument("--cpu-default", action="store_true",
                     help="default children to JAX_PLATFORMS=cpu when unset")
+    # self-driving knobs (docs/serving-fleet.md "Self-driving fleet")
+    ap.add_argument("--autoscale", action="store_true",
+                    help="run the burn-rate autoscaler control thread")
+    ap.add_argument("--min-replicas", type=int, default=1)
+    ap.add_argument("--max-replicas", type=int, default=8)
+    ap.add_argument("--scale-poll", type=float, default=1.0,
+                    help="autoscaler signal poll interval (seconds)")
+    ap.add_argument("--scale-cooldown", type=float, default=20.0,
+                    help="seconds after a scale action before the next "
+                         "decision")
+    ap.add_argument("--scale-queue-high", type=float, default=8.0,
+                    help="summed replica queue depth counting as queue "
+                         "pressure for the sustained gate")
+    ap.add_argument("--scale-window", type=float, default=30.0,
+                    help="the sustained-queue gate's long window (its "
+                         "fast window is a sixth of it)")
+    ap.add_argument("--scale-down-after", type=float, default=0.0,
+                    help="seconds of calm before a scale-down (0 = "
+                         "2x the gate window)")
+    ap.add_argument("--respawn-backoff-base", type=float, default=0.5,
+                    help="crash-loop backoff base (doubles per "
+                         "consecutive quick death, full jitter)")
+    ap.add_argument("--respawn-backoff-max", type=float, default=30.0)
+    ap.add_argument("--session-checkpoint", type=float, default=0.0,
+                    help="session checkpoint cadence seconds for every "
+                         "replica (0 = off); enables the SIGKILL "
+                         "re-home path")
+    ap.add_argument("--session-checkpoint-sync", action="store_true",
+                    help="checkpoint each session commit synchronously "
+                         "(zero lost answered points under SIGKILL)")
     args = ap.parse_args(argv)
     return Fleet(args).run()
 

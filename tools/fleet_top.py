@@ -128,6 +128,19 @@ def render_frame(metrics: dict, prev: Optional[dict], statusz: dict,
     lines.append("masking debt: %s" % (
         "  ".join("%s=%.3f" % kv for kv in hot.items()) if hot
         else "0 (no replica burn hidden by failover)"))
+    # the self-driving plane (docs/serving-fleet.md "Self-driving
+    # fleet"): replica count, the adaptive hedge's live value, and the
+    # most recent scale decision off the router's event ring
+    asc = statusz.get("autoscale") or {}
+    if asc:
+        ev = asc.get("events") or []
+        last = ("%(direction)s %(url)s (%(reason)s)" % ev[-1]
+                if ev else "none yet")
+        lines.append(
+            "autoscale: %s replicas  adaptive=%s  hedge=%sms  last: %s" % (
+                asc.get("replicas", "?"),
+                "on" if asc.get("adaptive") else "off",
+                asc.get("hedge_effective_ms", "-"), last))
     lines.append("  (* = stale snapshot: the replica's LAST numbers; "
                  "deg: y=degraded drn=draining)")
     return "\n".join(lines)

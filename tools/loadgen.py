@@ -174,19 +174,57 @@ def interleave(sessions: List[Tuple[str, List[dict]]]) -> List[dict]:
         k += 1
 
 
-def stream_points(sessions: List[Tuple[str, List[dict]]]) -> List[dict]:
-    """The per-point streaming corpus (docs/performance.md "The session
-    matcher"): every probe of every vehicle becomes ONE single-point
-    ``"stream": true`` /report body, round-robin across vehicles with
-    each vehicle's point order preserved — the open-loop firehose the
-    session matcher answers at point latency."""
+def stream_sessions(sessions: List[Tuple[str, List[dict]]]) -> List[Tuple[str, List[dict]]]:
+    """Per-vehicle single-point ``"stream": true`` request lists in
+    point order (the per-uuid form both the round-robin interleave and
+    the skewed sampler consume)."""
     per_uuid = []
     for uuid, reqs in sessions:
         flat = [p for r in reqs for p in r["trace"]]
         per_uuid.append((uuid, [
             {"uuid": uuid, "stream": True, "trace": [p],
              "match_options": dict(MATCH_OPTIONS)} for p in flat]))
-    return interleave(per_uuid)
+    return per_uuid
+
+
+def stream_points(sessions: List[Tuple[str, List[dict]]]) -> List[dict]:
+    """The per-point streaming corpus (docs/performance.md "The session
+    matcher"): every probe of every vehicle becomes ONE single-point
+    ``"stream": true`` /report body, round-robin across vehicles with
+    each vehicle's point order preserved — the open-loop firehose the
+    session matcher answers at point latency."""
+    return interleave(stream_sessions(sessions))
+
+
+def skewed_requests(per_uuid: List[Tuple[str, List[dict]]], n: int,
+                    share: float, hot_frac: float, rng: random.Random,
+                    stream: bool) -> List[dict]:
+    """Regional-skew corpus (the hot-city scenario, docs/serving-fleet.md
+    "Self-driving fleet"): ``share`` of the offered traffic is drawn
+    from the hottest ``hot_frac`` of vehicles, so a few uuids
+    concentrate load on their rendezvous-affine replicas while the rest
+    of the fleet idles — the affinity-stressing shape uniform replay
+    never produces.  Each vehicle's own request order is preserved; an
+    exhausted vehicle recycles (streams recycle as a fresh uuid so an
+    open session's clock never rewinds)."""
+    k = max(1, min(len(per_uuid) - 1, int(round(hot_frac * len(per_uuid))))) \
+        if len(per_uuid) > 1 else 1
+    hot, cold = per_uuid[:k], per_uuid[k:]
+    state = {u: {"i": 0, "cyc": 0} for u, _reqs in per_uuid}
+    out = []
+    for _ in range(n):
+        pool = hot if (not cold or rng.random() < share) else cold
+        uuid, reqs = pool[rng.randrange(len(pool))]
+        st = state[uuid]
+        if st["i"] >= len(reqs):
+            st["i"] = 0
+            st["cyc"] += 1
+        r = dict(reqs[st["i"]])
+        st["i"] += 1
+        if st["cyc"] and stream:
+            r["uuid"] = "%s~c%d" % (r["uuid"], st["cyc"])
+        out.append(r)
+    return out
 
 
 def fold_stream_windows(point_reqs: List[dict], schedule: List[float],
@@ -242,6 +280,63 @@ def build_schedule(n: int, rate: float, arrival: str,
         t += rng.expovariate(rate) if arrival == "poisson" else 1.0 / rate
         out.append(t)
     return out
+
+
+def profile_rate_fn(profile: str, base_rate: float, duration: float):
+    """Time-varying offered-rate profiles (docs/serving-fleet.md
+    "Self-driving fleet" — the shapes production actually sees):
+
+      "diurnal"             one compressed day: a sinusoid from 0.25x
+                            (night) through 1.75x (peak) of --rate,
+                            starting at the trough
+      "flash:<f0>:<f1>:<m>" a flash crowd: --rate baseline, multiplied
+                            by <m> between fractions <f0> and <f1> of
+                            the duration (e.g. flash:0.3:0.7:5)
+    """
+    import math
+
+    if profile == "diurnal":
+        return lambda t: base_rate * (
+            1.0 - 0.75 * math.cos(2.0 * math.pi * t / max(duration, 1e-9)))
+    if profile.startswith("flash:"):
+        try:
+            _, f0, f1, mult = profile.split(":")
+            f0, f1, mult = float(f0), float(f1), float(mult)
+            assert 0.0 <= f0 < f1 <= 1.0 and mult > 0
+        except (ValueError, AssertionError):
+            raise ValueError("--profile flash wants flash:<f0>:<f1>:<mult> "
+                             "with 0 <= f0 < f1 <= 1") from None
+        t0, t1 = f0 * duration, f1 * duration
+        return lambda t: base_rate * (mult if t0 <= t < t1 else 1.0)
+    raise ValueError("unknown --profile %r (diurnal | flash:f0:f1:mult)"
+                     % profile)
+
+
+def profile_schedule(rate: float, duration: float, profile: str,
+                     arrival: str, rng: random.Random) -> List[float]:
+    """Arrival offsets under a time-varying rate.  Poisson arrivals come
+    from inhomogeneous thinning against the profile's peak rate (exact
+    for piecewise shapes, unbiased for the sinusoid); uniform arrivals
+    integrate the rate stepwise."""
+    fn = profile_rate_fn(profile, rate, duration)
+    peak = max(fn(duration * i / 1000.0) for i in range(1001))
+    if peak <= 0:
+        raise ValueError("profile rate must be > 0 somewhere")
+    out: List[float] = []
+    t = 0.0
+    if arrival == "poisson":
+        while True:
+            t += rng.expovariate(peak)
+            if t >= duration:
+                return out
+            if rng.random() < fn(t) / peak:
+                out.append(t)
+    while True:
+        r = max(fn(t), 1e-9)
+        t += 1.0 / r
+        if t >= duration:
+            return out
+        out.append(t)
 
 
 def timeline_schedule(requests: List[dict], warp: float) -> List[float]:
@@ -425,10 +520,21 @@ def step_stats(samples: List[Sample], offered_rate: float) -> dict:
         codes[k] = codes.get(k, 0) + 1
         if s.replica:
             replicas[s.replica] = replicas.get(s.replica, 0) + 1
+    # the overload ledger: admitted traffic (200s) judged on its own —
+    # "shed exactly down to capacity" means the admitted tail holds its
+    # objective while shed_fraction tracks the excess offered load
+    # (docs/serving-fleet.md "Self-driving fleet")
+    admitted = [s for s in samples if s.code == 200]
+    shed = sum(1 for s in samples if s.code in (429, 503))
     return {
         "n": len(samples),
         "offered_rps": round(offered_rate, 3),
         "achieved_rps": round(len(samples) / span, 3) if span > 0 else None,
+        "admitted_rps": (round(len(admitted) / span, 3)
+                         if span > 0 else None),
+        "admitted_quantiles": quantiles_ms([s.latency_s for s in admitted]),
+        "shed_fraction": (round(shed / len(samples), 4)
+                          if samples else None),
         "status": dict(sorted(codes.items())),
         # per-replica request distribution (X-Reporter-Replica echoes):
         # the fleet rehearsal's affinity and failover assertions read this
@@ -469,6 +575,17 @@ def main(argv=None) -> int:
                          "knee (achieved/offered and SLO per step)")
     ap.add_argument("--arrival", choices=("poisson", "uniform"),
                     default="poisson")
+    ap.add_argument("--profile", default=None,
+                    help="time-varying offered rate over --duration: "
+                         "diurnal (compressed day, 0.25x..1.75x of "
+                         "--rate) or flash:<f0>:<f1>:<mult> (flash "
+                         "crowd between fractions f0..f1 of the run); "
+                         "ignored with --ramp / --time-warp")
+    ap.add_argument("--skew", default=None,
+                    help="regional skew <share>:<hot_frac> — <share> of "
+                         "requests drawn from the hottest <hot_frac> of "
+                         "vehicles (e.g. 0.8:0.1: 80%% of traffic on "
+                         "10%% of uuids, the hot-city affinity stress)")
     ap.add_argument("--seed", type=int, default=1)
     ap.add_argument("--concurrency", type=int, default=32)
     ap.add_argument("--timeout-s", type=float, default=10.0)
@@ -563,7 +680,16 @@ def main(argv=None) -> int:
         return 2
     if args.stream_window < 1:
         ap.error("--stream-window must be >= 1")
-    corpus = stream_points(sessions) if args.stream else interleave(sessions)
+    per_uuid = stream_sessions(sessions) if args.stream else sessions
+    corpus = interleave(per_uuid)
+    skew = None
+    if args.skew:
+        try:
+            share, hot_frac = (float(x) for x in args.skew.split(":"))
+            assert 0.0 < share <= 1.0 and 0.0 < hot_frac <= 1.0
+            skew = (share, hot_frac)
+        except (ValueError, AssertionError):
+            ap.error("--skew wants <share>:<hot_frac>, both in (0, 1]")
 
     # rate steps
     if args.ramp:
@@ -591,21 +717,39 @@ def main(argv=None) -> int:
                 r.pop("_t0", None)
             offered = (len(schedule) / schedule[-1]) if schedule and schedule[-1] > 0 else 0.0
         else:
-            n = max(1, int(rate * args.duration))
-            reqs = []
-            for i in range(n):
-                r = dict(corpus[i % len(corpus)])
-                cyc = i // len(corpus)
-                if cyc and args.stream:
-                    # a re-cycled stream point must not rewind an open
-                    # session's clock: each pass over the corpus streams
-                    # as a fresh fleet of vehicles
-                    r["uuid"] = "%s~c%d" % (r["uuid"], cyc)
-                reqs.append(r)
+            if args.profile and not args.ramp:
+                try:
+                    schedule = profile_schedule(rate, args.duration,
+                                                args.profile,
+                                                args.arrival, rng)
+                except ValueError as e:
+                    ap.error(str(e))
+                if not schedule:
+                    sys.stderr.write("loadgen: profile produced an empty "
+                                     "schedule\n")
+                    return 2
+                n = len(schedule)
+                offered = n / max(args.duration, 1e-9)
+            else:
+                n = max(1, int(rate * args.duration))
+                schedule = build_schedule(n, rate, args.arrival, rng)
+                offered = rate
+            if skew is not None:
+                reqs = skewed_requests(per_uuid, n, skew[0], skew[1],
+                                       rng, args.stream)
+            else:
+                reqs = []
+                for i in range(n):
+                    r = dict(corpus[i % len(corpus)])
+                    cyc = i // len(corpus)
+                    if cyc and args.stream:
+                        # a re-cycled stream point must not rewind an
+                        # open session's clock: each pass over the
+                        # corpus streams as a fresh fleet of vehicles
+                        r["uuid"] = "%s~c%d" % (r["uuid"], cyc)
+                    reqs.append(r)
             for r in reqs:
                 r.pop("_t0", None)
-            schedule = build_schedule(n, rate, args.arrival, rng)
-            offered = rate
         if args.stream and args.stream_window > 1:
             reqs, schedule, dropped = fold_stream_windows(
                 reqs, schedule, args.stream_window)
@@ -702,10 +846,15 @@ def main(argv=None) -> int:
                    if args.stream else None),
         "gap_s": gaps,
         "time_warp": args.time_warp or None,
+        "profile": args.profile,
+        "skew": args.skew,
         "sessions": len(sessions),
         "requests": len(all_samples),
         "offered_rps": steps_out[-1]["offered_rps"],
         "achieved_rps": head["achieved_rps"],
+        "admitted_rps": head["admitted_rps"],
+        "admitted_quantiles": head["admitted_quantiles"],
+        "shed_fraction": head["shed_fraction"],
         "status": head["status"],
         "replica_distribution": head["replicas"],
         "degraded": head["degraded"],
