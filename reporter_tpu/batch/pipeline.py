@@ -45,6 +45,7 @@ import uuid as uuidlib
 from typing import Callable, Iterable, List, Optional, Sequence, Tuple
 
 from ..anonymise.storage import make_store
+from ..utils import journal
 from ..obs import flight as obs_flight
 from ..obs import metrics as obs
 from ..obs import trace as obs_trace
@@ -122,53 +123,15 @@ def _collect_worker_snaps(snap_dir: str) -> None:
     shutil.rmtree(snap_dir, ignore_errors=True)
 
 
-def _mark_done(done_path: Optional[str], unit: str) -> None:
-    """Worker-side progress journal: one line per processed work unit, so
-    the parent can requeue ONLY what a dead worker left unfinished (a unit
-    in flight at the crash replays — at-least-once, never silent loss)."""
-    if not done_path:
-        return
-    try:
-        with open(done_path, "a") as f:
-            f.write(unit + "\n")
-    except OSError:  # progress journalling must never fail the phase
-        log.warning("could not journal progress to %s", done_path)
-
-
-def _unfinished_units(chunks, procs, done_dir: str) -> List[str]:
-    """Units assigned to dead workers minus what their done-journals
-    record as processed."""
-    remaining: List[str] = []
-    for i, p in enumerate(procs):
-        if p.exitcode == 0:
-            continue
-        done = set()
-        try:
-            with open(os.path.join(done_dir, "w%d.done" % i)) as f:
-                done = {line.rstrip("\n") for line in f}
-        except OSError:
-            pass  # worker died before journalling anything
-        remaining.extend(k for k in chunks[i] if k not in done)
-    return remaining
-
+# per-unit done-file journaling + fan-out helpers: shared with the
+# distributed UBODT builder (tiles/ubodt.py) via utils/journal
+_mark_done = journal.mark_done
+_unfinished_units = journal.unfinished_units
+split = journal.split
 
 DEFAULT_VALUER = (
     'lambda l: (lambda c: (c[1], c[0], c[9], c[10], c[5]))(l.split("|"))'
 )
-
-
-def split(items: Sequence, n: int) -> List[List]:
-    """Balanced n-way split, same contract as simple_reporter.py:70-79."""
-    items = list(items)
-    size = int(math.ceil(len(items) / float(n)))
-    cutoff = len(items) % n
-    result = []
-    pos = 0
-    for i in range(n):
-        end = pos + size if cutoff == 0 or i < cutoff else pos + size - 1
-        result.append(items[pos:end])
-        pos = end
-    return result
 
 
 def compile_valuer(source: Optional[str]) -> Callable:
@@ -644,16 +607,7 @@ def report_tiles(
     return failures
 
 
-def _join_checked(procs) -> int:
-    """Join workers and count the ones that died abnormally -- a crashed
-    worker must not read as success."""
-    dead = 0
-    for p in procs:
-        p.join()
-        if p.exitcode != 0:
-            dead += 1
-            log.error("worker %s exited with code %s", p.name, p.exitcode)
-    return dead
+_join_checked = journal.join_checked
 
 
 # -- driver ----------------------------------------------------------------

@@ -46,6 +46,21 @@ class MatcherConfig:
     # static unique budget falls back to the plain probe in-program.
     # $REPORTER_PROBE_DEDUP=0|1 overrides at runtime.
     probe_dedup: bool = False
+    # hot/cold UBODT tiering (docs/performance.md "Continent-scale data
+    # plane"): > 0 = the device holds a hot-bucket arena of at most this
+    # many bytes while the full table stays host-paged behind the
+    # lax.cond full-width fallback (tiles/tiering.py) — for tables bigger
+    # than resident device memory.  0 = the whole table device-resident
+    # (every bench and test default).  $REPORTER_UBODT_HOT_BYTES
+    # overrides; match output is bit-identical either way.
+    ubodt_hot_bytes: int = 0
+    # fleet shard assignment "i/N" (docs/serving-fleet.md "Sharded
+    # tables"): seeds the hot arena with this replica's contiguous
+    # bucket-range partition — the same partition the gp shard_map probe
+    # and the distributed builder use — and is advertised on /health so
+    # the router's optional geo-aware ranking can steer matching traffic
+    # here.  "" = unsharded.  $REPORTER_UBODT_SHARD overrides.
+    ubodt_shard: str = ""
     # viterbi forward selection (docs/performance.md): "scan" = sequential
     # lax.scan (O(T) depth, least work), "assoc" = log-depth associative
     # max-plus scan, "auto" = assoc for padded window lengths >=

@@ -161,6 +161,25 @@ class SegmentMatcher:
         elif getattr(ubodt, "layout", "cuckoo") != self._ubodt_layout:
             ubodt = ubodt.relayout(self._ubodt_layout)
         self.ubodt = ubodt
+        # hot/cold tiering + fleet shard assignment (docs/performance.md
+        # "Continent-scale data plane"): $REPORTER_UBODT_HOT_BYTES > 0
+        # keeps only a hot-bucket arena device-resident (host-paged cold
+        # rows, bit-identical output); $REPORTER_UBODT_SHARD="i/N" seeds
+        # that arena with this replica's bucket-range partition
+        env_hot = os.environ.get("REPORTER_UBODT_HOT_BYTES", "").strip()
+        try:
+            self._ubodt_hot_bytes = int(env_hot) if env_hot else int(
+                getattr(self.cfg, "ubodt_hot_bytes", 0) or 0)
+        except ValueError:
+            raise ValueError(
+                "REPORTER_UBODT_HOT_BYTES must be an integer byte count, "
+                "got %r" % (env_hot,))
+        from ..tiles.tiering import parse_shard
+
+        self.ubodt_shard = parse_shard(
+            os.environ.get("REPORTER_UBODT_SHARD", "").strip()
+            or getattr(self.cfg, "ubodt_shard", "") or "")
+        self.tiering = None
         self.backend = backend
         # viterbi forward selection (docs/performance.md): scan = sequential
         # lax.scan (O(T) depth), assoc = log-depth associative max-plus scan,
@@ -241,7 +260,26 @@ class SegmentMatcher:
         from ..ops.viterbi import MatchParams
 
         self._dg = self.arrays.to_device()
-        self._du = self.ubodt.to_device()
+        if self._ubodt_hot_bytes > 0 and max(
+                1, int(self.cfg.devices)) == 1:
+            # tiered table: hot-bucket arena on device, cold rows paged
+            # from host behind the lax.cond full-width fallback
+            # (tiles/tiering.py; output bit-identical to the resident
+            # table).  Mutually exclusive with a device mesh — the gp
+            # shard_map path is the in-replica HBM-scaling alternative.
+            from ..tiles.tiering import TieredTable
+
+            self.tiering = TieredTable(
+                self.ubodt, self._ubodt_hot_bytes, shard=self.ubodt_shard)
+            self._du = self.tiering.device()
+        else:
+            if self._ubodt_hot_bytes > 0:
+                log.warning(
+                    "REPORTER_UBODT_HOT_BYTES ignored: tiering does not "
+                    "compose with a device mesh (cfg.devices=%d); the gp "
+                    "shard_map path is the in-replica alternative",
+                    self.cfg.devices)
+            self._du = self.ubodt.to_device()
         self._params = MatchParams.from_config(self.cfg)
 
         # device mesh in the product path (VERDICT r03 next #4): with

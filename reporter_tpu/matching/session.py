@@ -75,6 +75,14 @@ H_STEP_SESSIONS = obs.histogram(
     "reporter_session_step_sessions",
     "Sessions folded per incremental session-step device dispatch",
     buckets=obs.BATCH_FILL_BUCKETS)
+C_SESSION_DEDUP = obs.counter(
+    "reporter_session_dedup_points_total",
+    "Streaming points dropped at SessionEngine admission because an "
+    "identical raw point (time, lat, lon) already lives in the "
+    "session's replay buffer — a hedged \"stream\": true request that "
+    "landed on two replicas (or a client retry racing a slow answer) "
+    "commits once; the duplicate still gets a full answer from the "
+    "accumulated tail (docs/serving-fleet.md \"Beam handoff\")")
 C_CKPT = obs.counter(
     "reporter_session_checkpoints_total",
     "Session checkpoint events (written / pruned / cleared / error) — "
@@ -499,23 +507,60 @@ class SessionEngine:
             if ent is None:
                 ent = order[uuid] = {
                     "uuid": uuid, "pkey": m._params_key(tr),
-                    "subs": [], "points": []}
-            ent["subs"].append((i, len(ent["points"]), len(pts)))
-            ent["points"].extend(pts)
+                    "raw_subs": []}
+            ent["raw_subs"].append((i, pts))
 
         # resolve sessions + build the dispatch items.  The store is only
         # READ here; rebuild-from-replay prepends the replay buffer to the
         # step so the beam reconstitutes inside the same dispatch.
+        # Hedging-aware idempotency (docs/serving-fleet.md "Beam
+        # handoff"): admission DEDUPS each sub-request's points by raw
+        # replay-point identity (time, lat, lon) against the session's
+        # replay buffer — a hedged streaming point that landed on two
+        # replicas (one leg committed, the handoff merged, then the other
+        # leg's copy arrives here) or a client retry commits ONCE; the
+        # duplicate delivery still gets a full answer from the
+        # accumulated tail.  The identity window is the replay buffer
+        # depth (session_tail_points), the same identity import_wire's
+        # merge-dedup uses, so the fleet points ledger stays exact under
+        # any interleaving of hedges, retries and handoffs.
         items = []
+        dispatch_map = []
         for ent in order.values():
-            pts = ent["points"]
-            t_first = float(pts[0]["time"]) if pts else 0.0
+            raw_first = next(
+                (p for _i, pts in ent["raw_subs"] for p in pts), None)
+            t_first = float(raw_first["time"]) if raw_first else 0.0
             sess = self.store.get_or_open(ent["uuid"], t_first, ent["pkey"])
             ent["sess"] = sess
+            seen = {(p.get("time"), p.get("lat"), p.get("lon"))
+                    for p in sess.replay}
+            subs, points, dups = [], [], 0
+            for i, pts in ent["raw_subs"]:
+                fresh = []
+                for p in pts:
+                    key = (p.get("time"), p.get("lat"), p.get("lon"))
+                    if key in seen:
+                        dups += 1
+                        continue
+                    seen.add(key)
+                    fresh.append(p)
+                subs.append((i, len(points), len(fresh)))
+                points.extend(fresh)
+            ent["subs"] = subs
+            ent["points"] = points
+            if dups:
+                C_SESSION_DEDUP.inc(dups)
             rebuild = sess.rebuild_pending and bool(sess.replay)
             ent["rebuild"] = rebuild
-            step_pts = (list(sess.replay) + pts) if rebuild else pts
+            if not points and not rebuild:
+                # every point was a duplicate delivery: nothing to
+                # dispatch or commit — answer from the accumulated tail
+                ent["noop"] = True
+                continue
+            ent["noop"] = False
+            step_pts = (list(sess.replay) + points) if rebuild else points
             ent["n_prefix"] = len(sess.replay) if rebuild else 0
+            dispatch_map.append(ent)
             items.append({
                 "points": step_pts,
                 "carry": None if rebuild else sess.carry,
@@ -536,11 +581,25 @@ class SessionEngine:
                     # flight: its futures are already failed — commit
                     # nothing, answer nothing (late-commit guard)
                     return results  # type: ignore[return-value]
-                for ent, (rec, aux, carry_out) in zip(entries, step_out):
+                for ent, (rec, aux, carry_out) in zip(dispatch_map,
+                                                      step_out):
                     self._apply(ent, rec, aux, carry_out, results)
+                for ent in entries:
+                    if ent.get("noop"):
+                        self._answer_noop(ent, results)
             return results  # type: ignore[return-value]
 
         return finish
+
+    def _answer_noop(self, ent: dict, results) -> None:
+        """Answer duplicate-only sub-requests from the accumulated tail
+        without committing anything — the idempotent replay of an answer
+        that already left (or is leaving) through the first delivery."""
+        sess: SessionState = ent["sess"]
+        for i, _p0, _n in ent["subs"]:
+            results[i] = self._render(
+                sess, list(sess.records), list(sess.replay), None, n_new=0,
+                meta=dict(sess.meta(), points=0, deduped=True))
 
     def _apply(self, ent: dict, rec, aux, carry_out, results) -> None:
         """Fold one entry's device answer into its session and render the
@@ -665,6 +724,15 @@ class SessionEngine:
     def _degraded_step_locked(self, cpu_matcher, trace, uuid, pts, pkey,
                               t_first) -> dict:
         sess = self.store.get_or_open(uuid, t_first, pkey)
+        # same admission dedup as the healthy path: a hedged duplicate
+        # arriving during a degradation window must not double-commit
+        seen = {(p.get("time"), p.get("lat"), p.get("lon"))
+                for p in sess.replay}
+        fresh = [p for p in pts
+                 if (p.get("time"), p.get("lat"), p.get("lon")) not in seen]
+        if len(fresh) < len(pts):
+            C_SESSION_DEDUP.inc(len(pts) - len(fresh))
+        pts = fresh
         win_raw = list(sess.replay) + [
             {"lat": p["lat"], "lon": p["lon"], "time": p["time"]}
             for p in pts]

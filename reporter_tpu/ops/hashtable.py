@@ -119,18 +119,30 @@ def _select(rows: jnp.ndarray, src: jnp.ndarray, dst: jnp.ndarray):
     return dist, time, first
 
 
+def _bucket_rows(u, b: jnp.ndarray) -> jnp.ndarray:
+    """One bucket-row fetch [..., 128 or 256] — a plain gather from a
+    device-resident packed table, or the hot-arena / host-paged two-tier
+    path when the table is tiered (tiles/tiering.py: bit-identical rows
+    either way, only the executed memory traffic changes)."""
+    if getattr(u, "tier", None) is None:
+        return u.packed[b]
+    from ..tiles.tiering import tiered_bucket_rows
+
+    return tiered_bucket_rows(u, b)
+
+
 def _lookup_plain(u: DeviceUBODT, src: jnp.ndarray, dst: jnp.ndarray):
     """The architectural-constant probe: one aligned row DMA per hash
     function (wide32: one; cuckoo: two, merged elementwise)."""
     with stage("ubodt-probe"):
         b1 = device_pair_hash(src, dst, u.bmask)
-        r1 = u.packed[b1]  # [..., 128 or 256]: one aligned lane-row DMA per probe
+        r1 = _bucket_rows(u, b1)  # [..., 128 or 256]: one aligned lane-row DMA per probe
     if u.layout == "wide32":
         with stage("select"):
             return _select(r1, src, dst)
     with stage("ubodt-probe"):
         b2 = device_pair_hash2(src, dst, u.bmask)
-        r2 = u.packed[b2]
+        r2 = _bucket_rows(u, b2)
     # select per bucket and combine: keys are unique, so at most one bucket
     # hits and an elementwise min/max merges exactly.  (Concatenating the
     # two row sets first materialised a [..., 2*BUCKET*ROW_W] array — ~11 ms

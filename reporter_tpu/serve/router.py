@@ -69,6 +69,7 @@ import argparse
 import hashlib
 import json
 import logging
+import os
 import random
 import re
 import threading
@@ -163,6 +164,13 @@ C_HANDOFF = obs.counter(
     "export_failed / import_failed; docs/serving-fleet.md \"Beam "
     "handoff\")",
     ("outcome",))
+C_GEO = obs.counter(
+    "reporter_router_geo_requests_total",
+    "Requests ranked with the flag-gated geo-aware term "
+    "(REPORTER_ROUTER_GEO), by outcome: steered = the geo term changed "
+    "the primary replica vs the plain rendezvous hash, aligned = it "
+    "agreed (docs/serving-fleet.md \"Sharded tables\")",
+    ("outcome",))
 C_SCALE = obs.counter(
     "reporter_fleet_scale_events_total",
     "Fleet scale events accepted at the router's admin surface (POST "
@@ -183,12 +191,26 @@ def rendezvous_score(uuid: str, replica_url: str) -> int:
     return int.from_bytes(h.digest(), "big")
 
 
+def geo_cell(lat: float, lon: float, cell_deg: float) -> int:
+    """Stable id of the ``cell_deg``-degree geographic cell containing a
+    point — the locality key of the optional geo-aware ranking term
+    (docs/serving-fleet.md "Sharded tables").  Hashed so consecutive
+    cells spread across shard indices instead of striping."""
+    cell_deg = max(1e-6, float(cell_deg))
+    key = "%d|%d" % (int(lat // cell_deg), int(lon // cell_deg))
+    h = hashlib.blake2b(key.encode("utf-8"), digest_size=8)
+    return int.from_bytes(h.digest(), "big")
+
+
 class Replica:
     """One backend serve process, as the router sees it."""
 
     def __init__(self, url: str):
         self.url = url.rstrip("/")
         self.id: Optional[str] = None       # learned from X-Reporter-Replica
+        # UBODT shard assignment "i/N" learned from the /health payload
+        # (docs/serving-fleet.md "Sharded tables"); None = unsharded
+        self.shard: Optional[str] = None
         self.state = "init"                  # init|healthy|draining|unhealthy
         self.probe_fail_streak = 0
         self.probe_ok_streak = 0
@@ -221,6 +243,7 @@ class Replica:
         now = _time.monotonic()
         return {
             "url": self.url, "id": self.id, "state": self.state,
+            "shard": self.shard,
             "available": self.available(now),
             "fail_streak": self.fail_streak,
             "probe_fail_streak": self.probe_fail_streak,
@@ -306,6 +329,20 @@ class FleetRouter:
         self.federator = obs_fed.Federator(
             [r.url for r in self.replicas], pool=self.pool,
             fleet_engine=self.slo)
+        # optional geo-aware ranking term (docs/serving-fleet.md "Sharded
+        # tables"): OFF by default — with the flag off the ranking is the
+        # PR 9 rendezvous hash bit-for-bit.  On, a request carrying a
+        # usable first coordinate prefers replicas whose advertised UBODT
+        # shard covers its geographic cell (cell id mod shard count), so
+        # vehicles in one region concentrate their probe traffic on the
+        # replica whose hot arena holds that region's bucket partition;
+        # the rendezvous hash still breaks ties, so per-vehicle affinity
+        # inside a cell is stable.
+        self.geo_routing = os.environ.get(
+            "REPORTER_ROUTER_GEO", "").strip().lower() in (
+                "1", "true", "on", "yes")
+        self.geo_cell_deg = _resolve_num(
+            "REPORTER_ROUTER_GEO_CELL_DEG", None, 0.25)
         # probe-phase jitter fraction: each replica's next probe lands at
         # interval * (1 + U[0, jitter]) so N replicas spread out instead
         # of being probed in lockstep every tick
@@ -395,6 +432,9 @@ class FleetRouter:
         rid = headers.get("X-Reporter-Replica") or info.get("replica")
         if rid:
             r.id = str(rid)
+        shard = info.get("ubodt_shard")
+        if shard:
+            r.shard = str(shard)
         r.last_probe = {"status": status,
                         "state": info.get("status"),
                         "t": round(_time.time(), 3)}
@@ -822,17 +862,50 @@ class FleetRouter:
 
     # -- routing ------------------------------------------------------------
 
-    def ranked(self, uuid: str) -> List[Replica]:
+    def _geo_pref(self, r: Replica, cell: int) -> int:
+        """1 when replica ``r``'s advertised shard covers geographic cell
+        ``cell`` (cell id mod shard count == shard index), else 0."""
+        shard = r.shard
+        if not shard:
+            return 0
+        try:
+            idx_s, n_s = str(shard).split("/", 1)
+            idx, n = int(idx_s), int(n_s)
+        except ValueError:
+            return 0
+        return 1 if n > 0 and cell % n == idx else 0
+
+    def ranked(self, uuid: str,
+               geo: Optional[Tuple[float, float]] = None) -> List[Replica]:
+        """Replicas in rendezvous order.  With the geo flag ON and a
+        usable coordinate, the shard-covering replica ranks first and the
+        rendezvous hash breaks ties; with the flag off (the default) the
+        ranking is the PR 9 rendezvous hash bit-for-bit — ``geo`` is
+        never even computed by the callers then."""
+        if geo is not None and self.geo_routing:
+            cell = geo_cell(geo[0], geo[1], self.geo_cell_deg)
+            ranked = sorted(
+                self.replicas,
+                key=lambda r: (self._geo_pref(r, cell),
+                               rendezvous_score(uuid, r.url)),
+                reverse=True)
+            plain_top = max(self.replicas,
+                            key=lambda r: rendezvous_score(uuid, r.url))
+            C_GEO.labels("aligned" if ranked[0] is plain_top
+                         else "steered").inc()
+            return ranked
         return sorted(self.replicas,
                       key=lambda r: rendezvous_score(uuid, r.url),
                       reverse=True)
 
-    def route_order(self, uuid: str) -> Tuple[List[Replica], bool]:
+    def route_order(self, uuid: str,
+                    geo: Optional[Tuple[float, float]] = None,
+                    ) -> Tuple[List[Replica], bool]:
         """(available replicas in rendezvous order, remapped?) — remapped
         is True when the vehicle's true primary is out and its traffic is
         landing elsewhere (the affinity disruption the remap counter and
         the chaos suite measure)."""
-        ranked = self.ranked(uuid)
+        ranked = self.ranked(uuid, geo)
         now = _time.monotonic()
         order = [r for r in ranked if r.available(now)]
         remapped = bool(order) and order[0] is not ranked[0]
@@ -976,7 +1049,8 @@ class FleetRouter:
         raise TimeoutError("hedged request: no replica answered in time")
 
     def dispatch(self, endpoint: str, body: bytes, uuid: str,
-                 fwd_headers: dict, span: Optional[Span] = None):
+                 fwd_headers: dict, span: Optional[Span] = None,
+                 geo: Optional[Tuple[float, float]] = None):
         """Route one request: rendezvous order, failover under the shared
         retry budget, optional hedging.  Returns (status, headers, body,
         outcome) — outcome feeds the router request counter.  ``span``
@@ -986,7 +1060,7 @@ class FleetRouter:
         so ``GET /debug/traces?id=`` can show which replicas were tried
         and why."""
         t_rank = _time.monotonic()
-        order, remapped = self.route_order(uuid)
+        order, remapped = self.route_order(uuid, geo)
         hops: List[dict] = []
         hop_lock = threading.Lock()
 
@@ -1372,7 +1446,7 @@ class FleetRouter:
                 return n
 
             def _proxy(self, endpoint: str, payload_bytes: bytes,
-                       uuid: str):
+                       uuid: str, geo=None):
                 t0 = _time.monotonic()
                 # fleet-SLO route: streaming session submits classify
                 # under "report_stream" like they do replica-side, so the
@@ -1422,7 +1496,8 @@ class FleetRouter:
                         fwd[KEEP_HEADER] = fk
                         span.meta["flight_keep"] = fk
                     status, rhdrs, rbody, outcome = router.dispatch(
-                        endpoint, payload_bytes, uuid, fwd, span=span)
+                        endpoint, payload_bytes, uuid, fwd, span=span,
+                        geo=geo)
                     C_REQS.labels(endpoint, outcome).inc()
                     span.meta["outcome"] = outcome
                     if outcome in ("no_replica", "unreachable",
@@ -1548,11 +1623,29 @@ class FleetRouter:
                     # pre-group by vehicle)
                     if action == "report":
                         uuid = str(payload.get("uuid") or "")
+                        lead = payload
                     else:
                         traces = payload.get("traces") or [{}]
-                        uuid = str((traces[0] or {}).get("uuid") or "") \
-                            if isinstance(traces, list) else ""
-                    self._proxy(action, raw, uuid)
+                        lead = (traces[0] or {}) \
+                            if isinstance(traces, list) else {}
+                        uuid = str(lead.get("uuid") or "") \
+                            if isinstance(lead, dict) else ""
+                    # geo term (flag-gated; None keeps the ranking the
+                    # plain rendezvous hash): the request's first
+                    # coordinate names the geographic cell whose shard
+                    # owner should serve it
+                    geo = None
+                    if router.geo_routing and isinstance(lead, dict):
+                        pts = lead.get("trace")
+                        p0 = pts[0] if (isinstance(pts, list) and pts
+                                        and isinstance(pts[0], dict)) \
+                            else None
+                        try:
+                            if p0 is not None:
+                                geo = (float(p0["lat"]), float(p0["lon"]))
+                        except (KeyError, TypeError, ValueError):
+                            geo = None
+                    self._proxy(action, raw, uuid, geo)
                 except Exception as e:  # noqa: BLE001 - never drop the socket
                     log.exception("unhandled router error")
                     self._answer(500, {"error": str(e)})

@@ -267,6 +267,15 @@ class MicroBatcher:
         # entirely absent with REPORTER_ADAPTIVE=0 (bit-for-bit static).
         self._wait_ctl = None
         self._h_qwait = self._h_dstep = None
+        # adaptive max_batch (the third knob the PR 13 controllers left
+        # static): when the device-step p95 dominates the tail on batches
+        # that actually fill to the cap, the batch is the latency — the
+        # controller narrows it toward max_batch/4 and glides back to the
+        # static cap when the step stops dominating.  Clamped, deadbanded
+        # and cooldown-limited like every adaptive control; absent with
+        # REPORTER_ADAPTIVE=0 (bit-for-bit static).
+        self._batch_ctl = None
+        self._static_max_batch = max_batch
         if obs_adaptive.enabled() and self.max_wait > 0:
             static = self.max_wait
             self._wait_ctl = obs_adaptive.Controller(
@@ -275,6 +284,11 @@ class MicroBatcher:
                 cooldown_s=1.0)
             self._h_qwait = obs_adaptive.WindowedQuantile(window_s=30.0)
             self._h_dstep = obs_adaptive.WindowedQuantile(window_s=60.0)
+            if max_batch > 1:
+                self._batch_ctl = obs_adaptive.Controller(
+                    "%s_max_batch" % name, float(max_batch),
+                    lo=max(1.0, max_batch / 4.0), hi=float(max_batch),
+                    cooldown_s=1.0)
         # fault-domain knobs (docs/robustness.md), env-overridable so a
         # deployment can retune without a config rollout.  deadline_ms<=0
         # disables the server default (client-sent deadlines still apply);
@@ -380,6 +394,27 @@ class MicroBatcher:
         elif d95 > 4.0 * max(q95, self.max_wait) \
                 and fill >= max(2, self.max_batch // 2):
             self.max_wait = ctl.propose(1.3 * self.max_wait)
+        self._adapt_batch(fill, q95, d95)
+
+    def _adapt_batch(self, fill: int, q95: float, d95: float) -> None:
+        """One adaptive-control tick for the batch width (no-op with
+        REPORTER_ADAPTIVE=0).  A device step whose p95 dominates the
+        queue tail ON BATCHES THAT FILL TO THE CAP means the batch width
+        itself is the client-visible latency — shrink it; once the step
+        stops dominating, glide back toward the static cap (the
+        throughput configuration the operator chose).  The controller
+        clamps to [max_batch/4, max_batch]: the adaptive knob can narrow
+        a batch, never widen past the operator's memory bound."""
+        ctl = self._batch_ctl
+        if ctl is None:
+            return
+        if d95 > 4.0 * max(q95, 1e-4) and fill >= self.max_batch:
+            self.max_batch = max(1, int(round(
+                ctl.propose(0.7 * ctl.value))))
+        elif d95 < 2.0 * max(q95, 1e-4) \
+                and ctl.value < self._static_max_batch:
+            self.max_batch = max(1, int(round(
+                ctl.propose(1.3 * ctl.value))))
 
     def retry_after_s(self) -> int:
         """Backoff hint for shed (429) responses: deeper queue, longer
@@ -1360,6 +1395,14 @@ class ReporterService:
             "graph_devices": int(getattr(m.cfg, "graph_devices", 1)) if m else None,
             "edges": int(m.arrays.num_edges) if m else None,
             "ubodt_rows": int(m.ubodt.num_rows) if m else None,
+            # fleet shard assignment + hot/cold tiering (docs/serving-
+            # fleet.md "Sharded tables"): the router learns each
+            # replica's shard from this probe payload, which is what the
+            # flag-gated geo-aware ranking term steers by
+            "ubodt_shard": ("%d/%d" % m.ubodt_shard
+                            if m and getattr(m, "ubodt_shard", None)
+                            else None),
+            "ubodt_tiered": bool(getattr(m, "tiering", None)) if m else None,
             "uptime_s": round(_time.time() - self._t_boot, 1),
             "requests": self._n_requests,
             "errors": self._n_errors,
@@ -1584,6 +1627,13 @@ class ReporterService:
             # the session plane: open per-vehicle sessions + folded points
             "sessions": (self.session_store.summary()
                          if self.session_store is not None else None),
+            # the continent-scale data plane (docs/performance.md): hot
+            # arena residency + shard assignment; None = untiered table
+            "ubodt_tier": (
+                self.matcher.tiering.summary()
+                if self.matcher is not None
+                and getattr(self.matcher, "tiering", None) is not None
+                else None),
             # the adaptive-control plane (docs/serving-fleet.md
             # "Self-driving fleet"): live effective knob values next to
             # their static configuration; None = that controller is off
@@ -1593,6 +1643,11 @@ class ReporterService:
                                  if b is not None else None),
                 "session_wait_s": (
                     round(self.session_batcher.max_wait, 5)
+                    if self.session_batcher is not None else None),
+                # the third knob (this PR): live effective batch widths
+                "max_batch": (b.max_batch if b is not None else None),
+                "session_max_batch": (
+                    self.session_batcher.max_batch
                     if self.session_batcher is not None else None),
             },
             # the preemption plane: checkpoint dir/cadence/dirty backlog

@@ -1,0 +1,452 @@
+"""Hot/cold tiered UBODT: continent tables bigger than device memory.
+
+Every bench so far ran city graphs whose UBODT fits in one device's
+memory; a continent OSM extract's precomputed routing table does not
+(ROADMAP open item 3).  This module keeps the table device-resident
+*where it is hot* and host-paged *where it is cold*:
+
+  hot tier    a device-resident **arena** of packed bucket rows (the same
+              128/256-lane rows ops/hashtable.py gathers from a resident
+              table), sized by ``REPORTER_UBODT_HOT_BYTES``, plus a
+              device ``slot_map`` [n_buckets] i32 mapping each bucket to
+              its arena slot (-1 = cold);
+  cold tier   the FULL packed table as a **host-memory-kind array leaf**
+              (``pinned_host`` where the backend supports XLA host
+              offload — the pages stay in host DRAM and a cold gather
+              rides the PCIe/ICI transfer XLA inserts; the CPU backend's
+              arrays are host memory already, so the same program is the
+              CPU-verifiable twin).
+
+The device probe (``tiered_bucket_rows``, called by ops/hashtable's
+``_bucket_rows`` seam) follows the exact ``lax.cond`` full-width
+fallback pattern of the PR 5 probe-dedup overflow: the common case (every
+probed bucket hot) runs entirely from the arena and the cold pages are
+never touched; any miss takes the full-width fallback — gather EVERY
+probed bucket's row from the host pages and select per element.  Either
+way the gathered bytes are identical (the arena rows are copies of the
+host pages), so match output is **bit-identical** to an untiered table in
+every case — both viterbi kernels, both table layouts, any tier state
+(differential-tested in tests/test_tiering.py).
+
+Deliberately NOT a host callback: converting a callback operand to numpy
+mid-execution can deadlock the CPU client when every executor thread is
+parked in a callback (computation waits on callback, callback's
+conversion waits on an executor — observed under the matcher's pipelined
+dispatch, tools/tiering_probe.py).  The memory-kind leaf keeps the cold
+fetch a pure in-program gather.
+
+Admission/eviction is a probe-frequency EWMA: every dispatch's bucket
+set feeds per-bucket counters (a ``jax.debug.callback`` side channel
+that only PARKS its operand handles — a separate drain thread converts
+them after the fact, so callback context never blocks on the runtime),
+folded into an exponentially-weighted score on each maintenance pass;
+the top-scored buckets hold the arena.  A fleet shard assignment
+(``REPORTER_UBODT_SHARD=i/N``, docs/serving-fleet.md) SEEDS the hot set
+with the replica's bucket-range partition — the same contiguous
+partition the gp-sharded shard_map probe and the distributed builder
+use — but admission stays EWMA-driven after boot, so a mis-sharded
+traffic mix converges to the real working set instead of thrashing.
+
+Observability (docs/observability.md): ``reporter_ubodt_tier_hits_total``
+/ ``_misses_total`` / ``_evictions_total`` counters plus resident-row /
+residency-fraction gauges.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from collections import deque
+from typing import Optional, Tuple
+
+import numpy as np
+
+from ..obs import metrics as obs
+from .ubodt import ROW_W, UBODT, bucket_entries
+
+log = logging.getLogger(__name__)
+
+C_TIER_HITS = obs.counter(
+    "reporter_ubodt_tier_hits_total",
+    "UBODT probes answered from the device-resident hot-bucket arena "
+    "(docs/performance.md \"Continent-scale data plane\")")
+C_TIER_MISSES = obs.counter(
+    "reporter_ubodt_tier_misses_total",
+    "UBODT probes whose bucket was cold — served bit-identically through "
+    "the host-paged full-width fallback")
+C_TIER_EVICTIONS = obs.counter(
+    "reporter_ubodt_tier_evictions_total",
+    "Hot-arena bucket rows evicted by the probe-frequency EWMA "
+    "maintenance pass")
+G_TIER_ROWS = obs.gauge(
+    "reporter_ubodt_tier_resident_rows",
+    "Bucket rows currently resident in the device hot arena")
+G_TIER_FRAC = obs.gauge(
+    "reporter_ubodt_tier_residency_frac",
+    "Fraction of the table's buckets resident in the device hot arena "
+    "(resident rows / n_buckets)")
+
+
+def parse_shard(spec: str) -> Optional[Tuple[int, int]]:
+    """``"i/N"`` -> (i, N), or None for empty/unset.  Raises on nonsense —
+    a typo'd shard assignment must fail the boot, not silently serve the
+    wrong partition."""
+    spec = (spec or "").strip()
+    if not spec:
+        return None
+    try:
+        idx_s, n_s = spec.split("/", 1)
+        idx, n = int(idx_s), int(n_s)
+    except ValueError:
+        raise ValueError("ubodt shard must be 'i/N', got %r" % (spec,))
+    if n < 1 or not 0 <= idx < n:
+        raise ValueError("ubodt shard index out of range: %r" % (spec,))
+    return idx, n
+
+
+def shard_bucket_range(idx: int, n_shards: int,
+                       n_buckets: int) -> Tuple[int, int]:
+    """Contiguous bucket range [lo, hi) of shard ``idx`` of ``n_shards`` —
+    the SAME partition function everywhere the table splits: the
+    gp-sharded shard_map probe (each rank's local range starts at
+    axis_index * L), the distributed builder's shard outputs, and the
+    serving fleet's hot-set seeding."""
+    if not 0 <= idx < n_shards:
+        raise ValueError("shard %d/%d out of range" % (idx, n_shards))
+    lo = idx * n_buckets // n_shards
+    hi = (idx + 1) * n_buckets // n_shards
+    return lo, hi
+
+
+class TieredDeviceUBODT:
+    """The device-side face of a tiered table: pytree whose leaves are the
+    hot arena + slot map, with (bmask, layout, manager) as static aux —
+    the jitted probes specialise on the manager identity exactly once per
+    matcher, and a maintenance pass swaps leaf *contents* (same shapes)
+    without recompiling.
+
+    ``hot`` resolves through the manager for the long-lived instance the
+    matcher holds (so maintenance is visible to the next dispatch), and
+    holds the traced leaves for instances the tracer reconstructs."""
+
+    shard_axis = None  # tiered tables never ride the shard_map path
+
+    def __init__(self, hot, bmask: int, layout: str, tier: "TieredTable"):
+        self._hot = hot
+        self.bmask = int(bmask)
+        self.layout = layout
+        self.tier = tier
+
+    @property
+    def hot(self):
+        return self._hot if self._hot is not None else self.tier._hot_dev
+
+    @property
+    def max_probes(self) -> int:
+        return 1 if self.layout == "wide32" else 2
+
+    def with_shard_axis(self, axis: str):
+        raise ValueError(
+            "a tiered UBODT cannot be bucket-range sharded over a mesh "
+            "axis: the gp shard_map path and host-paged tiering are "
+            "alternative HBM-scaling legs (docs/performance.md)")
+
+    def tree_flatten(self):
+        return ((self.hot,), (self.bmask, self.layout, self.tier))
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(children[0], *aux)
+
+
+def _register_tiered():
+    from jax import tree_util
+
+    tree_util.register_pytree_node(
+        TieredDeviceUBODT,
+        lambda u: u.tree_flatten(),
+        TieredDeviceUBODT.tree_unflatten,
+    )
+
+
+try:
+    _register_tiered()
+except ImportError:  # pragma: no cover - host-only usage without jax
+    pass
+
+
+class TieredTable:
+    """Host-side manager of one tiered table: owns the full host pages,
+    the EWMA scores, and the device arena/slot-map pair.
+
+    Thread-safety: the stats callback runs on dispatch threads and
+    ``maintain`` may run from it; both serialise on one lock.  The data
+    path needs no locking at all — the host pages are immutable, and a
+    dispatch that interleaves with an arena swap still reads correct rows
+    from whichever (arena, slot_map) pair it captured (every arena row is
+    a copy of its host page, so ANY consistent pair yields identical
+    probe results)."""
+
+    def __init__(self, ubodt: UBODT, hot_bytes: int,
+                 shard: Optional[Tuple[int, int]] = None,
+                 maintain_every: int = 8, ewma_decay: float = 0.8):
+        self.ubodt = ubodt
+        self.hot_bytes = int(hot_bytes)
+        self.shard = shard
+        self.maintain_every = max(1, int(maintain_every))
+        self.ewma_decay = float(ewma_decay)
+        self.lanes = bucket_entries(ubodt.layout) * ROW_W
+        self.n_buckets = ubodt.n_buckets
+        # the host pages: the FULL packed table, rank-2 contiguous so the
+        # cold-fetch fancy-index is one C-level gather
+        self.pages = np.ascontiguousarray(
+            ubodt.packed.reshape(self.n_buckets, self.lanes), np.int32)
+        row_bytes = self.lanes * 4
+        # hot capacity in bucket rows; a budget smaller than one row is a
+        # legal (if silly) configuration — everything cold, output still
+        # bit-identical (tests/test_tiering.py pins it)
+        self.capacity = min(self.n_buckets, self.hot_bytes // row_bytes)
+        self._lock = threading.Lock()
+        self._ewma = np.zeros(self.n_buckets, np.float64)
+        self._counts = np.zeros(self.n_buckets, np.int64)
+        self._dispatches_since_maintain = 0
+        self._misses_since_maintain = 0
+        # probe-stats pipeline: the debug.callback only PARKS its operand
+        # handles (touching the runtime from callback context can
+        # deadlock against a concurrent device fetch on the CPU client —
+        # observed with tools/tiering_probe.py); this drain thread
+        # converts and accumulates afterwards, the same dispatch-side /
+        # collect-side split matcher._record_probe_stats uses.  Bounded:
+        # under a stats backlog old samples drop, never dispatches.
+        self._stats_q: "deque" = deque(maxlen=256)
+        self._stats_wake = threading.Event()
+        self._stats_thread = threading.Thread(
+            target=self._stats_loop, daemon=True, name="ubodt-tier-stats")
+        self._stats_thread.start()
+        self._hot_set = np.zeros(0, np.int64)
+        # seed: the replica's shard partition (as much of it as fits),
+        # so a sharded fleet boots with its own bucket range resident
+        if shard is not None and self.capacity > 0:
+            lo, hi = shard_bucket_range(shard[0], shard[1], self.n_buckets)
+            self._hot_set = np.arange(lo, min(hi, lo + self.capacity),
+                                      dtype=np.int64)
+        # the cold tier: the full pages as ONE immutable array leaf in
+        # host memory where the backend offers it (TPU pinned_host = XLA
+        # host offload; the CPU backend's default memory IS host DRAM)
+        self._pages_dev, self.cold_memory_kind = self._put_pages()
+        self._hot_dev = self._build_hot(self._hot_set)
+        self._publish_gauges()
+        log.info(
+            "ubodt tiering: %d/%d bucket rows hot (%d B budget, %d B row, "
+            "table %d B)%s", len(self._hot_set), self.n_buckets,
+            self.hot_bytes, row_bytes, self.table_bytes,
+            " shard %d/%d seeded" % shard if shard else "")
+
+    @property
+    def table_bytes(self) -> int:
+        return self.n_buckets * self.lanes * 4
+
+    def device(self) -> TieredDeviceUBODT:
+        """The matcher-facing device table (hot leaves resolve live
+        through this manager, so maintenance is visible to the next
+        dispatch without re-plumbing)."""
+        return TieredDeviceUBODT(None, self.ubodt.bmask, self.ubodt.layout,
+                                 self)
+
+    def _put_pages(self):
+        """The cold pages as one device-visible array, preferring the
+        backend's pinned-host memory space (XLA host offload: the bytes
+        stay in host DRAM, a cold gather pays the interconnect, and
+        device memory holds only the arena).  Falls back to the default
+        memory space — on the CPU backend that IS host memory, so the
+        fallback is the semantically-identical twin; on an accelerator
+        without host offload it is a capacity concession, logged."""
+        import jax
+        import jax.numpy as jnp
+
+        dev = jax.devices()[0]
+        try:
+            sharding = jax.sharding.SingleDeviceSharding(
+                dev, memory_kind="pinned_host")
+            pages = jax.device_put(self.pages, sharding)
+            return pages, "pinned_host"
+        except Exception:  # noqa: BLE001 - backend without host offload
+            kind = getattr(dev, "default_memory", lambda: None)()
+            kind = getattr(kind, "kind", "device")
+            if dev.platform != "cpu":
+                log.warning(
+                    "ubodt tiering: backend %s lacks pinned_host memory; "
+                    "cold pages are %s-resident (capacity win deferred "
+                    "to a host-offload-capable jax)", dev.platform, kind)
+            return jnp.asarray(self.pages), kind
+
+    def _build_hot(self, hot_set: np.ndarray):
+        """(arena, slot_map) device arrays for a hot bucket set.  The
+        arena always has >= 1 row so the hot-path gather's clamped index
+        is in bounds even at capacity 0."""
+        import jax.numpy as jnp
+
+        arena = np.zeros((max(1, len(hot_set)), self.lanes), np.int32)
+        if len(hot_set):
+            arena[: len(hot_set)] = self.pages[hot_set]
+        slot_map = np.full(self.n_buckets, -1, np.int32)
+        slot_map[hot_set] = np.arange(len(hot_set), dtype=np.int32)
+        return jnp.asarray(arena), jnp.asarray(slot_map), self._pages_dev
+
+    # -- the stats side-channel (device program -> host) --------------------
+
+    def _note(self, b, hot):
+        """debug.callback target: park the probe's (buckets, hot-mask)
+        handles for the drain thread.  MUST NOT touch the jax runtime
+        (no np.asarray on device arrays) — callback context."""
+        self._stats_q.append((b, hot))
+        self._stats_wake.set()
+
+    def _stats_loop(self) -> None:
+        while True:
+            self._stats_wake.wait()
+            self._stats_wake.clear()
+            try:
+                self.drain_stats()
+            except Exception:  # noqa: BLE001 - stats must never die
+                log.exception("ubodt tier stats drain failed")
+
+    def drain_stats(self) -> None:
+        """Convert and accumulate every parked probe sample, then run a
+        maintenance pass when one is due.  Runs on the drain thread;
+        also callable directly (tests, the measurement harness) to make
+        the counters deterministic at a sync point."""
+        due = False
+        while True:
+            try:
+                b, hot = self._stats_q.popleft()
+            except IndexError:
+                break
+            b = np.asarray(b).reshape(-1)
+            hot = np.asarray(hot).reshape(-1)
+            n_hit = int(np.count_nonzero(hot))
+            n_miss = b.size - n_hit
+            C_TIER_HITS.inc(n_hit)
+            C_TIER_MISSES.inc(n_miss)
+            with self._lock:
+                self._counts += np.bincount(b, minlength=self.n_buckets)
+                self._dispatches_since_maintain += 1
+                self._misses_since_maintain += n_miss
+                due = due or (
+                    self._misses_since_maintain > 0 and
+                    self._dispatches_since_maintain >= self.maintain_every)
+        if due:
+            self.maintain()
+
+    # -- maintenance --------------------------------------------------------
+
+    def maintain(self) -> dict:
+        """One admission/eviction pass: fold the window's probe counts
+        into the EWMA, take the top-``capacity`` buckets as the new hot
+        set, rebuild the arena, and publish it.  Returns counters (tests
+        and /statusz)."""
+        with self._lock:
+            self._ewma *= self.ewma_decay
+            self._ewma += self._counts
+            self._counts[:] = 0
+            self._dispatches_since_maintain = 0
+            self._misses_since_maintain = 0
+            if self.capacity <= 0:
+                return {"hot_rows": 0, "admitted": 0, "evicted": 0}
+            if self.capacity >= self.n_buckets:
+                new_set = np.arange(self.n_buckets, dtype=np.int64)
+            else:
+                # top-capacity by EWMA; ties resolve to the lowest bucket
+                # index (stable, so an all-zero score keeps the seeded set
+                # ordering deterministic)
+                top = np.argpartition(-self._ewma, self.capacity - 1)[
+                    : self.capacity]
+                new_set = np.sort(top).astype(np.int64)
+                # never evict a probed bucket for an unprobed one: drop
+                # zero-score winners in favour of the incumbent hot set
+                # (the seeded shard must not churn out under zero traffic)
+                zero = self._ewma[new_set] <= 0.0
+                n_zero = int(np.count_nonzero(zero))
+                if n_zero and len(self._hot_set):
+                    keep_old = self._hot_set[
+                        ~np.isin(self._hot_set, new_set)]
+                    fill = keep_old[:n_zero]
+                    new_set = np.sort(np.concatenate(
+                        [new_set[~zero],
+                         new_set[zero][: n_zero - len(fill)],
+                         fill])).astype(np.int64)
+            evicted = int(np.count_nonzero(
+                ~np.isin(self._hot_set, new_set)))
+            admitted = int(np.count_nonzero(
+                ~np.isin(new_set, self._hot_set)))
+            if admitted or evicted or not len(self._hot_set):
+                self._hot_set = new_set
+                self._hot_dev = self._build_hot(new_set)
+            C_TIER_EVICTIONS.inc(evicted)
+            self._publish_gauges()
+            return {"hot_rows": int(len(self._hot_set)),
+                    "admitted": admitted, "evicted": evicted}
+
+    def _publish_gauges(self) -> None:
+        G_TIER_ROWS.set(len(self._hot_set))
+        G_TIER_FRAC.set(len(self._hot_set) / max(1, self.n_buckets))
+
+    # -- introspection ------------------------------------------------------
+
+    def hot_buckets(self) -> np.ndarray:
+        with self._lock:
+            return self._hot_set.copy()
+
+    def summary(self) -> dict:
+        """The /statusz tier block (docs/http-api.md)."""
+        with self._lock:
+            hot_rows = int(len(self._hot_set))
+        return {
+            "hot_bytes": self.hot_bytes,
+            "table_bytes": self.table_bytes,
+            "n_buckets": self.n_buckets,
+            "hot_rows": hot_rows,
+            "capacity_rows": self.capacity,
+            "residency_frac": round(hot_rows / max(1, self.n_buckets), 4),
+            "layout": self.ubodt.layout,
+            "cold_memory_kind": self.cold_memory_kind,
+            "shard": ("%d/%d" % self.shard) if self.shard else None,
+        }
+
+
+def tiered_bucket_rows(u: TieredDeviceUBODT, b):
+    """One bucket-row fetch [..., lanes] through the two-tier path — the
+    ops/hashtable ``_bucket_rows`` seam for tiered tables.
+
+    The exact lax.cond full-width fallback pattern of the PR 5 dedup
+    overflow: predicate = "every probed bucket is hot".  True: one arena
+    gather, the cold pages are never touched.  False: the FULL bucket
+    set gathers from the host-memory pages and a per-element select
+    keeps the arena rows where they exist.  Both sides produce identical
+    bytes (arena rows are copies of the pages), so downstream selects —
+    and therefore match output — are bit-identical to an untiered table.
+    Probe-frequency accounting rides a park-only debug.callback OUTSIDE
+    the data path.  Under vmap (the carry/session seam transitions) the
+    cond lowers to a select and both sides execute — correctness is
+    unaffected; only the fast-path skip is."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..obs.attrib import stage
+
+    arena, slot_map, pages = u.hot
+    slot = slot_map[b]
+    hot = slot >= 0
+    with stage("tier-arena"):
+        rows_hot = arena[jnp.where(hot, slot, 0)]
+    jax.debug.callback(u.tier._note, b, hot)
+
+    def _all_hot(_):
+        return rows_hot
+
+    def _paged(_):
+        with stage("tier-page"):
+            rows_cold = pages[b]
+        return jnp.where(hot[..., None], rows_hot, rows_cold)
+
+    return jax.lax.cond(jnp.all(hot), _all_hot, _paged, None)

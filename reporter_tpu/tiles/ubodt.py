@@ -52,12 +52,27 @@ from __future__ import annotations
 
 import heapq
 import logging
+import multiprocessing
+import os
+import shutil
+import tempfile
 from dataclasses import dataclass
 from typing import List, Optional, Tuple
 
 import numpy as np
 
+from ..obs import metrics as obs
+from ..utils import journal
+
 log = logging.getLogger(__name__)
+
+C_DIST_UNITS = obs.counter(
+    "reporter_ubodt_dist_units_total",
+    "Distributed-builder source-range work units by outcome (built = "
+    "journalled complete by a worker, requeued = a dead worker's "
+    "unfinished remainder re-run once on the parent; "
+    "docs/performance.md \"Continent-scale data plane\")",
+    ("outcome",))
 
 # uint32 multiplicative mixing constants (Knuth / murmur-style).  Two
 # independent mixes -> the two cuckoo bucket choices.
@@ -606,6 +621,167 @@ def ubodt_from_columns(
         max_kicks=0 if wide else int(max_chain),
         max_probes=1 if wide else 2, layout=layout,
     )
+
+
+# -- distributed builder ----------------------------------------------------
+#
+# Continent extracts make the bounded-Dijkstra sweep the preprocessing
+# bottleneck: it is embarrassingly parallel over SOURCE NODES, so the
+# distributed builder partitions sources into contiguous work units,
+# fans them out over spawn processes, and reuses the batch pipeline's
+# per-unit done-file journaling (utils/journal) so a SIGKILL'd worker's
+# unfinished remainder is requeued ONCE onto the surviving parent —
+# at-least-once, never silent loss.  Each unit's rows land in an atomic
+# npz (tmp + rename: a unit file is either whole or absent), and the
+# parent concatenates units in source order, which makes the row stream
+# — and therefore the packed table — BYTE-IDENTICAL to the single-node
+# C++/Python twin builders (tests/test_ubodt_dist.py diffs all three).
+
+
+def _unit_rows(arrays_cols: tuple, delta: float, lo: int, hi: int):
+    """(src, dst, dist, time, fe) columns for sources [lo, hi), rows in
+    the exact order the single-node python loop emits them."""
+    out_start, out_edges, edge_to, edge_len, edge_speed = arrays_cols
+    srcs: List[int] = []
+    dsts: List[int] = []
+    dists: List[float] = []
+    times: List[float] = []
+    fes: List[int] = []
+    for src in range(lo, hi):
+        for dst, d, tm, fe in _bounded_dijkstra(
+                src, delta, out_start, out_edges, edge_to, edge_len,
+                edge_speed):
+            srcs.append(src)
+            dsts.append(dst)
+            dists.append(d)
+            times.append(tm)
+            fes.append(fe)
+    return (np.asarray(srcs, np.int32), np.asarray(dsts, np.int32),
+            np.asarray(dists, np.float32), np.asarray(times, np.float32),
+            np.asarray(fes, np.int32))
+
+
+def _unit_path(out_dir: str, key: str) -> str:
+    return os.path.join(out_dir, "unit_%s.npz" % key.replace(":", "_"))
+
+
+def _dist_worker(arrays_cols: tuple, delta: float, units: List[str],
+                 out_dir: str, done_path: Optional[str],
+                 kill_unit: Optional[str] = None) -> None:
+    """One builder worker: process each 'lo:hi' unit, write its columns
+    atomically, journal it done.  ``kill_unit`` is the chaos hook the
+    SIGKILL-survival test arms: the worker that reaches that unit dies
+    mid-build (never passed on the parent's requeue path)."""
+    import signal
+
+    for key in units:
+        if kill_unit == key:
+            os.kill(os.getpid(), signal.SIGKILL)
+        lo, hi = (int(v) for v in key.split(":"))
+        src, dst, dist, tm, fe = _unit_rows(arrays_cols, delta, lo, hi)
+        path = _unit_path(out_dir, key)
+        tmp = "%s.%d.tmp" % (path, os.getpid())
+        with open(tmp, "wb") as f:
+            np.savez(f, src=src, dst=dst, dist=dist, time=tm, fe=fe)
+        os.replace(tmp, path)
+        journal.mark_done(done_path, key)
+        C_DIST_UNITS.labels("built").inc()
+
+
+def build_ubodt_distributed(
+    arrays,
+    delta: float = 3000.0,
+    workers: int = 2,
+    layout: str = "cuckoo",
+    load_factor: "float | None" = None,
+    use_native: bool = True,
+    unit_sources: int = 256,
+    workdir: Optional[str] = None,
+    kill_unit: Optional[str] = None,
+) -> UBODT:
+    """Multi-process UBODT build: sources partitioned into ``unit_sources``
+    ranges, fanned over ``workers`` spawn processes with per-unit
+    done-file journaling, output byte-identical to ``build_ubodt`` (both
+    the C++ and the pure-Python single-node twins).
+
+    Spawn, not fork: the caller usually has JAX initialised, and forking
+    a multithreaded process can deadlock (batch/pipeline.py rationale).
+    The graph columns are pickled to each worker — for continent extracts
+    the per-worker copy is a few hundred MB of numpy, far below the
+    Dijkstra working set; a memory-mapped handoff is the next step when
+    that stops being true.  Workers run the per-source python oracle
+    sweep (the C++ builder is whole-graph; its rows are bit-identical to
+    the python loop's, which is what makes the concatenated output equal
+    all three builders)."""
+    n = int(arrays.num_nodes)
+    cols = (
+        np.ascontiguousarray(arrays.out_start),
+        np.ascontiguousarray(arrays.out_edges),
+        np.ascontiguousarray(arrays.edge_to),
+        np.ascontiguousarray(arrays.edge_len),
+        np.ascontiguousarray(arrays.edge_speed),
+    )
+    unit_sources = max(1, int(unit_sources))
+    units = ["%d:%d" % (lo, min(lo + unit_sources, n))
+             for lo in range(0, n, unit_sources)]
+    own_dir = workdir is None
+    out_dir = workdir or tempfile.mkdtemp(prefix="ubodt_dist_")
+    os.makedirs(out_dir, exist_ok=True)
+    try:
+        workers = max(1, int(workers))
+        if workers == 1 or len(units) <= 1:
+            _dist_worker(cols, delta, units, out_dir, None)
+        else:
+            ctx = multiprocessing.get_context("spawn")
+            done_dir = tempfile.mkdtemp(prefix="ubodt_done_")
+            chunks = journal.split(units, workers)
+            procs = []
+            for i, chunk in enumerate(chunks):
+                p = ctx.Process(
+                    target=_dist_worker,
+                    args=(cols, delta, chunk, out_dir,
+                          os.path.join(done_dir, "w%d.done" % i),
+                          kill_unit),
+                )
+                p.start()
+                procs.append(p)
+            dead = journal.join_checked(procs)
+            if dead:
+                remaining = journal.unfinished_units(chunks, procs,
+                                                     done_dir)
+                C_DIST_UNITS.labels("requeued").inc(len(remaining))
+                log.warning(
+                    "%d ubodt builder worker(s) died; requeueing %d "
+                    "unfinished source range(s) in the parent",
+                    dead, len(remaining))
+                # the parent re-run never re-arms the chaos kill hook
+                _dist_worker(cols, delta, remaining, out_dir, None)
+            shutil.rmtree(done_dir, ignore_errors=True)
+        # concatenate in SOURCE ORDER: unit order is the source order, so
+        # the row stream equals the single-node builders' and the packed
+        # table is byte-identical
+        parts = []
+        for key in units:
+            with np.load(_unit_path(out_dir, key)) as z:
+                parts.append((z["src"], z["dst"], z["dist"], z["time"],
+                              z["fe"]))
+        src = np.concatenate([p[0] for p in parts]) if parts else \
+            np.zeros(0, np.int32)
+        dst = np.concatenate([p[1] for p in parts]) if parts else \
+            np.zeros(0, np.int32)
+        dist = np.concatenate([p[2] for p in parts]) if parts else \
+            np.zeros(0, np.float32)
+        tm = np.concatenate([p[3] for p in parts]) if parts else \
+            np.zeros(0, np.float32)
+        fe = np.concatenate([p[4] for p in parts]) if parts else \
+            np.zeros(0, np.int32)
+    finally:
+        if own_dir:
+            shutil.rmtree(out_dir, ignore_errors=True)
+    return ubodt_from_columns(
+        src, dst, dist, tm, fe, delta, load_factor,
+        use_native=use_native, layout=layout,
+    ).attach_graph(arrays.edge_to)
 
 
 def ubodt_from_rows(

@@ -33,7 +33,7 @@ import pytest
 from reporter_tpu import faults
 from reporter_tpu.matching import MatcherConfig, SegmentMatcher
 from reporter_tpu.serve import router as router_mod
-from reporter_tpu.serve.router import FleetRouter, rendezvous_score
+from reporter_tpu.serve.router import FleetRouter, Replica, rendezvous_score
 from reporter_tpu.serve.service import ReporterService
 from reporter_tpu.stream.client import _post_json
 from reporter_tpu.tiles.arrays import build_graph_arrays
@@ -570,3 +570,82 @@ def test_sigterm_drain_finishes_inflight_then_exits_zero(engine, tmp_path):
         if proc.poll() is None:
             proc.kill()
             proc.wait()
+
+
+# -- geo-aware ranking (flag-gated; docs/serving-fleet.md "Sharded
+# tables") -----------------------------------------------------------------
+
+
+def test_geo_off_is_bitforbit_rendezvous(monkeypatch):
+    """With REPORTER_ROUTER_GEO unset the ranking is the PR 9 rendezvous
+    hash exactly, for every uuid — even when replicas advertise shards
+    and requests carry coordinates."""
+    monkeypatch.delenv("REPORTER_ROUTER_GEO", raising=False)
+    router = FleetRouter(
+        ["http://127.0.0.1:1", "http://127.0.0.1:2", "http://127.0.0.1:3"],
+        probe_interval_s=3600.0)
+    try:
+        assert router.geo_routing is False
+        for i, r in enumerate(router.replicas):
+            r.shard = "%d/3" % i
+        for k in range(50):
+            uuid = "veh-%d" % k
+            want = sorted(
+                router.replicas,
+                key=lambda r: rendezvous_score(uuid, r.url), reverse=True)
+            assert [r.url for r in router.ranked(uuid)] == \
+                [r.url for r in want]
+            # geo is never even computed with the flag off: the caller
+            # passes None, and an explicit geo changes nothing either
+            assert [r.url for r in router.ranked(uuid, (52.5, 13.4))] == \
+                [r.url for r in want]
+    finally:
+        router.stop()
+
+
+def test_geo_on_prefers_shard_owner(monkeypatch):
+    """Flag on: the replica whose advertised shard covers the request's
+    geographic cell ranks first; the rendezvous hash still orders the
+    rest, the mapping is stable per cell, and uuids without coordinates
+    keep plain rendezvous ranking."""
+    from reporter_tpu.serve.router import C_GEO, geo_cell
+
+    monkeypatch.setenv("REPORTER_ROUTER_GEO", "1")
+    monkeypatch.setenv("REPORTER_ROUTER_GEO_CELL_DEG", "0.25")
+    router = FleetRouter(
+        ["http://127.0.0.1:1", "http://127.0.0.1:2", "http://127.0.0.1:3"],
+        probe_interval_s=3600.0)
+    try:
+        assert router.geo_routing is True
+        for i, r in enumerate(router.replicas):
+            r.shard = "%d/3" % i
+        geo = (52.5, 13.4)
+        cell = geo_cell(geo[0], geo[1], 0.25)
+        owner = next(r for r in router.replicas
+                     if router._geo_pref(r, cell))
+        g0 = sum(C_GEO.labels(o).value for o in ("steered", "aligned"))
+        for k in range(20):
+            order = router.ranked("veh-%d" % k, geo)
+            assert order[0] is owner
+            # the tail is still rendezvous-ordered
+            tail = [r for r in router.replicas if r is not owner]
+            want = sorted(tail, key=lambda r: rendezvous_score(
+                "veh-%d" % k, r.url), reverse=True)
+            assert [r.url for r in order[1:]] == [r.url for r in want]
+        assert sum(C_GEO.labels(o).value
+                   for o in ("steered", "aligned")) == g0 + 20
+        # no coordinate -> plain rendezvous, even with the flag on
+        for k in range(20):
+            uuid = "veh-%d" % k
+            want = sorted(
+                router.replicas,
+                key=lambda r: rendezvous_score(uuid, r.url), reverse=True)
+            assert [r.url for r in router.ranked(uuid)] == \
+                [r.url for r in want]
+        # a replica with no (or junk) shard never gets the bonus
+        assert router._geo_pref(Replica("http://x:1"), cell) == 0
+        junk = Replica("http://x:2")
+        junk.shard = "weird"
+        assert router._geo_pref(junk, cell) == 0
+    finally:
+        router.stop()

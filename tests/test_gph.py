@@ -1,0 +1,141 @@
+"""Spec-derived Valhalla .gph codec (tiles/gph.py): synthetic round-trip
+fixtures — encode_tiles -> decode_gph -> network_from_tiles must
+reproduce the source network up to the 1e-6-degree coordinate
+quantisation the baldr fixed-point layout imposes, and a decoded network
+must drive the matcher exactly like the original (closing the VERDICT
+".gph decoder" partial within the documented no-sample-tiles boundary)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from reporter_tpu.matching import MatcherConfig, SegmentMatcher
+from reporter_tpu.tiles.arrays import build_graph_arrays
+from reporter_tpu.tiles.gph import (
+    GPH_VERSION, GphError, decode_gph, decode_shape, encode_shape,
+    encode_tiles, network_from_tiles, pack_graphid, unpack_graphid,
+)
+from reporter_tpu.tiles.network import Edge, RoadNetwork, grid_city
+
+
+def q6(v: float) -> float:
+    return round(v * 1e6) / 1e6
+
+
+class TestShapeCodec:
+    def test_round_trip_property(self):
+        rng = np.random.default_rng(5)
+        for _ in range(50):
+            n = int(rng.integers(1, 30))
+            pts = [(float(rng.uniform(-85, 85)),
+                    float(rng.uniform(-179.9, 179.9))) for _ in range(n)]
+            got = decode_shape(encode_shape(pts))
+            assert got == [(q6(a), q6(b)) for a, b in pts]
+
+    def test_torn_varint_raises(self):
+        data = encode_shape([(52.5, 13.4)])
+        with pytest.raises(GphError):
+            decode_shape(data[:-1] + bytes([data[-1] | 0x80]))
+
+
+class TestGraphId:
+    def test_round_trip_and_bounds(self):
+        assert unpack_graphid(pack_graphid(2, 415760, 91)) == (2, 415760, 91)
+        with pytest.raises(GphError):
+            pack_graphid(8, 0, 0)
+        with pytest.raises(GphError):
+            pack_graphid(0, 1 << 22, 0)
+
+
+class TestTileRoundTrip:
+    def test_fields_survive(self):
+        city = grid_city(rows=4, cols=4, spacing_m=200.0)
+        for i, e in enumerate(city.edges):
+            e.way_id = 1000 + i
+        tiles = encode_tiles(city)
+        assert all(path.endswith(".gph") for path in tiles)
+        decoded = [decode_gph(b) for b in tiles.values()]
+        assert all(t.version == GPH_VERSION for t in decoded)
+        net = network_from_tiles(decoded)
+        assert net.num_nodes == city.num_nodes
+        assert net.num_edges == city.num_edges
+        assert np.allclose(net.node_lat, city.node_lat, atol=1.1e-6)
+        assert np.allclose(net.node_lon, city.node_lon, atol=1.1e-6)
+        # per-edge fields survive (edges regroup by from-node; compare as
+        # multisets keyed on endpoints)
+        def eset(n):
+            return sorted((e.from_node, e.to_node, round(e.speed_kph),
+                           e.way_id) for e in n.edges)
+        assert eset(net) == eset(city)
+
+    def test_cross_tile_references(self):
+        """Nodes spanning a 0.25-degree tile boundary decode back into
+        one connected network (end nodes are cross-tile GraphIds)."""
+        net = RoadNetwork()
+        a = net.add_node(0.2499, 13.0)   # tile south of the boundary
+        b = net.add_node(0.2501, 13.0)   # tile north of it
+        net.add_edge(Edge(a, b, speed_kph=30.0, way_id=7))
+        net.add_edge(Edge(b, a, speed_kph=30.0, way_id=7))
+        tiles = encode_tiles(net)
+        assert len(tiles) == 2
+        back = network_from_tiles(tiles.values())
+        assert back.num_nodes == 2 and back.num_edges == 2
+        assert {(e.from_node, e.to_node) for e in back.edges} == \
+            {(0, 1), (1, 0)}
+        # a tile set missing the referenced neighbour fails loudly
+        with pytest.raises(GphError):
+            network_from_tiles([next(iter(tiles.values()))])
+
+    def test_malformed_streams_raise(self):
+        city = grid_city(rows=3, cols=3, spacing_m=200.0)
+        data = next(iter(encode_tiles(city).values()))
+        with pytest.raises(GphError):
+            decode_gph(data[:100])          # truncated header
+        with pytest.raises(GphError):
+            decode_gph(data[:300])          # truncated sections
+        bad = bytearray(data)
+        bad[8:24] = b"9.9.9".ljust(16, b"\x00")
+        with pytest.raises(GphError):
+            decode_gph(bytes(bad))          # major-version mismatch
+
+
+class TestMatcherParity:
+    def test_decoded_network_matches_identically(self):
+        """The matcher over the decoded network produces the same wire
+        output as over a network built from the SAME quantised
+        coordinates — the decoder is transparent to everything
+        downstream."""
+        city = grid_city(rows=4, cols=4, spacing_m=200.0)
+        net = network_from_tiles(encode_tiles(city).values())
+        # quantise the original the way the fixed-point layout does AND
+        # regroup edges by from-node the way the NodeInfo adjacency
+        # window does, so the comparison isolates the byte codec (not
+        # the 1e-6 rounding or the edge-id renumbering)
+        qcity = RoadNetwork()
+        for lat, lon in zip(city.node_lat, city.node_lon):
+            qcity.add_node(q6(lat), q6(lon))
+        per_node = {}
+        for e in city.edges:
+            per_node.setdefault(e.from_node, []).append(e)
+        for i in range(city.num_nodes):
+            for e in per_node.get(i, ()):
+                qcity.add_edge(Edge(e.from_node, e.to_node,
+                                    speed_kph=float(round(e.speed_kph)),
+                                    internal=e.internal,
+                                    way_id=e.way_id))
+        cfg = MatcherConfig(length_buckets=[16])
+        outs = []
+        for n in (qcity, net):
+            arrays = build_graph_arrays(n, cell_size=100.0)
+            m = SegmentMatcher(arrays=arrays, config=cfg)
+            xs = np.linspace(arrays.node_x[4], arrays.node_x[7], 9)
+            ys = np.linspace(arrays.node_y[4], arrays.node_y[7], 9) + 3.0
+            lat, lon = arrays.proj.to_latlon(xs, ys)
+            outs.append(m.match_many([{"uuid": "v", "trace": [
+                {"lat": float(a), "lon": float(o), "time": 1000.0 + 15 * i}
+                for i, (a, o) in enumerate(zip(lat, lon))]}]))
+        # edge ids may renumber (edges regroup by from-node), so compare
+        # the wire segments, which speak OSMLR/segment terms
+        assert json.dumps(outs[0], sort_keys=True) == \
+            json.dumps(outs[1], sort_keys=True)

@@ -570,3 +570,69 @@ def test_slow_drain_stalls_session_export(monkeypatch, engine):
         assert time.monotonic() - t0 < 0.3
     finally:
         rep.close()
+
+
+# -- adaptive max_batch (the third knob, ISSUE 14) --------------------------
+
+
+class _BatchStub:
+    backend = "cpu"
+
+    def match_many_async(self, traces):
+        return lambda: [{"segments": []} for _ in traces]
+
+
+def test_batch_width_shrinks_when_device_step_dominates():
+    """Full batches whose device-step p95 dwarfs the queue tail mean the
+    batch width IS the latency: the controller narrows it, clamped to
+    static/4, and glides back to the static cap once the step calms."""
+    b = MicroBatcher(_BatchStub(), max_batch=64, max_wait_ms=10.0,
+                     watchdog_s=0)
+    assert b._batch_ctl is not None
+    b._batch_ctl.cooldown_s = 0.0
+    b._wait_ctl.cooldown_s = 0.0
+    for _ in range(64):
+        b._h_qwait.observe(0.002)
+    for _ in range(16):
+        b._h_dstep.observe(0.500)
+    for _ in range(40):
+        b._adapt_wait(fill=b.max_batch)
+    assert b.max_batch < 64
+    assert b.max_batch == max(1, int(round(b._batch_ctl.lo)))
+    # never widens past the operator's static cap
+    assert b._batch_ctl.hi == 64.0
+    # calm step: glide back toward static
+    b._h_qwait = obs_adaptive.WindowedQuantile(window_s=30.0)
+    b._h_dstep = obs_adaptive.WindowedQuantile(window_s=60.0)
+    for _ in range(64):
+        b._h_qwait.observe(0.010)
+    for _ in range(16):
+        b._h_dstep.observe(0.012)
+    for _ in range(40):
+        b._adapt_wait(fill=1)
+    assert b.max_batch >= 0.9 * 64
+
+
+def test_batch_width_static_without_fill_pressure():
+    """A dominating step on batches that do NOT fill is a fill-window
+    story, not a width story — the width knob must not move."""
+    b = MicroBatcher(_BatchStub(), max_batch=64, max_wait_ms=10.0,
+                     watchdog_s=0)
+    b._batch_ctl.cooldown_s = 0.0
+    for _ in range(64):
+        b._h_qwait.observe(0.002)
+    for _ in range(16):
+        b._h_dstep.observe(0.500)
+    for _ in range(20):
+        b._adapt_wait(fill=3)
+    assert b.max_batch == 64
+
+
+def test_batch_width_static_with_adaptive_off(monkeypatch):
+    monkeypatch.setenv("REPORTER_ADAPTIVE", "0")
+    b = MicroBatcher(_BatchStub(), max_batch=64, max_wait_ms=10.0,
+                     watchdog_s=0)
+    assert b._batch_ctl is None
+    for _ in range(20):
+        b._adapt_wait(fill=64)  # no controller state at all
+    assert b.max_batch == 64

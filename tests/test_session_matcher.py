@@ -525,3 +525,108 @@ def test_session_metrics_and_dispatch_cohort(setup):
     assert sum(v for lv, v in fam("reporter_dispatch_cohort_total")
                if lv == ["session", "step"]) \
         == before_disp + len(tr["trace"])
+
+
+# -- hedging-aware idempotency (docs/serving-fleet.md "Beam handoff") -------
+
+
+def test_hedged_duplicate_point_commits_once(setup):
+    """The same raw point delivered twice (a hedged "stream": true
+    request that landed on two replicas, or a client retry) commits
+    ONCE: the ledger counts it once, the duplicate still gets a full
+    answer from the accumulated tail, and the decode stays bit-exact
+    with a clean single-delivery stream."""
+    from reporter_tpu.matching.session import C_SESSION_DEDUP
+
+    arrays, _ = setup
+    m = _matcher(setup)
+    eng, store = _engine(m)
+    tr = _traces(arrays, 1, 6, seed=31)[0]
+    pts = tr["trace"]
+    d0 = C_SESSION_DEDUP.value
+    a1 = eng.match_many([{"uuid": "hedge", "trace": pts[:1],
+                          "match_options": MO}])[0]
+    a2 = eng.match_many([{"uuid": "hedge", "trace": pts[:1],
+                          "match_options": MO}])[0]
+    s = store.peek("hedge")
+    assert s.points_total == 1 and s.seq == 1
+    assert C_SESSION_DEDUP.value == d0 + 1
+    assert a2["_stream"]["session"].get("deduped") is True
+    assert a2["_stream"]["session"]["points"] == 0
+    assert a2["segments"] == a1["segments"]
+    # the stream continues unperturbed: feed the rest, compare the
+    # decode against a clean engine that never saw the duplicate
+    for j in range(1, len(pts)):
+        eng.match_many([{"uuid": "hedge", "trace": pts[j:j + 1],
+                         "match_options": MO}])
+    eng2, store2 = _engine(m)
+    _stream(eng2, tr, step=1, uuid="clean")
+    _assert_records_equal(_session_records(store, "hedge"),
+                          _session_records(store2, "clean"),
+                          "post-dedup stream vs clean stream")
+    assert store.peek("hedge").points_total == len(pts)
+
+
+def test_duplicate_within_one_batch_commits_once(setup):
+    """Two submits of the same point co-batched in ONE micro-batch (the
+    tightest hedge race) fold to one committed copy; both get answers."""
+    arrays, _ = setup
+    m = _matcher(setup)
+    eng, store = _engine(m)
+    tr = _traces(arrays, 1, 4, seed=37)[0]
+    p = tr["trace"][:1]
+    out = eng.match_many([
+        {"uuid": "race", "trace": p, "match_options": MO},
+        {"uuid": "race", "trace": p, "match_options": MO},
+    ])
+    assert len(out) == 2 and all(o is not None for o in out)
+    assert store.peek("race").points_total == 1
+
+
+def test_partial_duplicate_commits_only_fresh(setup):
+    """A retry carrying one already-committed point plus one new point
+    commits only the new one — and the decode equals the clean stream."""
+    arrays, _ = setup
+    m = _matcher(setup)
+    eng, store = _engine(m)
+    tr = _traces(arrays, 1, 5, seed=41)[0]
+    pts = tr["trace"]
+    eng.match_many([{"uuid": "part", "trace": pts[:1],
+                     "match_options": MO}])
+    out = eng.match_many([{"uuid": "part", "trace": pts[:2],
+                          "match_options": MO}])[0]
+    s = store.peek("part")
+    assert s.points_total == 2
+    assert out["_stream"]["session"]["points"] == 1
+    for j in range(2, len(pts)):
+        eng.match_many([{"uuid": "part", "trace": pts[j:j + 1],
+                         "match_options": MO}])
+    eng2, store2 = _engine(m)
+    _stream(eng2, tr, step=1, uuid="clean2")
+    _assert_records_equal(_session_records(store, "part"),
+                          _session_records(store2, "clean2"),
+                          "partial-duplicate stream vs clean stream")
+
+
+def test_service_level_hedge_duplicate(setup):
+    """Chaos-shaped end to end: the SAME streaming body served twice by
+    the real service (what a hedge loser's late landing or a client
+    retry looks like replica-side) answers 200 both times with ONE
+    ledger entry."""
+    from reporter_tpu.serve.service import ReporterService
+
+    arrays, _ = setup
+    m = _matcher(setup)
+    svc = ReporterService(m, max_wait_ms=1.0, session_wait_ms=1.0)
+    tr = _traces(arrays, 1, 4, seed=43)[0]
+    body = {"uuid": "veh-hh", "stream": True,
+            "trace": tr["trace"][:1], "match_options": MO}
+    code1, out1 = svc.handle_report(dict(body))
+    code2, out2 = svc.handle_report(dict(body))
+    assert code1 == 200 and code2 == 200
+    assert out2["session"].get("deduped") is True
+    assert svc.session_store.peek("veh-hh").points_total == 1
+    # degraded-mode parity: the dedup also guards the CPU-oracle path
+    n0 = svc.session_store.peek("veh-hh").points_total
+    svc.session_engine.degraded_step(m, dict(body))
+    assert svc.session_store.peek("veh-hh").points_total == n0

@@ -1,0 +1,277 @@
+"""Hot/cold tiered UBODT differentials (docs/performance.md
+"Continent-scale data plane"): match output must be BIT-IDENTICAL to the
+untiered table for every tier state — both viterbi kernels, both table
+layouts, cold-miss storms, eviction churn mid-stream, a hot arena smaller
+than one bucket row, and tier state across UBODT.relayout()."""
+
+import dataclasses
+import json
+
+import numpy as np
+import pytest
+
+from reporter_tpu.matching import MatcherConfig, SegmentMatcher
+from reporter_tpu.tiles.arrays import build_graph_arrays
+from reporter_tpu.tiles.network import grid_city
+from reporter_tpu.tiles.tiering import (
+    TieredTable, parse_shard, shard_bucket_range,
+)
+from reporter_tpu.tiles.ubodt import build_ubodt
+
+
+@pytest.fixture(scope="module")
+def setup():
+    city = grid_city(rows=5, cols=5, spacing_m=150.0)
+    arrays = build_graph_arrays(city, cell_size=100.0)
+    return city, arrays
+
+
+@pytest.fixture(scope="module")
+def tables(setup):
+    _, arrays = setup
+    return {layout: build_ubodt(arrays, delta=1500.0, layout=layout)
+            for layout in ("cuckoo", "wide32")}
+
+
+def fleet_traces(arrays, n=10, pts=12, seed=3):
+    rng = np.random.default_rng(seed)
+    out = []
+    for i in range(n):
+        r = int(rng.integers(0, 5))
+        row_nodes = [r * 5 + c for c in range(5)]
+        xs = arrays.node_x[row_nodes]
+        ys = arrays.node_y[row_nodes]
+        t = np.linspace(0.05, 0.9, pts)
+        px = np.interp(t, np.linspace(0, 1, 5), xs) + rng.normal(0, 3, pts)
+        py = np.interp(t, np.linspace(0, 1, 5), ys) + rng.normal(0, 3, pts)
+        lat, lon = arrays.proj.to_latlon(px, py)
+        out.append({"uuid": "v%d" % i, "trace": [
+            {"lat": float(a), "lon": float(o), "time": 1000.0 + 15 * j}
+            for j, (a, o) in enumerate(zip(lat, lon))]})
+    return out
+
+
+# -- ops-level probe differential -------------------------------------------
+
+
+@pytest.mark.parametrize("layout", ["cuckoo", "wide32"])
+@pytest.mark.parametrize("hot_bytes", [1, 3000, 1 << 30])
+def test_probe_bit_identical(tables, layout, hot_bytes):
+    """jit / vmap / dedup probe paths over every tier occupancy: empty
+    arena (budget below one row), partial, and everything-hot."""
+    import jax
+    import jax.numpy as jnp
+
+    from reporter_tpu.ops.hashtable import ubodt_lookup
+
+    u = tables[layout]
+    du = u.to_device()
+    rng = np.random.default_rng(7)
+    src = jnp.asarray(rng.integers(0, 30, size=(16, 5, 4)), jnp.int32)
+    dst = jnp.asarray(rng.integers(0, 30, size=(16, 5, 4)), jnp.int32)
+    want = jax.jit(ubodt_lookup)(du, src, dst)
+    tier = TieredTable(u, hot_bytes)
+    tdu = tier.device()
+    for _ in range(2):  # cold storm, then the EWMA-warmed arena
+        got = jax.jit(ubodt_lookup)(tdu, src, dst)
+        for a, b in zip(want, got):
+            assert (np.asarray(a) == np.asarray(b)).all()
+        tier.maintain()
+    # dedup path (the other lax.cond fallback composes with this one)
+    got = jax.jit(
+        lambda u_, s_, d_: ubodt_lookup(u_, s_, d_, dedup=True))(
+            tdu, src, dst)
+    for a, b in zip(want, got):
+        assert (np.asarray(a) == np.asarray(b)).all()
+    # under vmap: the carry/session seam-transition context (cond lowers
+    # to a select; both sides still produce identical bytes)
+    vm = jax.jit(jax.vmap(ubodt_lookup, in_axes=(None, 0, 0)))
+    for a, b in zip(vm(du, src, dst), vm(tdu, src, dst)):
+        assert (np.asarray(a) == np.asarray(b)).all()
+
+
+def test_cold_miss_storm_counters(tables):
+    import jax
+    import jax.numpy as jnp
+
+    from reporter_tpu.ops.hashtable import ubodt_lookup
+    from reporter_tpu.tiles import tiering
+
+    u = tables["cuckoo"]
+    tier = TieredTable(u, 4096, maintain_every=1)
+    tdu = tier.device()
+    h0 = tiering.C_TIER_HITS.value
+    m0 = tiering.C_TIER_MISSES.value
+    rng = np.random.default_rng(11)
+    src = jnp.asarray(rng.integers(0, 25, size=(256,)), jnp.int32)
+    dst = jnp.asarray(rng.integers(0, 25, size=(256,)), jnp.int32)
+    jax.block_until_ready(jax.jit(ubodt_lookup)(tdu, src, dst))
+    tier.drain_stats()
+    assert tiering.C_TIER_MISSES.value > m0  # everything cold at boot
+    assert tiering.C_TIER_HITS.value >= h0
+    # the EWMA admitted the stormed buckets: repeat traffic now hits
+    tier.maintain()
+    h1 = tiering.C_TIER_HITS.value
+    jax.block_until_ready(jax.jit(ubodt_lookup)(tdu, src, dst))
+    tier.drain_stats()
+    assert tiering.C_TIER_HITS.value > h1
+
+
+# -- matcher-level wire differential ----------------------------------------
+
+
+@pytest.mark.parametrize("layout", ["cuckoo", "wide32"])
+@pytest.mark.parametrize("kernel", ["scan", "assoc"])
+def test_match_wire_identical(setup, tables, layout, kernel):
+    """Full matcher: bucketed + carry-chain traffic, tiered (tiny hot
+    budget) vs untiered, wire-identical; eviction churn mid-stream stays
+    identical."""
+    _, arrays = setup
+    cfg = MatcherConfig(ubodt_layout=layout, viterbi_kernel=kernel,
+                        probe_dedup=True, length_buckets=[16])
+    u = tables[layout]
+    base = SegmentMatcher(arrays=arrays, ubodt=u, config=cfg)
+    trs = fleet_traces(arrays) + fleet_traces(arrays, n=1, pts=40, seed=9)
+    want = base.match_many(trs)
+    tiered = SegmentMatcher(
+        arrays=arrays, ubodt=u,
+        config=dataclasses.replace(cfg, ubodt_hot_bytes=4096))
+    assert tiered.tiering is not None
+    assert tiered.tiering.table_bytes > 4 * 4096  # a genuinely cold table
+    got = tiered.match_many(trs)
+    assert json.dumps(want, sort_keys=True) == json.dumps(got,
+                                                          sort_keys=True)
+    # eviction churn mid-stream: hammer a different traffic mix, force
+    # maintenance, then replay the original — still wire-identical
+    tiered.match_many(fleet_traces(arrays, n=8, seed=77))
+    ev = tiered.tiering.maintain()
+    tiered.tiering.maintain()
+    got2 = tiered.match_many(trs)
+    assert json.dumps(want, sort_keys=True) == json.dumps(got2,
+                                                          sort_keys=True)
+    assert ev["hot_rows"] > 0
+
+
+def test_session_step_identical(setup, tables):
+    """The per-vehicle session step (carry round trip included) is
+    bit-exact across tiering — the streaming path probes through the
+    same seam."""
+    _, arrays = setup
+    cfg = MatcherConfig(length_buckets=[16])
+    base = SegmentMatcher(arrays=arrays, ubodt=tables["cuckoo"],
+                          config=cfg)
+    tiered = SegmentMatcher(
+        arrays=arrays, ubodt=tables["cuckoo"],
+        config=dataclasses.replace(cfg, ubodt_hot_bytes=2048))
+    tr = fleet_traces(arrays, n=1, pts=6)[0]
+    items = [{"points": tr["trace"][:3], "carry": None,
+              "t0": float(tr["trace"][0]["time"]), "pkey": ()}]
+    (rec_a, aux_a, carry_a), = base.match_sessions(items)
+    (rec_b, aux_b, carry_b), = tiered.match_sessions(items)
+    for a, b in zip(rec_a, rec_b):
+        assert (np.asarray(a) == np.asarray(b)).all()
+    for k in ("scores", "edge", "offset"):
+        assert (np.asarray(carry_a[k]) == np.asarray(carry_b[k])).all()
+    # step 2 from the carried beam
+    items2 = [{"points": tr["trace"][3:], "carry": carry_a,
+               "t0": float(tr["trace"][0]["time"]), "pkey": ()}]
+    (rec_a2, _, _), = base.match_sessions(items2)
+    items2[0]["carry"] = carry_b
+    (rec_b2, _, _), = tiered.match_sessions(items2)
+    for a, b in zip(rec_a2, rec_b2):
+        assert (np.asarray(a) == np.asarray(b)).all()
+
+
+# -- tier mechanics ---------------------------------------------------------
+
+
+def test_hot_arena_smaller_than_one_row(tables):
+    """A budget below one bucket row is legal: capacity 0, everything
+    pages cold, residency 0."""
+    tier = TieredTable(tables["wide32"], 1)
+    assert tier.capacity == 0
+    assert tier.summary()["hot_rows"] == 0
+    assert tier.maintain() == {"hot_rows": 0, "admitted": 0, "evicted": 0}
+
+
+def test_eviction_accounting(tables):
+    from reporter_tpu.tiles import tiering
+
+    u = tables["cuckoo"]
+    tier = TieredTable(u, 8 * 512)  # 8 cuckoo rows
+    assert tier.capacity == 8
+    # synthesise skewed probe traffic directly through the stats hook
+    tier._note(np.arange(8), np.zeros(8, bool))
+    tier.drain_stats()
+    tier.maintain()
+    assert set(tier.hot_buckets()) >= set(range(8))
+    e0 = tiering.C_TIER_EVICTIONS.value
+    # a hotter competing set must displace the old one
+    rival = np.arange(tier.n_buckets - 8, tier.n_buckets)
+    for _ in range(6):
+        tier._note(np.repeat(rival, 4), np.zeros(32, bool))
+        tier.drain_stats()
+        tier.maintain()
+    assert set(tier.hot_buckets()) == set(rival)
+    assert tiering.C_TIER_EVICTIONS.value > e0
+
+
+def test_tier_state_across_relayout(setup, tables):
+    """UBODT.relayout() composes with tiering two ways: re-tiering the
+    relayouted table directly, and the matcher's env-driven relayout of
+    a prebuilt table — both still bit-identical to untiered."""
+    _, arrays = setup
+    u = tables["cuckoo"]
+    wide = u.relayout("wide32")
+    tier = TieredTable(wide, 4096)
+    assert tier.n_buckets == wide.n_buckets
+    assert tier.lanes == 256
+    cfg = MatcherConfig(ubodt_layout="wide32", ubodt_hot_bytes=4096,
+                        length_buckets=[16])
+    m = SegmentMatcher(arrays=arrays, ubodt=u, config=cfg)  # relayouts
+    assert m.ubodt.layout == "wide32"
+    assert m.tiering is not None
+    assert m.tiering.ubodt.layout == "wide32"
+    base = SegmentMatcher(
+        arrays=arrays, ubodt=wide,
+        config=MatcherConfig(ubodt_layout="wide32", length_buckets=[16]))
+    trs = fleet_traces(arrays, n=4)
+    assert json.dumps(base.match_many(trs), sort_keys=True) == \
+        json.dumps(m.match_many(trs), sort_keys=True)
+
+
+def test_shard_seeding_and_parse(tables):
+    u = tables["cuckoo"]
+    lo, hi = shard_bucket_range(1, 4, u.n_buckets)
+    tier = TieredTable(u, 4 * 512, shard=(1, 4))
+    hot = tier.hot_buckets()
+    assert len(hot) == 4
+    assert (hot >= lo).all() and (hot < hi).all()
+    # the seed survives a zero-traffic maintenance pass (never evict a
+    # probed-nothing world into a different probed-nothing world)
+    tier.maintain()
+    assert set(tier.hot_buckets()) == set(hot)
+    assert parse_shard("") is None
+    assert parse_shard("2/8") == (2, 8)
+    with pytest.raises(ValueError):
+        parse_shard("8/2")
+    with pytest.raises(ValueError):
+        parse_shard("nope")
+    # the partition tiles the bucket space exactly
+    spans = [shard_bucket_range(i, 3, u.n_buckets) for i in range(3)]
+    assert spans[0][0] == 0 and spans[-1][1] == u.n_buckets
+    assert all(a[1] == b[0] for a, b in zip(spans, spans[1:]))
+
+
+def test_mesh_excludes_tiering(setup, tables):
+    """Tiering and the dp/gp mesh are alternative scaling legs: a meshed
+    config logs + ignores the hot budget instead of mis-composing."""
+    import jax
+
+    _, arrays = setup
+    if len(jax.devices()) < 2:
+        pytest.skip("needs >= 2 devices for a dp mesh")
+    cfg = MatcherConfig(devices=2, ubodt_hot_bytes=4096,
+                        length_buckets=[16])
+    m = SegmentMatcher(arrays=arrays, ubodt=tables["cuckoo"], config=cfg)
+    assert m.tiering is None

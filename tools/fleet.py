@@ -229,6 +229,15 @@ class Fleet:
         rid = "rep-%d" % i
         env = dict(self._base_env)
         env["REPORTER_REPLICA_ID"] = rid
+        # fleet-sharded UBODT serving (docs/serving-fleet.md "Sharded
+        # tables"): each replica is assigned one contiguous bucket-range
+        # shard of the table to seed its hot arena with, advertised on
+        # /health for the router's geo-aware ranking.  A respawned
+        # replica keeps its slot's shard (i mod count), so the partition
+        # is stable across restarts and scale events.
+        if self.args.ubodt_shards > 0:
+            env["REPORTER_UBODT_SHARD"] = "%d/%d" % (
+                i % self.args.ubodt_shards, self.args.ubodt_shards)
         return Child(
             rid,
             serve_cmd + [self.args.config, "%s:%d" % (self.host, port)],
@@ -634,6 +643,12 @@ def main(argv=None) -> int:
     ap.add_argument("--federate-every", type=float, default=5.0,
                     help="seconds between federation pulls written to "
                          "<workdir>/federation.json (0 disables)")
+    ap.add_argument("--ubodt-shards", type=int, default=0,
+                    help="assign each replica REPORTER_UBODT_SHARD="
+                         "'i%%N/N' over this many table shards (0 = "
+                         "unsharded; pair with REPORTER_UBODT_HOT_BYTES "
+                         "for the tiered serving fleet, docs/serving-"
+                         "fleet.md \"Sharded tables\")")
     ap.add_argument("--cpu-default", action="store_true",
                     help="default children to JAX_PLATFORMS=cpu when unset")
     # self-driving knobs (docs/serving-fleet.md "Self-driving fleet")

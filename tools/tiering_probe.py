@@ -1,0 +1,173 @@
+#!/usr/bin/env python
+"""CPU measurement harness for the hot/cold tiered UBODT (ISSUE 14
+acceptance): a tiered table >= 4x the configured hot budget must serve
+with match output BIT-IDENTICAL to the untiered table (both viterbi
+kernels x both layouts), and the artifact records the measured hit rate
+and throughput next to the untiered baseline.
+
+The on-chip story is an HBM-capacity property (a continent table simply
+does not fit); on CPU the hot arena and the host pages live in the same
+DRAM, so the throughput numbers here measure the OVERHEAD of the tier
+machinery (slot-map indirection + stats callback + cold-path host
+gathers), not a speedup — the honest CPU-measurable claims are
+bit-identity, hit-rate convergence, and bounded overhead.
+
+    python tools/tiering_probe.py [--out docs/measurements/...json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np  # noqa: E402
+
+from reporter_tpu.matching import MatcherConfig, SegmentMatcher  # noqa: E402
+from reporter_tpu.tiles import tiering  # noqa: E402
+from reporter_tpu.tiles.arrays import build_graph_arrays  # noqa: E402
+from reporter_tpu.tiles.network import grid_city  # noqa: E402
+from reporter_tpu.tiles.ubodt import build_ubodt  # noqa: E402
+
+
+def fleet_traces(arrays, rows, n, pts, seed):
+    rng = np.random.default_rng(seed)
+    out = []
+    for i in range(n):
+        r = int(rng.integers(0, rows))
+        row_nodes = [r * rows + c for c in range(rows)]
+        xs = arrays.node_x[row_nodes]
+        ys = arrays.node_y[row_nodes]
+        t = np.linspace(0.05, 0.9, pts)
+        px = np.interp(t, np.linspace(0, 1, rows), xs) + rng.normal(0, 3, pts)
+        py = np.interp(t, np.linspace(0, 1, rows), ys) + rng.normal(0, 3, pts)
+        lat, lon = arrays.proj.to_latlon(px, py)
+        out.append({"uuid": "v%d" % i, "trace": [
+            {"lat": float(a), "lon": float(o), "time": 1000.0 + 15 * j}
+            for j, (a, o) in enumerate(zip(lat, lon))]})
+    return out
+
+
+def run_leg(arrays, ubodt, traces, kernel, hot_bytes, reps=3):
+    layout = ubodt.layout
+    cfg = MatcherConfig(ubodt_layout=layout, viterbi_kernel=kernel,
+                        probe_dedup=True, length_buckets=[64])
+    base = SegmentMatcher(arrays=arrays, ubodt=ubodt, config=cfg)
+    want = base.match_many(traces)  # also warms the base jits
+    t0 = time.monotonic()
+    for _ in range(reps):
+        base.match_many(traces)
+    base_s = (time.monotonic() - t0) / reps
+
+    h0, m0 = tiering.C_TIER_HITS.value, tiering.C_TIER_MISSES.value
+    tiered = SegmentMatcher(
+        arrays=arrays, ubodt=ubodt,
+        config=dataclasses.replace(cfg, ubodt_hot_bytes=hot_bytes))
+    assert tiered.tiering is not None
+    ratio = tiered.tiering.table_bytes / hot_bytes
+    assert ratio >= 4.0, "table %.1fx hot budget < the 4x acceptance bar" \
+        % ratio
+    got = tiered.match_many(traces)  # the cold storm + warmup pass
+    tiered.tiering.drain_stats()
+    identical_cold = json.dumps(want, sort_keys=True) == json.dumps(
+        got, sort_keys=True)
+    cold_hits = tiering.C_TIER_HITS.value - h0
+    cold_misses = tiering.C_TIER_MISSES.value - m0
+    # fold the cold storm into the EWMA and admit the working set — the
+    # steady state a serving deployment reaches on its own maintenance
+    # cadence (maintain_every dispatches)
+    tiered.tiering.maintain()
+    h1, m1 = tiering.C_TIER_HITS.value, tiering.C_TIER_MISSES.value
+    t0 = time.monotonic()
+    for _ in range(reps):
+        got = tiered.match_many(traces)
+    tier_s = (time.monotonic() - t0) / reps
+    tiered.tiering.drain_stats()
+    identical_warm = json.dumps(want, sort_keys=True) == json.dumps(
+        got, sort_keys=True)
+    warm_hits = tiering.C_TIER_HITS.value - h1
+    warm_misses = tiering.C_TIER_MISSES.value - m1
+    n_pts = sum(len(t["trace"]) for t in traces)
+    return {
+        "layout": layout, "kernel": kernel,
+        "table_bytes": tiered.tiering.table_bytes,
+        "hot_bytes": hot_bytes,
+        "table_over_hot_budget": round(ratio, 2),
+        "hot_rows": tiered.tiering.summary()["hot_rows"],
+        "n_buckets": tiered.tiering.n_buckets,
+        "bit_identical_cold_pass": identical_cold,
+        "bit_identical_warm_pass": identical_warm,
+        "cold_pass_hit_rate": round(
+            cold_hits / max(1, cold_hits + cold_misses), 4),
+        "warm_hit_rate": round(
+            warm_hits / max(1, warm_hits + warm_misses), 4),
+        "untiered_points_per_sec": round(n_pts / base_s, 1),
+        "tiered_points_per_sec": round(n_pts / tier_s, 1),
+        "tiered_over_untiered": round(base_s / tier_s, 3),
+    }
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default=None)
+    ap.add_argument("--rows", type=int, default=10)
+    ap.add_argument("--traces", type=int, default=48)
+    ap.add_argument("--points", type=int, default=48)
+    ap.add_argument("--reps", type=int, default=3)
+    args = ap.parse_args(argv)
+
+    city = grid_city(rows=args.rows, cols=args.rows, spacing_m=150.0)
+    arrays = build_graph_arrays(city, cell_size=100.0)
+    legs = []
+    for layout in ("cuckoo", "wide32"):
+        ubodt = build_ubodt(arrays, delta=2000.0, layout=layout)
+        table_bytes = ubodt.n_buckets * ubodt.bucket_entries * 8 * 4
+        hot_bytes = table_bytes // 8  # 8x budget: comfortably >= the 4x bar
+        traces = fleet_traces(arrays, args.rows, args.traces,
+                              args.points, seed=3)
+        for kernel in ("scan", "assoc"):
+            leg = run_leg(arrays, ubodt, traces, kernel, hot_bytes,
+                          reps=args.reps)
+            legs.append(leg)
+            print(json.dumps(leg))
+    ok = all(leg["bit_identical_cold_pass"]
+             and leg["bit_identical_warm_pass"] for leg in legs)
+    art = {
+        "date": time.strftime("%Y-%m-%d"),
+        "what": ("CPU acceptance artifact for the hot/cold tiered UBODT "
+                 "(tiles/tiering.py): a table >= 4x the configured hot "
+                 "budget serves wire-identically to the untiered table "
+                 "across both kernels x both layouts; hit rate converges "
+                 "once the EWMA admits the working set.  CPU throughput "
+                 "measures tier-machinery OVERHEAD (hot arena and host "
+                 "pages share DRAM here) — the capacity win is the point "
+                 "on chip, where the cold tier is host memory a resident "
+                 "table cannot use at all."),
+        "platform": "cpu",
+        "acceptance": {
+            "table_over_hot_budget_min": min(
+                leg["table_over_hot_budget"] for leg in legs),
+            "bit_identical_all_legs": ok,
+            "warm_hit_rate_min": min(leg["warm_hit_rate"] for leg in legs),
+        },
+        "legs": legs,
+    }
+    out = args.out or os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "docs", "measurements",
+        "ubodt_tiering_cpu_%s.json" % time.strftime("%Y-%m-%d"))
+    with open(out, "w") as f:
+        json.dump(art, f, indent=1)
+    print("wrote %s" % out)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
