@@ -37,13 +37,43 @@ NEG_INF = -1e30
 
 
 class BruteForceMatcher:
-    """Exhaustive-candidate, exact-Dijkstra, float64 HMM matcher."""
+    """Exhaustive-candidate, exact-Dijkstra, float64 HMM matcher.
 
-    def __init__(self, arrays, cfg):
+    ``sparse``: optional dict of the sparse-gap model's values
+    (beta_ref_s, beta_scale, beta_max, break_speed_mps, vmax_mps,
+    plaus_weight — matching/sparse.SparseModel.oracle_values) — the f64
+    re-derivation of ops/viterbi.SparseParams' time-adaptive transition
+    model and gap-conditioned breakage.  The oracle must speak the SAME
+    model as the device when judging a sparse-cohort decode: a model
+    improvement scored against a dense-model oracle would read as a
+    regression.  None = the dense model, exactly as before."""
+
+    def __init__(self, arrays, cfg, sparse: "dict | None" = None):
         self.a = arrays
         self.cfg = cfg
+        self.sparse = dict(sparse) if sparse else None
         self._route_cache: Dict[int, Tuple[Dict[int, float], Dict[int, float]]] = {}
         self._seg_geom = None  # lazy f64 segment geometry (candidates())
+
+    # -- sparse-gap model (keep in lock-step with ops/viterbi.py) -----------
+
+    def _beta(self, dt: float) -> float:
+        """beta(dt): the time-adaptive tolerance family (sparse_beta)."""
+        beta = float(self.cfg.beta)
+        if not self.sparse or dt <= 0:
+            return beta
+        ref = max(float(self.sparse.get("beta_ref_s", 15.0)), 1.0)
+        scale = float(self.sparse.get("beta_scale", 1.0))
+        mult = 1.0 + scale * max(dt - ref, 0.0) / ref
+        return beta * min(mult, float(self.sparse.get("beta_max", 8.0)))
+
+    def _breakage(self, dt: float) -> float:
+        """Gap-conditioned breakage threshold (sparse_breakage)."""
+        base = float(self.cfg.breakage_distance)
+        if not self.sparse:
+            return base
+        return max(base, float(self.sparse.get("break_speed_mps", 34.0))
+                   * max(dt, 0.0))
 
     # -- exhaustive candidates (float64, no grid) ---------------------------
 
@@ -141,11 +171,19 @@ class BruteForceMatcher:
             return NEG_INF
         if dt > 0 and rtime > cfg.max_route_time_factor * max(dt, 1.0):
             return NEG_INF
-        logp = -abs(route - gc) / cfg.beta
+        beta_t = self._beta(dt)
+        logp = -abs(route - gc) / beta_t
         if cfg.turn_penalty_factor > 0.0 and not same_known:
             turn = float(a.edge_head0[eb]) - float(a.edge_head1[ea])
             turn = abs((turn + math.pi) % (2.0 * math.pi) - math.pi)
-            logp -= cfg.turn_penalty_factor * turn / (math.pi * cfg.beta)
+            logp -= cfg.turn_penalty_factor * turn / (math.pi * beta_t)
+        if self.sparse and dt > 0:
+            # drivable-speed plausibility (the f64 twin of the device term)
+            vmax = max(float(self.sparse.get("vmax_mps", 45.0)), 1.0)
+            implied = route / max(dt, 1.0)
+            if implied > vmax:
+                logp -= (float(self.sparse.get("plaus_weight", 3.0))
+                         * (implied - vmax) / vmax)
         return logp
 
     # -- viterbi ------------------------------------------------------------
@@ -173,7 +211,7 @@ class BruteForceMatcher:
             prev, cur = cands[t - 1], cands[t]
             sc = [NEG_INF] * len(cur)
             bp = [-1] * len(cur)
-            broke = (gc > self.cfg.breakage_distance or not prev
+            broke = (gc > self._breakage(dt) or not prev
                      or not cur or max(score[-1], default=NEG_INF) <= NEG_INF / 2)
             if not broke:
                 for j, cj in enumerate(cur):

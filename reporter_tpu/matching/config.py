@@ -97,6 +97,40 @@ class MatcherConfig:
     session_tail_points: int = 64
     max_sessions: int = 65536
     session_ttl_s: float = 3600.0
+    # sparse-gap matching model (docs/match-quality.md "Sparse gaps";
+    # ROADMAP open item 4): traces whose MEDIAN inter-point gap is at/
+    # above sparse_gap_s dispatch through the time-adaptive "sparse"
+    # program variants — beta scaled by the gap, a drivable-speed
+    # plausibility term, gap-conditioned breakage, and a per-cohort
+    # candidate budget/radius — while dense traffic keeps the
+    # byte-identical classic programs.  Off by default so library callers
+    # and the bit-exact differential suites see PR 14 output unchanged;
+    # the serve entrypoint turns it on ($REPORTER_SPARSE=0 reverts
+    # bit-for-bit).  Per-cohort calibrated values load from
+    # $REPORTER_CALIBRATION / ``calibration`` (tools/calibrate.py emits
+    # the pinned CALIBRATION.json); the knobs below are the uncalibrated
+    # family defaults.
+    sparse: bool = False
+    sparse_gap_s: float = 40.0
+    sparse_beam_k: int = 16
+    # 0 = inherit search_radius; any value clamps to cell_size/2 (the 2x2
+    # quadrant sweep bound) with the clamp counted + warned
+    sparse_search_radius: float = 0.0
+    sparse_beta_ref_s: float = 15.0
+    sparse_beta_scale: float = 1.0
+    sparse_beta_max: float = 8.0
+    sparse_break_speed_mps: float = 34.0
+    sparse_vmax_mps: float = 45.0
+    sparse_plaus_weight: float = 3.0
+    calibration: str = ""
+    # route-consistent interpolation (docs/match-quality.md): when on (or
+    # per request via match_options.interpolate), the post-decode engine
+    # re-times each matched point-pair's UBODT shortest-path segment
+    # sequence by free-flow traversal time (length/speed) instead of
+    # linear route distance, so a sparse trace's intermediate segments
+    # carry drivable boundary times — the way Meili's interpolation
+    # reports every traversed segment.  Same wire record shape either way.
+    interpolate: bool = False
     # batch rungs pre-dispatched per length bucket by warmup passes
     # (serve --warmup / batch --warmup); each snaps up to a ladder rung
     warmup_batch_sizes: List[int] = field(default_factory=lambda: [1])
@@ -142,9 +176,17 @@ class MatcherConfig:
         """Accept a valhalla-style config json ({'meili': {'default': {...}}})."""
         d = meili.get("meili", meili).get("default", meili.get("default", meili))
         c = cls()
-        # NB meili's interpolation_distance is intentionally absent: the
-        # batched kernel matches every point rather than interpolating
-        # near-duplicates, so accepting the key would silently do nothing.
+        # meili's interpolation_distance historically had no analogue here
+        # (the batched kernel matches every point rather than collapsing
+        # near-duplicates).  A config carrying the key now enables the
+        # route-consistent interpolation engine (matching/sparse.py): the
+        # part of meili's interpolation sparse traces actually depend on —
+        # every traversed segment reported with drivable boundary times —
+        # is honoured, while near-duplicate collapsing remains
+        # intentionally absent (the kernel is batched; dense duplicate
+        # points cost nothing).
+        if "interpolation_distance" in d:
+            c.interpolate = True
         for key in (
             "sigma_z", "beta", "search_radius", "breakage_distance",
             "max_route_distance_factor", "max_route_time_factor",

@@ -32,6 +32,9 @@ from ..tiles.network import RoadNetwork
 from ..tiles.ubodt import UBODT, build_ubodt
 from .assoc_native import associate_segments_batch
 from .config import MatcherConfig
+from .sparse import (
+    C_SPARSE_DISPATCH, SparseModel, associate_interpolated, clamp_radius,
+)
 
 log = logging.getLogger(__name__)
 
@@ -213,6 +216,24 @@ class SegmentMatcher:
             self._quality_aux = env_qa not in ("0", "false", "off", "no")
         else:
             self._quality_aux = bool(getattr(self.cfg, "quality_aux", False))
+        # sparse-gap matching model (docs/match-quality.md "Sparse gaps"):
+        # traces at the reference BatchingProcessor's sparse operating
+        # point dispatch through the time-adaptive "sparse" program
+        # variants with per-cohort (optionally CALIBRATION.json-pinned)
+        # parameters.  Off by default — the dense programs, the bit-exact
+        # differential suites, and PR 14 wire output are untouched; the
+        # serve entrypoint enables it ($REPORTER_SPARSE=0 reverts).
+        self.sparse = SparseModel(
+            self.cfg, arrays.cell_size,
+            mesh=(max(1, int(getattr(self.cfg, "devices", 1))) > 1
+                  or max(1, int(getattr(self.cfg, "graph_devices", 1))) > 1))
+        # route-consistent interpolation default (per-request
+        # match_options.interpolate overrides either way)
+        env_ip = os.environ.get("REPORTER_INTERPOLATE", "").strip().lower()
+        if env_ip:
+            self._interpolate = env_ip not in ("0", "false", "off", "no")
+        else:
+            self._interpolate = bool(getattr(self.cfg, "interpolate", False))
         # per-request MatchParams (ROADMAP open item 4's tuning surface):
         # the reference wire contract's sigma_z / beta / search_radius /
         # gps_accuracy ride match_options; MatchParams are traced scalars,
@@ -355,9 +376,13 @@ class SegmentMatcher:
         "none"; "chain" is the carry-dependent remainder it feeds;
         "session" is the per-vehicle incremental step (ops/viterbi
         .session_step_packed — always aux: the streaming path is the
-        ambiguity-sensitive one).  The gp-sharded variants are built
-        through _make_gp_jits; all expose packed calling conventions."""
-        if kind == "pre":
+        ambiguity-sensitive one).  The sparse-gap model's variants live
+        under their own kinds ("sparse" / "sparse_pre" / "sparse_chain" /
+        "sparse_session", docs/match-quality.md) so dense traffic keeps
+        replaying the byte-identical classic programs.  The gp-sharded
+        variants are built through _make_gp_jits; all expose packed
+        calling conventions."""
+        if kind in ("pre", "sparse_pre"):
             kernel = "none"
         # the aux (confidence-diagnostics) flag selects program VARIANTS
         # for the compact/chain kinds, so it is part of the cache key — a
@@ -367,6 +392,47 @@ class SegmentMatcher:
         key = (kind, kernel, qa)
         fn = self._jits.get(key)
         if fn is None:
+            if kind.startswith("sparse"):
+                # mesh deployments disable the model at construction; a
+                # sparse kind reaching a gp mesh is a programming error
+                if self._n_gp > 1:
+                    raise RuntimeError(
+                        "sparse dispatch kinds do not compose with the gp "
+                        "mesh (SparseModel should be disabled)")
+                import functools
+
+                import jax
+
+                from ..ops.viterbi import (
+                    chain_batch_carry_packed_sparse,
+                    match_batch_compact_packed_sparse,
+                    precompute_batch_packed_sparse,
+                    session_step_packed_sparse,
+                )
+
+                if kind == "sparse":
+                    self._jits[key] = jax.jit(
+                        functools.partial(
+                            match_batch_compact_packed_sparse,
+                            kernel=kernel, dedup=self._probe_dedup),
+                        static_argnums=(5,))
+                elif kind == "sparse_pre":
+                    self._jits[key] = jax.jit(
+                        functools.partial(
+                            precompute_batch_packed_sparse,
+                            dedup=self._probe_dedup),
+                        static_argnums=(5,))
+                elif kind == "sparse_chain":
+                    self._jits[key] = jax.jit(
+                        functools.partial(
+                            chain_batch_carry_packed_sparse, kernel=kernel),
+                        static_argnums=(6,))
+                else:  # sparse_session
+                    self._jits[key] = jax.jit(
+                        functools.partial(
+                            session_step_packed_sparse, kernel=kernel),
+                        static_argnums=(5,))
+                return self._jits[key]
             if self._n_gp > 1:
                 if kind == "pre":
                     self._jits[key] = self._make_gp_pre_jit()
@@ -472,12 +538,19 @@ class SegmentMatcher:
         sigma = _num("sigma_z", _num("gps_accuracy", self.cfg.sigma_z))
         radius = _num("search_radius", self.cfg.search_radius)
         max_radius = float(self.arrays.cell_size) / 2.0
-        return {
+        out = {
             "sigma_z": sigma,
             "beta": _num("beta", self.cfg.beta),
-            "search_radius": min(radius, max_radius),
+            "search_radius": clamp_radius(
+                radius, self.arrays.cell_size, source="request"),
             "shape_match": mo.get("shape_match", "map_snap"),
         }
+        if radius > max_radius:
+            # the clamp used to be invisible even in ?debug=1; now it is a
+            # counter, a structured warning (clamp_radius), and this flag
+            # riding the debug echo (docs/http-api.md)
+            out["search_radius_clamped"] = True
+        return out
 
     def _params_key(self, trace) -> tuple:
         """Effective-params grouping key for one trace: () = the config
@@ -663,13 +736,45 @@ class SegmentMatcher:
             return jax.device_put(xin, self._batch_sharding)
         return jnp.asarray(xin)
 
+    def _interp_indices(self, traces) -> "set | None":
+        """Trace indices to associate through the route-consistent
+        interpolation engine: per-request match_options.interpolate wins,
+        else the matcher default (cfg.interpolate / $REPORTER_INTERPOLATE).
+        None when nothing interpolates (the fast path)."""
+        out = None
+        for i, tr in enumerate(traces):
+            mo = tr.get("match_options") if isinstance(tr, dict) else None
+            want = self._interpolate
+            if isinstance(mo, dict) and "interpolate" in mo:
+                want = bool(mo["interpolate"])
+            if want:
+                if out is None:
+                    out = set()
+                out.add(i)
+        return out
+
+    def _sparse_row_factor(self, slabel: str, pkey: tuple = ()) -> int:
+        """How many dense rows one sparse row costs in the B*T device
+        budget: the transition tensor is [B, T, K, K], so a cohort's wider
+        K inflates memory by (K_sp/K)^2 — fold that into the length passed
+        to _device_cap."""
+        if not slabel:
+            return 1
+        _p, _sp, k_sp = self.sparse.params_for(slabel, pkey)
+        k0 = max(1, int(self.cfg.beam_k))
+        return max(1, (k_sp * k_sp) // (k0 * k0))
+
     def _dispatch_batch(self, px: np.ndarray, py: np.ndarray, times: np.ndarray, valid: np.ndarray,
-                        pkey: tuple = ()):
+                        pkey: tuple = (), slabel: str = ""):
         """Queue one [B, T] padded batch on the backend without blocking.
         Returns an opaque handle for _collect_batch.  ``pkey`` selects a
         per-request effective-params group (see _params_key; () = the
         config defaults): MatchParams are traced scalars, so a custom
-        group runs the SAME compiled program with different inputs."""
+        group runs the SAME compiled program with different inputs.
+        ``slabel`` selects a sparse gap cohort (docs/match-quality.md):
+        the batch dispatches through the time-adaptive "sparse" program
+        variant with the cohort's calibrated MatchParams + SparseParams
+        (traced too) and candidate budget K."""
         # chaos seam: a UBODT probe-program failure surfaces mid-call, per
         # chunk, unlike the dispatch point at match_many_async entry
         faults.maybe_raise("ubodt_probe")
@@ -679,8 +784,6 @@ class SegmentMatcher:
             B = px.shape[0]
             kernel = self._kernel_for(px.shape[1])
             qa = self._quality_aux
-            p = self._params_for(pkey)
-            fn = self._get_jit("compact", kernel)
             if self._mesh is not None and px.shape[0] % self._n_dp:
                 # dp sharding splits the batch axis evenly across chips
                 px, py, times, valid = self._stage_rows(
@@ -688,6 +791,23 @@ class SegmentMatcher:
                     px, py, times, valid
                 )
             xin = self._put_packed(pack_inputs(px, py, times, valid))
+            if slabel:
+                p, sp, k_sp = self.sparse.params_for(slabel, pkey)
+                fn = self._get_jit("sparse", kernel)
+                t0 = _time.monotonic()
+                res, aux = fn(self._dg, self._du, xin, p, sp, k_sp)
+                C_DISPATCHES.labels(kernel).inc()
+                C_DISPATCH_COHORT.labels("bucketed", "sparse").inc()
+                self._note_dispatch(
+                    px.shape, _time.monotonic() - t0, kind="sparse",
+                    kernel=kernel, fn=fn,
+                    args=(self._dg, self._du, xin, p, sp, k_sp))
+                if not qa:
+                    aux = None
+                self._start_host_copy(res)
+                return ("jax", B, res, aux)
+            p = self._params_for(pkey)
+            fn = self._get_jit("compact", kernel)
             t0 = _time.monotonic()
             res = fn(self._dg, self._du, xin, p, self.cfg.beam_k)
             aux = None
@@ -880,14 +1000,19 @@ class SegmentMatcher:
             str(t.get("uuid", "")) for t in traces if isinstance(t, dict)))
         results: List[Optional[dict]] = [None] * len(traces)
 
-        # bucket by (effective-params group, padded length); traces beyond
-        # the largest bucket stream through fixed windows with carried
-        # Viterbi state (jax backend) instead of compiling ever-larger
-        # shapes.  The params key is () for default-config traffic (the
-        # fast path), so a fleet without per-request overrides batches
-        # exactly as before.
+        # bucket by (effective-params group, sparse gap cohort, padded
+        # length); traces beyond the largest bucket stream through fixed
+        # windows with carried Viterbi state (jax backend) instead of
+        # compiling ever-larger shapes.  The params key is () and the
+        # sparse label "" for default dense traffic (the fast path), so a
+        # fleet without overrides batches exactly as before.  Sparse
+        # cohorts (median gap >= cfg.sparse_gap_s, model enabled) dispatch
+        # through the time-adaptive "sparse" program variants with their
+        # cohort's calibrated params (docs/match-quality.md).
+        sparse_on = self.sparse.enabled and self.backend == "jax"
         buckets: Dict[tuple, List[int]] = {}
         long_map: Dict[tuple, List[int]] = {}
+        interp_idx = self._interp_indices(traces)
         max_bucket = self.cfg.length_buckets[-1] if self.cfg.length_buckets else 256
         for i, tr in enumerate(traces):
             n = len(tr["trace"])
@@ -895,19 +1020,27 @@ class SegmentMatcher:
                 results[i] = {"segments": []}
                 continue
             pkey = self._params_key(tr)
+            slabel = (self.sparse.label_for_trace(tr) or "") if sparse_on \
+                else ""
             if n > max_bucket and self.backend == "jax":
-                long_map.setdefault(pkey, []).append(i)
+                long_map.setdefault((pkey, slabel), []).append(i)
                 continue
-            buckets.setdefault((pkey, self._bucket_len(n)), []).append(i)
+            buckets.setdefault((pkey, slabel, self._bucket_len(n)),
+                               []).append(i)
 
         # cap the device batch: the kernel materialises [B, T, K, K]
         # transition arrays, so bound B*T (and rows on top); rounded down to a
-        # power of two so the pow2 batch padding below cannot overshoot it
+        # power of two so the pow2 batch padding below cannot overshoot it.
+        # A sparse cohort's wider K grows the transition tensor by
+        # (K_sp/K)^2, so its cap shrinks by the same factor.
         chunks = []
-        for (pkey, blen), idxs in sorted(buckets.items()):
-            cap = self._device_cap(blen)
+        for (pkey, slabel, blen), idxs in sorted(buckets.items()):
+            cap = self._device_cap(blen * self._sparse_row_factor(
+                slabel, pkey))
+            if slabel:
+                C_SPARSE_DISPATCH.labels(slabel).inc(len(idxs))
             chunks.extend(
-                (pkey, blen, idxs[i : i + cap])
+                (pkey, slabel, blen, idxs[i : i + cap])
                 for i in range(0, len(idxs), cap)
             )
         # pipeline: keep a few chunks in flight on the device (jax dispatch
@@ -922,12 +1055,14 @@ class SegmentMatcher:
         def drain_one():
             idxs_, handle_, times_ = pending.popleft()
             res, aux = self._collect_batch_aux(handle_)
-            self._associate_and_store(idxs_, *res, times_, results, aux=aux)
+            self._associate_and_store(idxs_, *res, times_, results, aux=aux,
+                                      interp=interp_idx)
 
-        for pkey, blen, idxs in chunks:
+        for pkey, slabel, blen, idxs in chunks:
             px, py, tm, valid, times = self._fill_rows(traces, idxs, blen)
             handle = self._dispatch_batch(
-                *self._pad_batch_staged(px, py, tm, valid), pkey=pkey)
+                *self._pad_batch_staged(px, py, tm, valid), pkey=pkey,
+                slabel=slabel)
             pending.append((idxs, handle, times))
             if len(pending) >= PIPELINE_DEPTH:
                 drain_one()
@@ -939,8 +1074,11 @@ class SegmentMatcher:
         # host association (VERDICT r04 next #2b: device_util 0.45 because
         # long compute serialised after bucketed association).
         long_handles = []
-        for pkey, lidx in sorted(long_map.items()):
-            long_handles.extend(self._dispatch_long(traces, lidx, pkey=pkey))
+        for (pkey, slabel), lidx in sorted(long_map.items()):
+            if slabel:
+                C_SPARSE_DISPATCH.labels(slabel).inc(len(lidx))
+            long_handles.extend(self._dispatch_long(traces, lidx, pkey=pkey,
+                                                    slabel=slabel))
 
         def finish() -> List[dict]:
             # chaos seam: a wedged device step (the serve watchdog's prey)
@@ -970,7 +1108,7 @@ class SegmentMatcher:
                     idxs_, res, times_, aux = self._fetch_long_aux(
                         long_handles[0])
                 self._associate_and_store(idxs_, *res, times_, results,
-                                          aux=aux)
+                                          aux=aux, interp=interp_idx)
                 return results  # type: ignore[return-value]
             fetched: "_queue.Queue" = _queue.Queue(maxsize=2)
 
@@ -1000,7 +1138,7 @@ class SegmentMatcher:
                         raise item
                     idxs_, res, times_, aux = item
                     self._associate_and_store(idxs_, *res, times_, results,
-                                              aux=aux)
+                                              aux=aux, interp=interp_idx)
             except BaseException:
                 # unblock the collector (it may be parked on the bounded
                 # queue) and let it run its remaining fetches to completion
@@ -1140,14 +1278,17 @@ class SegmentMatcher:
         return tuple(out)
 
     def _associate_and_store(self, idxs, edge, offset, breaks, times, results,
-                             aux=None):
+                             aux=None, interp=None):
         """Wire-format association for B rows (edge may carry pow2 pad rows;
         only the first len(idxs) are read).  times: per-row epoch-sec lists.
         ``aux``: optional [B, 4] confidence block (see MatchResult.aux);
         with quality diagnostics on, each result additionally carries a
         ``"_quality"`` dict (per-point edges, margin stats, pool-exhaustion
         fraction) the serve tier pops off before rendering the report —
-        it never reaches the wire contract."""
+        it never reaches the wire contract.  ``interp``: optional set of
+        trace indices whose association runs through the route-consistent
+        interpolation engine (matching/sparse.py) instead of the batch
+        walk — same record shape, speed-weighted boundary times."""
         B = len(idxs)
         T = edge.shape[1]
         abs_tm = np.zeros((B, T), np.float64)
@@ -1167,6 +1308,25 @@ class SegmentMatcher:
         C_BREAKS.inc(int(np.count_nonzero((breaks[:B] != 0) & in_trace)))
         for row, i in enumerate(idxs):
             results[i] = {"segments": seg_lists[row]}
+        if interp:
+            off32 = np.asarray(offset, np.float32)
+            for row, i in enumerate(idxs):
+                if i not in interp:
+                    continue
+                n = int(n_pts[row])
+                mps = [
+                    {"edge": int(edge[row, t]),
+                     "offset": float(off32[row, t]),
+                     "time": float(abs_tm[row, t]),
+                     "break": bool(breaks[row, t]),
+                     "shape_index": t}
+                    for t in range(n)
+                ]
+                results[i] = {"segments": associate_interpolated(
+                    self.arrays, self.ubodt, mps,
+                    queue_thresh_mps=self.cfg.queue_speed_threshold_kph / 3.6,
+                    back_tol=2.0 * self.cfg.sigma_z + 5.0,
+                )}
         if not self._quality_aux:
             return
         for row, i in enumerate(idxs):
@@ -1183,7 +1343,8 @@ class SegmentMatcher:
                 q["pool_exhausted_frac"] = (round(nx / n, 4) if n else 0.0)
             results[i]["_quality"] = q
 
-    def _dispatch_long(self, traces, idxs, pkey: tuple = ()):
+    def _dispatch_long(self, traces, idxs, pkey: tuple = (),
+                       slabel: str = ""):
         """Dispatch carry chains for traces longer than the largest bucket:
         fixed [B, W]-windows with carried Viterbi state (ops/viterbi
         .TraceCarry), one compile set regardless of trace length, no HMM
@@ -1202,7 +1363,9 @@ class SegmentMatcher:
         from ..ops.viterbi import pack_inputs, unpack_compact
 
         W = self.cfg.length_buckets[-1] if self.cfg.length_buckets else 256
-        cap = self._device_cap(W)  # rows per device batch for this window
+        # rows per device batch for this window (a sparse cohort's wider K
+        # shrinks the cap by (K_sp/K)^2, same B*T*K*K budget)
+        cap = self._device_cap(W * self._sparse_row_factor(slabel, pkey))
 
         # longest-first so rows in one group need similar chunk counts
         order = sorted(idxs, key=lambda i: -len(traces[i]["trace"]))
@@ -1229,7 +1392,8 @@ class SegmentMatcher:
                 )
             xin = pack_inputs(px, py, tm, valid)  # [4, B_pad, n_chunks*W]
             host_parts, outs, aux_dev = self._dispatch_long_group(
-                xin, n_chunks, W, params=self._params_for(pkey))
+                xin, n_chunks, W, params=self._params_for(pkey),
+                pkey=pkey, slabel=slabel)
             dev_tail = None
             if outs:
                 dev_tail = outs[0] if len(outs) == 1 else jnp.concatenate(outs, axis=2)
@@ -1238,7 +1402,8 @@ class SegmentMatcher:
         return handles
 
     def _dispatch_long_group(self, xin, n_chunks: int, W: int,
-                             kernel: "str | None" = None, params=None):
+                             kernel: "str | None" = None, params=None,
+                             pkey: tuple = (), slabel: str = ""):
         """Dispatch every device program for ONE padded long-trace group.
         xin: packed [4, B_pad, n_chunks*W] numpy.  Returns (host_parts,
         outs, aux): already-fetched (edge, offset, breaks) wave tuples, the
@@ -1274,6 +1439,14 @@ class SegmentMatcher:
         B_pad = xin.shape[1]
         k = self.cfg.beam_k
         p = self._params if params is None else params
+        sp = None
+        if slabel:
+            # sparse cohort: the cohort's calibrated params + candidate
+            # budget ride the sparse pre/chain programs.  The legacy fused
+            # carry has no sparse variant — a sparse group always takes
+            # the hoisted path regardless of cfg.long_precompute (the
+            # REPORTER_SPARSE=0 differential covers the legacy program).
+            p, sp, k = self.sparse.params_for(slabel, pkey)
         if kernel is None:
             kernel = self._kernel_for(W)
         carry = initial_carry_batch(B_pad, k)
@@ -1284,7 +1457,7 @@ class SegmentMatcher:
         # confidence aux rides the hoisted chain programs only (the legacy
         # fused carry is the bit-exact differential reference and stays
         # untouched); components combine across seams as min / + / + / +
-        qa = self._quality_aux and self._long_pre
+        qa = (self._quality_aux and self._long_pre) or bool(slabel)
         aux_acc = None
 
         def _fold_aux(aux_c):
@@ -1304,7 +1477,7 @@ class SegmentMatcher:
                     if len(outs) > 1 else unpack_compact(outs[0]))
                 outs.clear()
 
-        if not self._long_pre:
+        if not self._long_pre and not slabel:
             fn_carry = self._get_jit("carry", kernel)
             for c in range(n_chunks):
                 t0 = _time.monotonic()
@@ -1323,8 +1496,9 @@ class SegmentMatcher:
                 _bank(out)
             return host_parts, outs, None
 
-        fn_pre = self._get_jit("pre", "none")
-        fn_chain = self._get_jit("chain", kernel)
+        fn_pre = self._get_jit("sparse_pre" if slabel else "pre", "none")
+        fn_chain = self._get_jit("sparse_chain" if slabel else "chain",
+                                 kernel)
         # chunk-major rows for the precompute: row c*B_pad + b is chunk c of
         # trace b, so one chunk's rows are a contiguous slice of a wave
         rows_all = np.ascontiguousarray(
@@ -1334,7 +1508,8 @@ class SegmentMatcher:
         # cap allows — the same B*T memory bound the fused program obeyed,
         # since the pre wave materialises the [rows, W-1, K, K] transition
         # tensors the fused program held transiently
-        cpw = max(1, self._device_cap(W) // B_pad)
+        cpw = max(1, self._device_cap(
+            W * self._sparse_row_factor(slabel, pkey)) // B_pad)
         for c0 in range(0, n_chunks, cpw):
             m = min(cpw, n_chunks - c0)
             rows = m * B_pad
@@ -1346,23 +1521,39 @@ class SegmentMatcher:
                 seg = np.concatenate(
                     [seg, np.zeros((4, rung - rows, W), np.float32)], axis=1)
             t0 = _time.monotonic()
-            pre = fn_pre(self._dg, self._du, self._put_packed(seg),
-                         p, k)
+            if slabel:
+                pre = fn_pre(self._dg, self._du, self._put_packed(seg),
+                             p, sp, k)
+                pre_args = (self._dg, self._du, seg, p, sp, k)
+            else:
+                pre = fn_pre(self._dg, self._du, self._put_packed(seg),
+                             p, k)
+                pre_args = (self._dg, self._du, seg, p, k)
             C_DISPATCH_COHORT.labels("long", "pre").inc()
             self._note_dispatch((rung, W), _time.monotonic() - t0,
-                                kind="pre", kernel="none", fn=fn_pre,
-                                args=(self._dg, self._du, seg,
-                                      p, k))
+                                kind="sparse_pre" if slabel else "pre",
+                                kernel="none", fn=fn_pre, args=pre_args)
             for i in range(m):
                 c = c0 + i
                 pre_c = jax.tree_util.tree_map(
                     lambda a: a[i * B_pad : (i + 1) * B_pad], pre)
                 t0 = _time.monotonic()
-                out = fn_chain(
-                    self._dg, self._du, pre_c,
-                    self._put_packed(xin[:, :, c * W : (c + 1) * W]),
-                    p, k, carry,
-                )
+                if slabel:
+                    out = fn_chain(
+                        self._dg, self._du, pre_c,
+                        self._put_packed(xin[:, :, c * W : (c + 1) * W]),
+                        p, sp, k, carry,
+                    )
+                    chain_args = (self._dg, self._du, pre_c,
+                                  xin[:, :, :W], p, sp, k, carry)
+                else:
+                    out = fn_chain(
+                        self._dg, self._du, pre_c,
+                        self._put_packed(xin[:, :, c * W : (c + 1) * W]),
+                        p, k, carry,
+                    )
+                    chain_args = (self._dg, self._du, pre_c,
+                                  xin[:, :, :W], p, k, carry)
                 if qa:
                     out, aux_c, carry = out
                     _fold_aux(aux_c)
@@ -1371,10 +1562,10 @@ class SegmentMatcher:
                 C_DISPATCHES.labels(kernel).inc()
                 C_DISPATCH_COHORT.labels("long", "chain").inc()
                 self._note_dispatch((B_pad, W), _time.monotonic() - t0,
-                                    kind="chain", kernel=kernel, fn=fn_chain,
-                                    args=(self._dg, self._du, pre_c,
-                                          xin[:, :, :W], p, k,
-                                          carry))
+                                    kind="sparse_chain" if slabel
+                                    else "chain",
+                                    kernel=kernel, fn=fn_chain,
+                                    args=chain_args)
                 _bank(out)
         return host_parts, outs, aux_acc
 
@@ -1529,6 +1720,7 @@ class SegmentMatcher:
         handles = []
         for i, it in enumerate(items):
             n = max(1, len(it["points"]))
+            slabel = self._session_label(it)
             if n > w_max and self.backend == "jax":
                 # an over-bucket step (rebuild-from-replay, or a fat
                 # delta) CHAINS through the largest warmed [B, W] session
@@ -1536,11 +1728,12 @@ class SegmentMatcher:
                 # fixed-compile-set property the long-trace path has, and
                 # the same decode the windowed long path produces (carry
                 # seams at W boundaries)
-                handles.append(self._dispatch_session_chain(it, i, w_max))
+                handles.append(self._dispatch_session_chain(
+                    it, i, w_max, slabel=slabel))
                 continue
             groups.setdefault(
-                (it["pkey"], self._session_bucket(n)), []).append(i)
-        for (pkey, W), idxs in sorted(groups.items()):
+                (it["pkey"], slabel, self._session_bucket(n)), []).append(i)
+        for (pkey, slabel, W), idxs in sorted(groups.items()):
             cap = self._device_cap(W)
             for g in range(0, len(idxs), cap):
                 sub = idxs[g : g + cap]
@@ -1570,9 +1763,32 @@ class SegmentMatcher:
                     [items[i]["carry"] for i in sub]
                     + [None] * (b_pad - len(sub)), b_pad)
                 kernel = self._kernel_for(W)
+                xin = self._put_packed(pack_inputs(px, py, tm, valid))
+                if slabel:
+                    # sparse streaming step: the time-adaptive model with
+                    # the cohort's calibrated params, K pinned to the
+                    # carried beam width (a session's beam cannot change
+                    # width mid-life — the wider candidate budget is a
+                    # windowed-dispatch lever; docs/match-quality.md)
+                    p, sp, _k_sp = self.sparse.params_for(slabel, pkey)
+                    fn = self._get_jit("sparse_session", kernel)
+                    C_SPARSE_DISPATCH.labels(slabel).inc(len(sub))
+                    t0 = _time.monotonic()
+                    packed, aux, carry_out = fn(
+                        self._dg, self._du, xin, p, sp, self.cfg.beam_k,
+                        carry)
+                    C_DISPATCHES.labels(kernel).inc()
+                    C_DISPATCH_COHORT.labels("session", "sparse").inc()
+                    self._note_dispatch(
+                        (b_pad, W), _time.monotonic() - t0,
+                        kind="sparse_session", kernel=kernel, fn=fn,
+                        args=(self._dg, self._du, xin, p, sp,
+                              self.cfg.beam_k, carry))
+                    self._start_host_copy(packed)
+                    handles.append(("jax", sub, ns, packed, aux, carry_out))
+                    continue
                 p = self._params_for(pkey)
                 fn = self._get_jit("session", kernel)
-                xin = self._put_packed(pack_inputs(px, py, tm, valid))
                 t0 = _time.monotonic()
                 packed, aux, carry_out = fn(
                     self._dg, self._du, xin, p, self.cfg.beam_k, carry)
@@ -1632,7 +1848,24 @@ class SegmentMatcher:
 
         return finish
 
-    def _dispatch_session_chain(self, item, idx: int, W: int):
+    def _session_label(self, item) -> str:
+        """The sparse gap cohort of one session step ("" = dense).  The
+        seam gap counts: a stream delivering one point per minute has a
+        one-element delta, and its dt lives between the carried last point
+        and the arriving one."""
+        if self.backend != "jax" or not self.sparse.enabled:
+            return ""
+        try:
+            times = [float(p["time"]) for p in item["points"]]
+            c = item.get("carry")
+            if c is not None:
+                times = [float(item["t0"]) + float(c["t"])] + times
+        except (KeyError, TypeError, ValueError):
+            return ""
+        return self.sparse.label_for_times(times) or ""
+
+    def _dispatch_session_chain(self, item, idx: int, W: int,
+                                slabel: str = ""):
         """One over-bucket session step as a carry chain of [B, W]
         session-program dispatches (B = 1 padded to the dp width): the
         rebuild-from-replay path's occasional wide window rides the SAME
@@ -1646,9 +1879,15 @@ class SegmentMatcher:
         b_pad = max(1, self._n_dp)
         carry = self._carry_batch(
             [item["carry"]] + [None] * (b_pad - 1), b_pad)
-        p = self._params_for(item["pkey"])
+        sp = None
+        if slabel:
+            p, sp, _k_sp = self.sparse.params_for(slabel, item["pkey"])
+            fn = self._get_jit("sparse_session", self._kernel_for(W))
+            C_SPARSE_DISPATCH.labels(slabel).inc()
+        else:
+            p = self._params_for(item["pkey"])
+            fn = self._get_jit("session", self._kernel_for(W))
         kernel = self._kernel_for(W)
-        fn = self._get_jit("session", kernel)
         chunk_outs = []
         for c0 in range(0, len(pts), W):
             chunk = dict(item, points=pts[c0 : c0 + W])
@@ -1657,14 +1896,22 @@ class SegmentMatcher:
                 px, py, tm, valid = _pad_rows(b_pad - 1, px, py, tm, valid)
             xin = self._put_packed(pack_inputs(px, py, tm, valid))
             t0 = _time.monotonic()
-            packed, aux, carry = fn(
-                self._dg, self._du, xin, p, self.cfg.beam_k, carry)
+            if sp is not None:
+                packed, aux, carry = fn(
+                    self._dg, self._du, xin, p, sp, self.cfg.beam_k, carry)
+                note_args = (self._dg, self._du, xin, p, sp,
+                             self.cfg.beam_k, carry)
+            else:
+                packed, aux, carry = fn(
+                    self._dg, self._du, xin, p, self.cfg.beam_k, carry)
+                note_args = (self._dg, self._du, xin, p, self.cfg.beam_k,
+                             carry)
             C_DISPATCHES.labels(kernel).inc()
             C_DISPATCH_COHORT.labels("session", "chain").inc()
             self._note_dispatch(
-                (b_pad, W), _time.monotonic() - t0, kind="session",
-                kernel=kernel, fn=fn,
-                args=(self._dg, self._du, xin, p, self.cfg.beam_k, carry))
+                (b_pad, W), _time.monotonic() - t0,
+                kind="sparse_session" if sp is not None else "session",
+                kernel=kernel, fn=fn, args=note_args)
             chunk_outs.append((packed, aux, ns[0]))
         self._start_host_copy(chunk_outs[-1][0])
         return ("chain", idx, chunk_outs, carry)
@@ -1739,6 +1986,16 @@ class SegmentMatcher:
                         self.match_many(_dummy_traces(n, b))
                         n_shapes += 1
                         C_WARM_SHAPES.labels(kern).inc()
+                        if self.sparse.enabled:
+                            # the sparse-cohort program variant for the
+                            # same shape: a dummy trace at the sparse
+                            # operating gap routes through the "sparse"
+                            # dispatch kind, so the first real ≥45 s-gap
+                            # request cannot hit a compile stall either
+                            self.match_many(_dummy_traces(
+                                n, b, dt=max(60.0, self.sparse.gap_s)))
+                            n_shapes += 1
+                            C_WARM_SHAPES.labels(kern).inc()
                 finally:
                     self._kernel_mode = prev_mode
         if carry_chain and self.cfg.length_buckets:
@@ -1774,11 +2031,13 @@ class SegmentMatcher:
         log.info("matcher warmup: %d shapes in %.1fs", n_shapes, dt)
         return dt
 
-    def dummy_traces(self, n: int, b: int) -> List[dict]:
+    def dummy_traces(self, n: int, b: int, dt: float = 5.0) -> List[dict]:
         """``b`` copies of an ``n``-point synthetic trace along the graph's
         first edge — the same full-dispatch-path probe warmup uses, also
         driven by obs/attrib.capture_matcher (/debug/attrib's on-demand
-        capture) so the profiled programs are exactly the serving ones."""
+        capture) so the profiled programs are exactly the serving ones.
+        ``dt`` sets the inter-point gap: warmup passes the sparse
+        operating gap to pre-compile the sparse-cohort program variants."""
         ax, ay, bx, by = self._probe_edge_coords()
         xs = np.linspace(ax, bx, n)
         ys = np.linspace(ay, by, n)
@@ -1786,7 +2045,8 @@ class SegmentMatcher:
         tr = {
             "uuid": "_warmup",
             "trace": [
-                {"lat": float(a), "lon": float(o), "time": 1.0 + 5.0 * i}
+                {"lat": float(a), "lon": float(o),
+                 "time": 1.0 + float(dt) * i}
                 for i, (a, o) in enumerate(zip(lat, lon))
             ],
         }

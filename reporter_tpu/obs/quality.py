@@ -245,8 +245,14 @@ class QualityEngine:
             if self.pace > 0:
                 time.sleep(min(self.pace * (time.monotonic() - t0), 1.0))
 
-    def _oracle_for(self, pkey: tuple):
-        oracle = self._oracles.get(pkey)
+    def _oracle_for(self, pkey: tuple, slabel: str = ""):
+        """The f64 oracle twin for one (params group, sparse cohort).  A
+        sparse-cohort trace was served by the time-adaptive model with
+        that cohort's (possibly calibrated) parameters — the oracle must
+        re-derive the SAME model in f64, or a model improvement would
+        score as a regression (docs/match-quality.md "Sparse gaps")."""
+        key = (pkey, slabel)
+        oracle = self._oracles.get(key)
         if oracle is None:
             import dataclasses
 
@@ -255,11 +261,19 @@ class QualityEngine:
             if len(self._oracles) >= 8:
                 self._oracles.clear()
             cfg = self.matcher.cfg
-            if pkey:
+            sparse = None
+            if slabel:
+                vals = self.matcher.sparse.oracle_values(slabel, pkey)
+                cfg = dataclasses.replace(
+                    cfg, sigma_z=vals["sigma_z"], beta=vals["beta"],
+                    search_radius=vals["search_radius"])
+                sparse = vals
+            elif pkey:
                 cfg = dataclasses.replace(
                     cfg, sigma_z=pkey[0], beta=pkey[1], search_radius=pkey[2])
-            oracle = BruteForceMatcher(self.matcher.arrays, cfg)
-            self._oracles[pkey] = oracle
+            oracle = BruteForceMatcher(self.matcher.arrays, cfg,
+                                       sparse=sparse)
+            self._oracles[key] = oracle
         return oracle
 
     def compare(self, trace: dict, prod_edges: List[int]) -> Optional[float]:
@@ -277,7 +291,11 @@ class QualityEngine:
         times = [float(p["time"]) for p in pts[:n]]
         xs, ys = a.proj.to_xy(lats, lons)
         pkey = self.matcher._params_key(trace)
-        oracle = self._oracle_for(pkey)
+        sm = getattr(self.matcher, "sparse", None)
+        slabel = ""
+        if sm is not None and sm.enabled and self.matcher.backend == "jax":
+            slabel = sm.label_for_times(times) or ""
+        oracle = self._oracle_for(pkey, slabel)
         t0 = time.monotonic()
         oracle_edge, _off, _brk = oracle.match_points(xs, ys, times)
         H_ORACLE_S.observe(time.monotonic() - t0)

@@ -81,6 +81,83 @@ class MatchParams(NamedTuple):
         )
 
 
+class SparseParams(NamedTuple):
+    """Traced scalars of the sparse-gap matching model (docs/match-quality.md
+    "Sparse gaps"; ROADMAP open item 4).  Presence is STATIC: every kernel
+    entry point takes ``sp=None`` by default and the None path is the
+    byte-identical pre-sparse program — the sparse variants live under their
+    own (kind, kernel) jit cache keys, so dense traffic never pays for (or
+    risks) the model.  All values are traced f32, so per-cohort calibrated
+    values (tools/calibrate.py -> CALIBRATION.json) dispatch through ONE
+    compiled program per shape, exactly like per-request MatchParams.
+
+    The model, per point-pair with measurement gap dt seconds:
+
+      * time-adaptive beta — the HMM's route-vs-great-circle tolerance
+        scales with the gap: beta_eff = beta * min(1 + beta_scale *
+        max(0, dt - beta_ref)/beta_ref, beta_max).  At a 5 s gap the route
+        hugs the straight line; at 60 s the vehicle legitimately turned
+        corners, and keeping the dense beta makes the true route lose to
+        geometrically-flattering wrong ones.
+      * drivable-speed plausibility — a transition whose route implies a
+        speed above vmax (m/s) pays plaus_weight * (implied - vmax)/vmax
+        log-prob units: at sparse gaps the time-factor cut alone is loose
+        (max_route_time_factor * dt grows with the gap), and implausibly
+        fast "shortcut" pairings are exactly the decodes the f64 oracle
+        rejects.
+      * gap-conditioned breakage — the fixed breakage_distance is replaced
+        by max(breakage_distance, break_speed * dt): a vehicle at highway
+        speed covers 2 km in under a minute, so the dense 2000 m teleport
+        rule misfires on honest ≥60 s gaps (the restart then truncates the
+        HMM evidence on both sides).
+    """
+
+    beta_ref: jnp.ndarray  # s; gaps at/below leave beta unchanged
+    beta_scale: jnp.ndarray  # growth rate of the beta multiplier
+    beta_max: jnp.ndarray  # cap on the beta multiplier
+    break_speed: jnp.ndarray  # m/s; breakage = max(base, break_speed*dt)
+    vmax: jnp.ndarray  # m/s drivable-speed plausibility knee
+    plaus_weight: jnp.ndarray  # log-prob units per vmax of excess speed
+
+    @classmethod
+    def from_values(cls, beta_ref, beta_scale, beta_max, break_speed, vmax,
+                    plaus_weight) -> "SparseParams":
+        return cls(
+            beta_ref=jnp.float32(beta_ref),
+            beta_scale=jnp.float32(beta_scale),
+            beta_max=jnp.float32(beta_max),
+            break_speed=jnp.float32(break_speed),
+            vmax=jnp.float32(vmax),
+            plaus_weight=jnp.float32(plaus_weight),
+        )
+
+    @classmethod
+    def from_config(cls, cfg) -> "SparseParams":
+        return cls.from_values(
+            getattr(cfg, "sparse_beta_ref_s", 15.0),
+            getattr(cfg, "sparse_beta_scale", 1.0),
+            getattr(cfg, "sparse_beta_max", 8.0),
+            getattr(cfg, "sparse_break_speed_mps", 34.0),
+            getattr(cfg, "sparse_vmax_mps", 45.0),
+            getattr(cfg, "sparse_plaus_weight", 3.0),
+        )
+
+
+def sparse_beta(p: MatchParams, sp: SparseParams, dt):
+    """The time-adaptive beta(dt) family (shared with the f64 oracle's
+    re-derivation in baseline/brute_matcher.py — keep in lock-step)."""
+    mult = 1.0 + sp.beta_scale * jnp.maximum(dt - sp.beta_ref, 0.0) \
+        / jnp.maximum(sp.beta_ref, 1.0)
+    return p.beta * jnp.minimum(mult, sp.beta_max)
+
+
+def sparse_breakage(p: MatchParams, sp: "SparseParams | None", dt):
+    """Gap-conditioned breakage threshold; sp None = the fixed rule."""
+    if sp is None:
+        return p.breakage_distance
+    return jnp.maximum(p.breakage_distance, sp.break_speed * jnp.maximum(dt, 0.0))
+
+
 class MatchResult(NamedTuple):
     cand: Candidates  # [T, K] candidate pool per point
     idx: jnp.ndarray  # [T] i32 chosen candidate slot, -1 = unmatched
@@ -98,7 +175,7 @@ class MatchResult(NamedTuple):
 
 def transition_matrix(dg: DeviceGraph, du: DeviceUBODT, src: Candidates, dst: Candidates,
                       gc: jnp.ndarray, dt: jnp.ndarray, p: MatchParams,
-                      pre=None):
+                      pre=None, sp: "SparseParams | None" = None):
     """[K, K] transition log-probs and route distances for one step.
 
     gc: great-circle (projected straight-line) metres between the two points.
@@ -108,14 +185,18 @@ def transition_matrix(dg: DeviceGraph, du: DeviceUBODT, src: Candidates, dst: Ca
     batched caller (precompute_batch hoists the gathers above the vmap so
     the probe sees the whole dispatch's key set and can dedup it); None =
     self-contained (the seam transition and the per-trace/oracle paths).
+    sp: optional SparseParams — the time-adaptive sparse-gap model
+    (beta(dt) + drivable-speed plausibility); None (static) keeps the
+    byte-identical dense program.
     """
     with stage("transition-build"):
-        return _transition_matrix(dg, du, src, dst, gc, dt, p, pre)
+        return _transition_matrix(dg, du, src, dst, gc, dt, p, pre, sp)
 
 
 def _transition_matrix(dg: DeviceGraph, du: DeviceUBODT, src: Candidates,
                        dst: Candidates, gc: jnp.ndarray, dt: jnp.ndarray,
-                       p: MatchParams, pre=None):
+                       p: MatchParams, pre=None,
+                       sp: "SparseParams | None" = None):
     ea, oa = src.edge, src.offset  # [K]
     eb, ob = dst.edge, dst.offset  # [K]
     if pre is None:
@@ -128,12 +209,12 @@ def _transition_matrix(dg: DeviceGraph, du: DeviceUBODT, src: Candidates,
         erb = dg.edge_rows[safe_eb]
         to_a = jax.lax.bitcast_convert_type(era[:, 0], jnp.int32)
         from_b = jax.lax.bitcast_convert_type(erb[:, 1], jnp.int32)
-        sp, sp_time, _ = ubodt_lookup(du, to_a[:, None], from_b[None, :])
+        sp_dist, sp_time, _ = ubodt_lookup(du, to_a[:, None], from_b[None, :])
     else:
-        era, erb, sp, sp_time = pre
+        era, erb, sp_dist, sp_time = pre
     len_a = era[:, 2]
     remain = (len_a - oa)[:, None]
-    route = remain + sp + ob[None, :]
+    route = remain + sp_dist + ob[None, :]
     # same 0.1 m/s floor as the UBODT builder and CPU oracle: a zero-speed
     # edge must not produce inf/NaN travel times
     speed_a = jnp.maximum(era[:, 3], 0.1)
@@ -163,12 +244,25 @@ def _transition_matrix(dg: DeviceGraph, du: DeviceUBODT, src: Candidates,
     # scaled by max_route_time_factor (meili's max-route-time cut)
     feasible &= (dt <= 0) | (rtime <= p.max_route_time_factor * jnp.maximum(dt, 1.0))
 
-    logp = -jnp.abs(route - gc) / p.beta
+    if sp is None:
+        beta_t = p.beta
+    else:
+        beta_t = sparse_beta(p, sp, dt)
+    logp = -jnp.abs(route - gc) / beta_t
     # turn penalty: scaled by the heading change between leaving the source
     # edge and entering the destination edge (0..pi); factor 0 (the reference
     # default, Dockerfile:45) disables it
     turn = jnp.abs(angle_diff(era[:, 5][:, None], erb[:, 4][None, :]))
-    logp = logp - jnp.where(same_known, 0.0, p.turn_penalty_factor * turn / (jnp.pi * p.beta))
+    logp = logp - jnp.where(same_known, 0.0, p.turn_penalty_factor * turn / (jnp.pi * beta_t))
+    if sp is not None:
+        # drivable-speed plausibility (sparse model): a pairing whose route
+        # implies a speed above vmax is penalised smoothly — the hard
+        # time-factor cut above scales with dt and goes loose exactly where
+        # sparse decodes need discrimination.  dt <= 0 (no measurement gap)
+        # disables it like the time cut.
+        implied = route / jnp.maximum(dt, 1.0)
+        excess = jnp.maximum(implied - sp.vmax, 0.0) / jnp.maximum(sp.vmax, 1.0)
+        logp = logp - jnp.where(dt > 0, sp.plaus_weight * excess, 0.0)
     logp = jnp.where(feasible, logp, NEG_INF)
     return logp, jnp.where(feasible, route, jnp.inf)
 
@@ -230,10 +324,12 @@ class TracePre(NamedTuple):
 
 
 def precompute_trace(dg: DeviceGraph, du: DeviceUBODT, px, py, times, valid,
-                     p: MatchParams, k: int) -> TracePre:
+                     p: MatchParams, k: int,
+                     sp: "SparseParams | None" = None) -> TracePre:
     """The carry-independent stage of match_trace: candidate quadrant sweep,
     emission scores, and the [T-1, K, K] max-plus transition-matrix build.
-    px/py/times/valid: [T].  vmap over batch (precompute_batch_packed)."""
+    px/py/times/valid: [T].  vmap over batch (precompute_batch_packed).
+    ``sp`` (static presence) selects the sparse-gap transition model."""
     cand = find_candidates_batch(dg, px, py, k, p.search_radius)  # [T, K]
 
     with stage("emission"):
@@ -250,14 +346,21 @@ def precompute_trace(dg: DeviceGraph, du: DeviceUBODT, px, py, times, valid,
     # the scan in chain_trace carries only the tiny max-plus recursion.
     src_c = jax.tree_util.tree_map(lambda a: a[:-1], cand)
     dst_c = jax.tree_util.tree_map(lambda a: a[1:], cand)
-    logp_all, route_all = jax.vmap(
-        transition_matrix, in_axes=(None, None, 0, 0, 0, 0, None)
-    )(dg, du, src_c, dst_c, gc, dts, p)  # [T-1, K, K]
+    if sp is None:
+        logp_all, route_all = jax.vmap(
+            transition_matrix, in_axes=(None, None, 0, 0, 0, 0, None)
+        )(dg, du, src_c, dst_c, gc, dts, p)  # [T-1, K, K]
+    else:
+        logp_all, route_all = jax.vmap(
+            transition_matrix, in_axes=(None, None, 0, 0, 0, 0, None, None,
+                                        None)
+        )(dg, du, src_c, dst_c, gc, dts, p, None, sp)
     return TracePre(cand=cand, emis=emis, logp=logp_all, route=route_all, gc=gc)
 
 
 def precompute_batch(dg: DeviceGraph, du: DeviceUBODT, px, py, times, valid,
-                     p: MatchParams, k: int, dedup: bool = False) -> TracePre:
+                     p: MatchParams, k: int, dedup: bool = False,
+                     sp: "SparseParams | None" = None) -> TracePre:
     """Batched precompute: [B, T] leaves -> TracePre with leading [B].
 
     Identical math (bit-identical results) to vmapping precompute_trace,
@@ -292,22 +395,31 @@ def precompute_batch(dg: DeviceGraph, du: DeviceUBODT, px, py, times, valid,
         era, erb = er[:, :-1], er[:, 1:]  # [B, T-1, K, 8]
         to_a = jax.lax.bitcast_convert_type(era[..., 0], jnp.int32)
         from_b = jax.lax.bitcast_convert_type(erb[..., 1], jnp.int32)
-    sp, sp_time, _ = ubodt_lookup(
+    sp_dist, sp_time, _ = ubodt_lookup(
         du, to_a[..., :, None], from_b[..., None, :], dedup=dedup
     )  # [B, T-1, K, K]
 
     src_c = jax.tree_util.tree_map(lambda a: a[:, :-1], cand)
     dst_c = jax.tree_util.tree_map(lambda a: a[:, 1:], cand)
-    step_axes = (None, None, 0, 0, 0, 0, None, 0)
-    tm = jax.vmap(jax.vmap(transition_matrix, in_axes=step_axes),
-                  in_axes=step_axes)
-    logp_all, route_all = tm(
-        dg, du, src_c, dst_c, gc, dts, p, (era, erb, sp, sp_time))
+    if sp is None:
+        step_axes = (None, None, 0, 0, 0, 0, None, 0)
+        tm = jax.vmap(jax.vmap(transition_matrix, in_axes=step_axes),
+                      in_axes=step_axes)
+        logp_all, route_all = tm(
+            dg, du, src_c, dst_c, gc, dts, p, (era, erb, sp_dist, sp_time))
+    else:
+        step_axes = (None, None, 0, 0, 0, 0, None, 0, None)
+        tm = jax.vmap(jax.vmap(transition_matrix, in_axes=step_axes),
+                      in_axes=step_axes)
+        logp_all, route_all = tm(
+            dg, du, src_c, dst_c, gc, dts, p, (era, erb, sp_dist, sp_time),
+            sp)
     return TracePre(cand=cand, emis=emis, logp=logp_all, route=route_all, gc=gc)
 
 
 def match_trace(dg: DeviceGraph, du: DeviceUBODT, px, py, times, valid, p: MatchParams, k: int,
-                carry: "TraceCarry | None" = None, kernel: str = "scan"):
+                carry: "TraceCarry | None" = None, kernel: str = "scan",
+                sp: "SparseParams | None" = None):
     """Match one trace of T (padded) points.  px/py/times/valid: [T].
     vmap over batch.  With ``carry`` (static presence), the first step
     transitions from the carried candidate beam instead of restarting, and
@@ -327,13 +439,15 @@ def match_trace(dg: DeviceGraph, du: DeviceUBODT, px, py, times, valid, p: Match
     (carry-dependent) — the long-trace path dispatches the two stages as
     separate programs so the precompute batches across chunks; fused here,
     XLA sees the exact same ops for the bucketed path."""
-    pre = precompute_trace(dg, du, px, py, times, valid, p, k)
-    return chain_trace(dg, du, pre, px, py, times, valid, p, k, carry, kernel)
+    pre = precompute_trace(dg, du, px, py, times, valid, p, k, sp)
+    return chain_trace(dg, du, pre, px, py, times, valid, p, k, carry, kernel,
+                       sp)
 
 
 def chain_trace(dg: DeviceGraph, du: DeviceUBODT, pre: TracePre, px, py, times,
                 valid, p: MatchParams, k: int,
-                carry: "TraceCarry | None" = None, kernel: str = "scan"):
+                carry: "TraceCarry | None" = None, kernel: str = "scan",
+                sp: "SparseParams | None" = None):
     """The carry-dependent stage of match_trace: seam transition from the
     carried beam (one [K, K] transition_matrix call — ~1/T of the hoisted
     transition work), score recursion, backtrace, and carry-out.  Consumes
@@ -341,16 +455,24 @@ def chain_trace(dg: DeviceGraph, du: DeviceUBODT, pre: TracePre, px, py, times,
     construction (it IS the tail of that function)."""
     T = px.shape[0]
     cand, emis, logp_all, route_all, gc = pre
+    # gap-conditioned breakage (sparse model): each step's teleport
+    # threshold scales with its measurement gap.  With sp None the
+    # per-step threshold is the traced scalar and the step function below
+    # closes over it exactly as before — the dense program is untouched.
+    brk_thresh = None
+    if sp is not None:
+        brk_thresh = sparse_breakage(p, sp, times[1:] - times[:-1])  # [T-1]
 
     def step(scores, inputs):
         """scores: [K] running viterbi scores.  One timestep t (1..T-1)."""
-        logp, route, emis_t, gc_t, valid_t = inputs
+        logp, route, emis_t, gc_t, valid_t = inputs[:5]
+        brk_t = p.breakage_distance if sp is None else inputs[5]
         total = scores[:, None] + logp  # [K src, K dst]
         best_src = jnp.argmax(total, axis=0)  # [K]
         best_val = jnp.max(total, axis=0)
         connected = best_val > NEG_INF / 2
         # breakage: too far apart, or nothing connects
-        broke = (gc_t > p.breakage_distance) | ~jnp.any(connected)
+        broke = (gc_t > brk_t) | ~jnp.any(connected)
         new_scores = jnp.where(broke, emis_t, best_val + emis_t)
         new_scores = jnp.where(valid_t, new_scores, scores)  # padding: freeze
         backptr = jnp.where(broke | ~connected, -1, best_src)
@@ -372,12 +494,14 @@ def chain_trace(dg: DeviceGraph, du: DeviceUBODT, pre: TracePre, px, py, times,
         dst_c = jax.tree_util.tree_map(lambda a: a[0], cand)
         gc0 = jnp.hypot(px[0] - carry.x, py[0] - carry.y)
         dt0 = times[0] - carry.t
-        logp0, route0 = transition_matrix(dg, du, src_c, dst_c, gc0, dt0, p)
+        logp0, route0 = transition_matrix(dg, du, src_c, dst_c, gc0, dt0, p,
+                                          sp=sp)
+        brk0 = sparse_breakage(p, sp, dt0)
         total0 = carry.scores[:, None] + logp0  # [K src, K dst]
         best_src0 = jnp.argmax(total0, axis=0)
         best_val0 = jnp.max(total0, axis=0)
         connected0 = best_val0 > NEG_INF / 2
-        broke0 = (gc0 > p.breakage_distance) | ~jnp.any(connected0) | ~carry.active
+        broke0 = (gc0 > brk0) | ~jnp.any(connected0) | ~carry.active
         init_scores = jnp.where(broke0, emis[0], best_val0 + emis[0])
         first_break = broke0
         first_route = jnp.where(
@@ -387,9 +511,12 @@ def chain_trace(dg: DeviceGraph, du: DeviceUBODT, pre: TracePre, px, py, times,
     if kernel == "assoc" and T >= 2:
         with stage("assoc-recursion"):
             all_scores, all_backptr, all_broke, all_route = _forward_assoc(
-                init_scores, logp_all, route_all, emis, gc, valid, p)
+                init_scores, logp_all, route_all, emis, gc, valid, p,
+                brk_thresh)
     elif kernel in ("scan", "assoc"):  # assoc degenerates to scan at T < 2
         xs = (logp_all, route_all, emis[1:], gc, valid[1:])
+        if sp is not None:
+            xs = xs + (brk_thresh,)
         with stage("scan-recursion"):
             _, (all_scores, all_backptr, all_broke, all_route) = jax.lax.scan(step, init_scores, xs)
     else:
@@ -544,17 +671,20 @@ def backtrace(scores_mat: jnp.ndarray, backptr: jnp.ndarray, valid: jnp.ndarray)
 # whenever the prefix scores agree.
 
 
-def _forward_assoc(init_scores, logp_all, route_all, emis, gc, valid, p: MatchParams):
+def _forward_assoc(init_scores, logp_all, route_all, emis, gc, valid, p: MatchParams,
+                   brk_thresh=None):
     """Log-depth equivalent of the lax.scan forward in match_trace.
     init_scores [K]; logp_all/route_all [T-1, K, K]; emis [T, K]; gc [T-1];
     valid [T].  Returns (all_scores, all_backptr, all_broke, all_route),
     each with leading [T-1], exactly like the sequential scan's stacked
-    outputs."""
+    outputs.  ``brk_thresh`` ([T-1], static presence): the sparse model's
+    gap-conditioned per-step breakage thresholds; None = the fixed rule."""
     k = emis.shape[1]
     valid_t = valid[1:]  # [T-1]
     feasible = logp_all > NEG_INF / 2  # [T-1, K, K]
     emis_alive = emis > NEG_INF / 2  # [T, K]
-    hard = gc > p.breakage_distance  # [T-1]
+    hard = gc > (p.breakage_distance if brk_thresh is None
+                 else brk_thresh)  # [T-1]
 
     # (1) alive-support recursion -> exact break flags.  Sequential, but the
     # carried state is [K] booleans and the per-step op a mask product — the
@@ -641,16 +771,19 @@ def backtrace_assoc(scores_mat: jnp.ndarray, backptr: jnp.ndarray, valid: jnp.nd
 
 
 def match_batch(dg: DeviceGraph, du: DeviceUBODT, px, py, times, valid, p: MatchParams, k: int,
-                kernel: str = "scan", dedup: bool = False) -> MatchResult:
+                kernel: str = "scan", dedup: bool = False,
+                sp: "SparseParams | None" = None) -> MatchResult:
     """px/py/times/valid: [B, T] -> MatchResult leaves with leading [B].
 
     precompute_batch (hoisted gathers, optional in-batch probe dedup) +
     the vmapped carry-free chain — the same composition match_trace fuses
-    per trace, with the gather-bound stage at batch level."""
+    per trace, with the gather-bound stage at batch level.  ``sp`` (static
+    presence) selects the sparse-gap model; its traced scalars are shared
+    across the batch like MatchParams."""
     import functools
 
-    pre = precompute_batch(dg, du, px, py, times, valid, p, k, dedup)
-    fn = functools.partial(chain_trace, kernel=kernel)
+    pre = precompute_batch(dg, du, px, py, times, valid, p, k, dedup, sp)
+    fn = functools.partial(chain_trace, kernel=kernel, sp=sp)
     return jax.vmap(fn, in_axes=(None, None, 0, 0, 0, 0, 0, None, None))(
         dg, du, pre, px, py, times, valid, p, k
     )
@@ -671,9 +804,10 @@ class CompactMatch(NamedTuple):
 
 
 def match_batch_compact(dg: DeviceGraph, du: DeviceUBODT, px, py, times, valid, p: MatchParams, k: int,
-                        kernel: str = "scan", dedup: bool = False) -> CompactMatch:
+                        kernel: str = "scan", dedup: bool = False,
+                        sp: "SparseParams | None" = None) -> CompactMatch:
     """match_batch + on-device gather of the chosen candidate per point."""
-    res = match_batch(dg, du, px, py, times, valid, p, k, kernel, dedup)
+    res = match_batch(dg, du, px, py, times, valid, p, k, kernel, dedup, sp)
     return _compact(res)
 
 
@@ -858,6 +992,79 @@ def session_step_packed(dg: DeviceGraph, du: DeviceUBODT, xin,
 
     px, py, times, valid = unpack_inputs(xin)
     fn = functools.partial(match_trace, kernel=kernel)
+    res, carry_out = jax.vmap(
+        fn, in_axes=(None, None, 0, 0, 0, 0, None, None, 0)
+    )(dg, du, px, py, times, valid, p, k, carry)
+    return pack_compact(_compact(res)), res.aux, carry_out
+
+
+# -- sparse-gap packed entry points -------------------------------------------
+#
+# The sparse-gap matching model (docs/match-quality.md "Sparse gaps") rides
+# its own packed entry points so the serving matcher caches them under
+# distinct (kind, kernel) jit keys: dense traffic keeps dispatching the
+# byte-identical classic programs, while sparse cohorts pay one extra
+# compile per shape for the time-adaptive variants.  SparseParams (and the
+# per-cohort MatchParams they ride next to) are traced scalars, so every
+# calibrated cohort shares ONE compiled program per shape.  All sparse
+# entries return the confidence aux block — sparse decodes are the
+# ambiguity-sensitive ones, and the calibration plane scores them.
+
+
+def match_batch_compact_packed_sparse(dg: DeviceGraph, du: DeviceUBODT, xin,
+                                      p: MatchParams, sp: SparseParams,
+                                      k: int, kernel: str = "scan",
+                                      dedup: bool = False):
+    """The sparse-cohort twin of match_batch_compact_packed_aux: packed
+    [4, B, T] in -> (packed [3, B, T], aux [B, 4]), with the time-adaptive
+    transition model and gap-conditioned breakage applied."""
+    px, py, times, valid = unpack_inputs(xin)
+    cm = match_batch_compact(dg, du, px, py, times, valid, p, k, kernel,
+                             dedup, sp)
+    return pack_compact(cm), cm.aux
+
+
+def precompute_batch_packed_sparse(dg: DeviceGraph, du: DeviceUBODT, xin,
+                                   p: MatchParams, sp: SparseParams, k: int,
+                                   dedup: bool = False) -> TracePre:
+    """precompute_batch_packed under the sparse transition model — the
+    long-trace chunk-batched precompute for sparse cohorts."""
+    px, py, times, valid = unpack_inputs(xin)
+    return precompute_batch(dg, du, px, py, times, valid, p, k, dedup, sp)
+
+
+def chain_batch_carry_packed_sparse(dg: DeviceGraph, du: DeviceUBODT,
+                                    pre: TracePre, xin, p: MatchParams,
+                                    sp: SparseParams, k: int,
+                                    carry: TraceCarry, kernel: str = "scan"):
+    """chain_batch_carry_packed_aux under the sparse model: the seam
+    transition and per-step breakage are gap-conditioned.  Returns
+    (packed [3, B, T], aux [B, 4], carry')."""
+    import functools
+
+    px, py, times, valid = unpack_inputs(xin)
+    fn = functools.partial(chain_trace, kernel=kernel, sp=sp)
+    res, carry_out = jax.vmap(
+        fn, in_axes=(None, None, 0, 0, 0, 0, 0, None, None, 0)
+    )(dg, du, pre, px, py, times, valid, p, k, carry)
+    return pack_compact(_compact(res)), res.aux, carry_out
+
+
+def session_step_packed_sparse(dg: DeviceGraph, du: DeviceUBODT, xin,
+                               p: MatchParams, sp: SparseParams, k: int,
+                               carry: TraceCarry, kernel: str = "scan"):
+    """session_step_packed under the sparse model: the per-vehicle
+    incremental step at the reference BatchingProcessor's sparse operating
+    point (≥ 45 s between points IS the streaming regime).  K stays the
+    carried beam width — a session's beam cannot change width mid-life —
+    so of the sparse levers, sessions get the time-adaptive transitions,
+    the gap-conditioned breakage, and the widened radius, while the wider
+    candidate budget applies to windowed dispatches only
+    (docs/match-quality.md)."""
+    import functools
+
+    px, py, times, valid = unpack_inputs(xin)
+    fn = functools.partial(match_trace, kernel=kernel, sp=sp)
     res, carry_out = jax.vmap(
         fn, in_axes=(None, None, 0, 0, 0, 0, None, None, 0)
     )(dg, du, px, py, times, valid, p, k, carry)

@@ -39,6 +39,15 @@ def main(argv):
     # retain ambiguous decodes (docs/match-quality.md).  An explicit
     # REPORTER_QUALITY_AUX=0 still disables.
     os.environ.setdefault("REPORTER_QUALITY_AUX", "1")
+    # the sparse-gap matching model defaults ON for the serving entrypoint
+    # (docs/match-quality.md "Sparse gaps"): traces at the reference
+    # BatchingProcessor's ≥45 s operating point dispatch through the
+    # time-adaptive program variants (calibrated per cohort when
+    # $REPORTER_CALIBRATION points at a CALIBRATION.json).  Library
+    # callers and the bit-exact differential suites keep the config
+    # default of off; an explicit REPORTER_SPARSE=0 reverts the serving
+    # path bit-for-bit to the dense model.
+    os.environ.setdefault("REPORTER_SPARSE", "1")
     # conf path: positional arg, else $MATCHER_CONF_FILE — the reference's
     # container default (README.md Env Var Overrides: MATCHER_CONF_FILE).
     # With the env set, the single positional may be the bind address.

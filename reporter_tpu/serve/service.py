@@ -1163,6 +1163,14 @@ class ReporterService:
                 return ("match_options.shape_match %r is not supported "
                         "(this matcher map-snaps; use \"map_snap\" or omit "
                         "the key)" % (sm,)), None, None
+            # route-consistent interpolation opt-in/out (docs/http-api.md:
+            # speed-weighted boundary times over the full UBODT path
+            # segment sequence, matching/sparse.py); booleans only so a
+            # typo'd string cannot silently pick a default
+            ip = mo.get("interpolate")
+            if ip is not None and not isinstance(ip, bool):
+                return ("match_options.interpolate must be a boolean"
+                        ), None, None
         return None, rl, tl
 
     def handle_report(self, trace: dict, debug: bool = False,
@@ -1624,6 +1632,13 @@ class ReporterService:
             # (None until a quality engine is configured)
             "quality": (self.quality.summary()
                         if self.quality is not None else None),
+            # the sparse-gap matching model (docs/match-quality.md
+            # "Sparse gaps"): enabled + calibration provenance; None
+            # until the engine attaches
+            "sparse": (m.sparse.summary()
+                       if m is not None
+                       and getattr(m, "sparse", None) is not None
+                       else None),
             # the session plane: open per-vehicle sessions + folded points
             "sessions": (self.session_store.summary()
                          if self.session_store is not None else None),

@@ -71,13 +71,21 @@ class TraceSynthesizer:
 
     # -- walking ----------------------------------------------------------
 
-    def walk(self, edges: List[int], dt: float, t0: float = 0.0):
+    def walk(self, edges: List[int], dt: float, t0: float = 0.0,
+             dt_jitter: float = 0.0):
         """Sample positions every dt seconds while driving the edge path at
-        edge speed.  Returns (xy [T,2], times [T], edge_ids [T])."""
+        edge speed.  Returns (xy [T,2], times [T], edge_ids [T]).
+
+        ``dt_jitter``: per-sample gap noise as a fraction of dt — each
+        inter-sample gap is drawn uniform from [dt*(1-j), dt*(1+j)], so a
+        "60 s" fleet stops being suspiciously metronomic (loadgen
+        --gap-jitter).  0 draws NOTHING from the rng: existing seeded
+        corpora stay bit-identical."""
         a = self.arrays
         xs, ts, eids = [], [], []
         t = t0
         next_sample = t0
+        j = max(0.0, min(float(dt_jitter), 0.9))
         for e in edges:
             length = float(a.edge_len[e])
             speed = max(float(a.edge_speed[e]), 0.1)
@@ -89,7 +97,11 @@ class TraceSynthesizer:
                 xs.append((x0 + f * (x1 - x0), y0 + f * (y1 - y0)))
                 ts.append(next_sample)
                 eids.append(e)
-                next_sample += dt
+                if j > 0.0:
+                    next_sample += dt * float(
+                        self.rng.uniform(1.0 - j, 1.0 + j))
+                else:
+                    next_sample += dt
             t += edge_t
         return np.asarray(xs), np.asarray(ts), np.asarray(eids, np.int64)
 
@@ -106,8 +118,11 @@ class TraceSynthesizer:
         report_levels=(0, 1, 2),
         transition_levels=(0, 1, 2),
         max_tries: int = 20,
+        dt_jitter: float = 0.0,
     ) -> SyntheticTrace:
-        """A trace of exactly n_points samples along a random route."""
+        """A trace of exactly n_points samples along a random route.
+        ``dt_jitter`` adds per-point gap noise (see walk); 0 keeps seeded
+        corpora bit-identical."""
         a = self.arrays
         # chain random destinations until the drive is long enough: small
         # networks have no single route of arbitrary duration
@@ -140,7 +155,8 @@ class TraceSynthesizer:
             consecutive_fails = 0
             edges.extend(leg)
             cur = dst
-        xy, ts, eids = self.walk(edges, dt, t0=0.0) if edges else (np.zeros((0, 2)), np.zeros(0), np.zeros(0, np.int64))
+        xy, ts, eids = self.walk(edges, dt, t0=0.0, dt_jitter=dt_jitter) \
+            if edges else (np.zeros((0, 2)), np.zeros(0), np.zeros(0, np.int64))
         if len(xy) < n_points:
             raise RuntimeError("could not draw a route long enough for %d points" % n_points)
 

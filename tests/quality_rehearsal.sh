@@ -18,8 +18,23 @@
 #      objective must be VIOLATING + alerting and the SAME quality gate
 #      must FAIL.
 #
+#   3. uncalibrated-params control (the sparse-model leg's counterweight,
+#      docs/match-quality.md "Sparse gaps"): the SAME load against a
+#      server with REPORTER_SPARSE=0 — the pre-sparse dense model.  The
+#      committed baseline encodes the CALIBRATED sparse accuracy on the
+#      45/60/90 s cohorts, so this leg's gate run must FAIL: if it ever
+#      passes, the baseline has stopped enforcing the recovered accuracy
+#      and regenerating it was dishonest.
+#
+# Leg 1's corpus includes the sparse fleets (--gap-s 45,60 and
+# --gap-s 45,60,90 with --gap-jitter) served by the CALIBRATED sparse
+# model (REPORTER_SPARSE defaults on in serve; REPORTER_CALIBRATION
+# points at the committed CALIBRATION.json).
+#
 # Baseline refresh: QUALITY_BASELINE_OUT=<path> writes leg 1's snapshot
 # instead of judging it (commit the result as QUALITY_BASELINE.json).
+# Regenerate CALIBRATION.json first (tools/calibrate.py) so the baseline
+# records calibrated accuracy — never hand-edit either file.
 #
 # Usage: tests/quality_rehearsal.sh [workdir]
 set -euo pipefail
@@ -27,11 +42,15 @@ set -euo pipefail
 cd "$(dirname "$0")/.."
 export PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}"
 export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+# the pinned per-cohort sparse calibration (tools/calibrate.py); serve
+# boots with the sparse model on by default and loads this table
+export REPORTER_CALIBRATION="${REPORTER_CALIBRATION:-$PWD/CALIBRATION.json}"
 
 WORK="${1:-$(mktemp -d /tmp/reporter-quality.XXXXXX)}"
 mkdir -p "$WORK"
 PORT=18071
 PORT2=18072
+PORT3=18073
 echo "quality rehearsal workdir: $WORK"
 
 PIDS=()
@@ -77,6 +96,14 @@ DENSE_ARGS=(--rate 12 --duration 5 --vehicles 10 --points 32 --window 16
 SPARSE_ARGS=(--rate 12 --duration 5 --vehicles 10 --points 32 --window 16
              --grid 8 --seed 11 --gap-s 45,60 --concurrency 16 --timeout-s 8
              --slo-availability 0.95 --slo-p99-ms 8000)
+# the calibrated-sparse-model leg's corpus: the full sparse operating
+# band incl. 90 s windows, with per-point gap jitter so the cohort
+# boundaries are exercised by non-metronomic gaps (the artifact records
+# the realized histogram)
+SPARSE90_ARGS=(--rate 12 --duration 5 --vehicles 12 --points 32 --window 16
+               --grid 8 --seed 13 --gap-s 45,60,90 --gap-jitter 0.2
+               --concurrency 16 --timeout-s 8
+               --slo-availability 0.95 --slo-p99-ms 8000)
 
 wait_up() {
     local port=$1 tries=$2
@@ -125,6 +152,9 @@ run_legs() {
     python tools/loadgen.py --url "http://127.0.0.1:$port" \
         "${SPARSE_ARGS[@]}" --server-slo \
         --out "$WORK/loadgen_sparse_$tag.json"
+    python tools/loadgen.py --url "http://127.0.0.1:$port" \
+        "${SPARSE90_ARGS[@]}" --server-slo \
+        --out "$WORK/loadgen_sparse90_$tag.json"
 }
 
 # ---- leg 1: no fault — the gate must pass --------------------------------
@@ -170,12 +200,36 @@ assert agr[0]["value"] is not None
 cohorts = slo["quality"]["cohorts"]
 sparse = [k for k in cohorts if "gap=45-60" in k or "gap=ge60" in k]
 assert sparse, "no sparse-gap cohort sampled: %s" % list(cohorts)
-for lg in ("loadgen_dense_nofault", "loadgen_sparse_nofault"):
+for lg in ("loadgen_dense_nofault", "loadgen_sparse_nofault",
+           "loadgen_sparse90_nofault"):
     art = json.load(open("$WORK/%s.json" % lg))
     assert art["slo"]["agree"] is True, lg
     assert art["slo"]["server_quality"] is not None, lg
+# the jittered sparse corpus proves its spread: the artifact's realized
+# gap histogram must be non-degenerate and sparse-dominated
+art = json.load(open("$WORK/loadgen_sparse90_nofault.json"))
+h = art["gap_histogram"]
+assert h and h["count"] > 0, h
+assert h["max_s"] > h["min_s"], "gap jitter produced uniform gaps: %s" % h
+sparse_pts = h["buckets"]["45-60"] + h["buckets"]["ge60"] + h["buckets"]["30-45"]
+assert sparse_pts > h["count"] // 2, h
 print("agreement %.4f ok; sparse cohorts sampled: %s"
       % (agr[0]["value"], sparse))
+print("sparse90 realized gaps: %s" % h)
+EOF
+
+python - <<EOF
+# the sparse model itself is live and CALIBRATED on the serving path
+# (statusz sparse block + the reporter_sparse_calibrated gauge)
+import json, urllib.request
+st = json.load(urllib.request.urlopen(
+    "http://127.0.0.1:$PORT/statusz", timeout=5))
+sp = st.get("sparse") or {}
+assert sp.get("enabled") is True, sp
+assert sp.get("calibrated") is True, (
+    "sparse model running UNCALIBRATED params — is REPORTER_CALIBRATION "
+    "pointing at CALIBRATION.json? %s" % sp)
+print("sparse model: enabled + calibrated (%s)" % sp.get("calibration"))
 EOF
 
 kill "$SERVE_PID" 2>/dev/null || true
@@ -233,5 +287,52 @@ print("skew leg: serving green, agreement %.4f violating+alerting, "
       "gate rc 1 — the quality plane catches what the serving plane "
       "cannot" % agr["value"])
 EOF
+
+kill "$SERVE_PID" 2>/dev/null || true
+wait "$SERVE_PID" 2>/dev/null || true
+
+# ---- leg 3: uncalibrated-params control — the gate must FAIL -------------
+# REPORTER_SPARSE=0 serves the pre-sparse dense model over the same
+# corpora.  The committed baseline records the CALIBRATED sparse accuracy
+# at the 45/60/90 s cohorts, so judging the dense model against it must
+# regress: this is the leg that proves the regenerated baseline actually
+# enforces the recovered accuracy (a baseline lenient enough to bless the
+# old model would pass here — and fail the rehearsal).
+echo "== leg 3: REPORTER_SPARSE=0 control (uncalibrated params, gate must FAIL) =="
+REPORTER_SPARSE=0 \
+python -m reporter_tpu.serve --warmup "$WORK/config.json" "127.0.0.1:$PORT3" \
+    > "$WORK/serve_control.log" 2>&1 &
+SERVE_PID=$!
+PIDS+=("$SERVE_PID")
+if ! wait_up "$PORT3" 240; then
+    echo "FAIL: control-leg service never came up; tail of serve log:"
+    tail -20 "$WORK/serve_control.log"
+    exit 1
+fi
+
+python tools/loadgen.py --url "http://127.0.0.1:$PORT3" \
+    "${DENSE_ARGS[@]}" --out "$WORK/loadgen_dense_control.json"
+python tools/loadgen.py --url "http://127.0.0.1:$PORT3" \
+    "${SPARSE_ARGS[@]}" --out "$WORK/loadgen_sparse_control.json"
+python tools/loadgen.py --url "http://127.0.0.1:$PORT3" \
+    "${SPARSE90_ARGS[@]}" --out "$WORK/loadgen_sparse90_control.json"
+drain_quality "$PORT3"
+mv "$WORK/slo_snapshot.json" "$WORK/slo_control.json"
+
+set +e
+python tools/quality_gate.py QUALITY_BASELINE.json \
+    --fresh "$WORK/slo_control.json" \
+    > "$WORK/quality_gate_control.json"
+CONTROL_RC=$?
+set -e
+if [ "$CONTROL_RC" -ne 1 ]; then
+    echo "FAIL: quality gate rc $CONTROL_RC on the REPORTER_SPARSE=0"
+    echo "control (want 1): the baseline no longer enforces the"
+    echo "calibrated sparse accuracy"
+    cat "$WORK/quality_gate_control.json"
+    exit 1
+fi
+echo "control leg: dense model FAILS the calibrated baseline (rc 1) — the"
+echo "gate enforces the recovered sparse accuracy"
 
 echo "quality rehearsal OK (artifacts in $WORK)"

@@ -71,7 +71,8 @@ MATCH_OPTIONS = {"mode": "auto", "report_levels": [0, 1],
 
 def synth_sessions(vehicles: int, points: int, window: int, grid: int,
                    seed: int,
-                   gaps: Optional[List[float]] = None) -> List[Tuple[str, List[dict]]]:
+                   gaps: Optional[List[float]] = None,
+                   gap_jitter: float = 0.0) -> List[Tuple[str, List[dict]]]:
     """Per-vehicle sessions from the in-repo synthesizer (numpy only — no
     accelerator): each vehicle is one route walk, windowed into
     ``window``-point /report bodies in drive order.  ``gaps`` (seconds)
@@ -79,7 +80,10 @@ def synth_sessions(vehicles: int, points: int, window: int, grid: int,
     ``--gap-s 45,60`` synthesizes a fleet at the reference
     BatchingProcessor's sparse operating point, the cohort whose
     agreement cliff ROADMAP open item 4 chases (the quality plane labels
-    its shadow samples by exactly these gap buckets)."""
+    its shadow samples by exactly these gap buckets).  ``gap_jitter``
+    (fraction of the gap, --gap-jitter) draws each inter-point gap from
+    [dt*(1-j), dt*(1+j)] so sparse corpora stop being suspiciously
+    metronomic; 0 keeps the seeded corpus bit-identical to before."""
     from reporter_tpu.synth import TraceSynthesizer
     from reporter_tpu.tiles.arrays import build_graph_arrays
     from reporter_tpu.tiles.network import grid_city
@@ -96,7 +100,8 @@ def synth_sessions(vehicles: int, points: int, window: int, grid: int,
         # a small grid can still stitch enough legs together
         s = synth.synthesize(points, dt=dt, sigma=5.0,
                              uuid="loadgen-veh-%04d" % i,
-                             max_tries=max(20, int(points * dt / 10.0)))
+                             max_tries=max(20, int(points * dt / 10.0)),
+                             dt_jitter=gap_jitter)
         uuid = "loadgen-veh-%04d" % i
         pts = s.trace["trace"]
         reqs = []
@@ -109,6 +114,41 @@ def synth_sessions(vehicles: int, points: int, window: int, grid: int,
         if reqs:
             sessions.append((uuid, reqs))
     return sessions
+
+
+def realized_gaps(sessions) -> Optional[dict]:
+    """The corpus's ACTUAL inter-point gap distribution — bucketed on the
+    quality plane's gap-cohort boundaries plus min/median/max — recorded
+    in the artifact so a \"sparse\" run proves its sparseness (and a
+    --gap-jitter run its spread) instead of asserting it."""
+    gaps: List[float] = []
+    for _uuid, reqs in sessions:
+        times: List[float] = []
+        for r in reqs:
+            times.extend(float(p["time"]) for p in r.get("trace", ()))
+        gaps.extend(b - a for a, b in zip(times, times[1:]) if b > a)
+    if not gaps:
+        return None
+    arr = sorted(gaps)
+    buckets = {"lt15": 0, "15-30": 0, "30-45": 0, "45-60": 0, "ge60": 0}
+    for g in arr:
+        if g < 15:
+            buckets["lt15"] += 1
+        elif g < 30:
+            buckets["15-30"] += 1
+        elif g < 45:
+            buckets["30-45"] += 1
+        elif g < 60:
+            buckets["45-60"] += 1
+        else:
+            buckets["ge60"] += 1
+    return {
+        "count": len(arr),
+        "min_s": round(arr[0], 2),
+        "median_s": round(arr[len(arr) // 2], 2),
+        "max_s": round(arr[-1], 2),
+        "buckets": buckets,
+    }
 
 
 def archive_sessions(src: str, sep: str, uuid_col: int, time_col: int,
@@ -603,6 +643,13 @@ def main(argv=None) -> int:
                          "seconds, cycled per vehicle (e.g. 45,60 — the "
                          "reference BatchingProcessor operating point; "
                          "default: dense 5 s sampling)")
+    ap.add_argument("--gap-jitter", type=float, default=0.0,
+                    help="per-point gap noise as a fraction of --gap-s: "
+                         "each gap draws uniform from [g*(1-j), g*(1+j)] "
+                         "so sparse corpora stop being suspiciously "
+                         "uniform; the artifact records the realized gap "
+                         "histogram either way (0 = off, bit-identical "
+                         "seeded corpus)")
     # streaming session scenario (docs/performance.md "The session
     # matcher"): open-loop per-POINT sends on uuid-affine sessions, each
     # point's latency against its own scheduled arrival
@@ -669,9 +716,11 @@ def main(argv=None) -> int:
                 args.archive, args.sep, args.uuid_col, args.time_col,
                 args.lat_col, args.lon_col, args.window, args.limit)
         else:
+            if not (0.0 <= args.gap_jitter < 1.0):
+                ap.error("--gap-jitter wants a fraction in [0, 1)")
             sessions = synth_sessions(args.vehicles, args.points,
                                       args.window, args.grid, args.seed,
-                                      gaps=gaps)
+                                      gaps=gaps, gap_jitter=args.gap_jitter)
     except Exception as e:  # noqa: BLE001 - setup failure is rc 2
         sys.stderr.write("loadgen: corpus build failed: %s\n" % (e,))
         return 2
@@ -845,6 +894,8 @@ def main(argv=None) -> int:
                     "points_dropped_tail": stream_dropped}
                    if args.stream else None),
         "gap_s": gaps,
+        "gap_jitter": args.gap_jitter or None,
+        "gap_histogram": realized_gaps(sessions),
         "time_warp": args.time_warp or None,
         "profile": args.profile,
         "skew": args.skew,

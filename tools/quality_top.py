@@ -90,6 +90,32 @@ def render(metrics: dict) -> str:
         out.append("%-10s %-7s %-6s %-6s %-7s %-8s %10.4f%s"
                    % (rid[:10], gap, ln, kern, layout, params, v, flag))
 
+    # per-gap-bucket roll-up: the sparse cohorts (the reference
+    # BatchingProcessor operating point) at a glance, whatever the
+    # len/kernel/layout split — mean of the cohort window means
+    by_gap: Dict[str, List[float]] = {}
+    for (_rid, gap, _ln, _kern, _layout, _params), v in rows:
+        by_gap.setdefault(gap, []).append(v)
+    if by_gap:
+        out.append("")
+        out.append("%-7s %10s %8s" % ("gap", "agreement", "cohorts"))
+        order = {"lt15": 0, "15-30": 1, "30-45": 2, "45-60": 3, "ge60": 4}
+        for gap in sorted(by_gap, key=lambda g: order.get(g, 9)):
+            vs = by_gap[gap]
+            mean_v = sum(vs) / len(vs)
+            flag = "  <-- LOW" if mean_v < 0.9 else ""
+            out.append("%-7s %10.4f %8d%s" % (gap, mean_v, len(vs), flag))
+
+    # sparse-model params indicator (reporter_sparse_calibrated:
+    # 1 = CALIBRATION.json cohort params live, 0 = enabled on
+    # uncalibrated config defaults, -1/absent = model off)
+    cal = _scalar(metrics, "reporter_sparse_calibrated")
+    if cal is not None:
+        state = ("CALIBRATED (CALIBRATION.json)" if cal >= 1 else
+                 "default params (UNCALIBRATED)" if cal >= 0 else
+                 "disabled")
+        out.append("sparse model: %s" % state)
+
     agree = _scalar(metrics, "reporter_quality_points_total",
                     {"verdict": "agree"}) or 0.0
     disagree = _scalar(metrics, "reporter_quality_points_total",
