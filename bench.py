@@ -201,6 +201,38 @@ def _write_status(**kw):
         pass
 
 
+def _cost_block(pps: float, chips: int) -> dict:
+    """The bench line's cost block (docs/economics.md): the configured
+    chip price (REPORTER_COST_PER_CHIP_HOUR > config > default) folded
+    to $-per-million-matched-points at this run's sustained e2e rate.
+    "assumed" provenance — a bench prices its own steady state; the
+    serving ledger's measured spend rides loadgen artifacts instead."""
+    from reporter_tpu.obs.economics import resolve_price
+
+    price = resolve_price()
+    chips = max(1, int(chips))
+    usd_per_m = (price / 3600.0 * chips / pps * 1e6) if pps > 0 else None
+    return {
+        "source": "assumed",
+        "price_per_chip_hour": price,
+        "chips": chips,
+        "usd_per_million_points": (round(usd_per_m, 6)
+                                   if usd_per_m is not None else None),
+    }
+
+
+def _memory_block(matcher):
+    """Device/host memory accounting for the artifact (same families as
+    the serving /statusz "memory" block)."""
+    try:
+        from reporter_tpu.obs.economics import memory_summary
+
+        return memory_summary(matcher) or None
+    except Exception as e:  # noqa: BLE001 - accounting must not sink a bench
+        _stderr("memory accounting failed: %s" % (e,))
+        return None
+
+
 def run_device() -> int:
     from reporter_tpu.utils.jaxenv import ensure_platform
 
@@ -784,6 +816,8 @@ def run_device() -> int:
             ubodt.packed.shape[0] * ubodt.bucket_entries, 1), 3),
         "ubodt_max_probes": ubodt.max_probes,
         "ubodt_max_kicks": int(ubodt.max_kicks),
+        "cost": _cost_block(pps, getattr(matcher.cfg, "devices", 1)),
+        "memory": _memory_block(matcher),
     }))
     return 0
 
@@ -1317,7 +1351,7 @@ def main() -> int:
               "oracle_cmp", "agreement_by_cohort", "device_mb",
               "fleet", "scenario", "edges", "ubodt_rows", "ubodt_layout",
               "ubodt_load", "ubodt_max_probes",
-              "ubodt_max_kicks"):
+              "ubodt_max_kicks", "cost", "memory"):
         if k in device_json:
             out[k] = device_json[k]
     out.update({k: baseline_json[k] for k in

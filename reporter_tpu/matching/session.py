@@ -441,6 +441,29 @@ class SessionStore:
         return {"sessions": n, "points_total": pts,
                 "max_sessions": self.max_sessions, "ttl_s": self.ttl_s}
 
+    def resident_bytes(self) -> int:
+        """Exact-by-construction payload bytes resident in the store
+        (docs/economics.md memory accounting): per session, the records
+        tail at its field widths (i32+f32+bool+f64 = 17 B), the replay
+        buffer at 3 f64 per point (lat/lon/time = 24 B), and the carry's
+        actual array nbytes + 16 B of scalars.  Payload bytes only —
+        Python object overhead is deliberately excluded so the number is
+        deterministic across interpreters and directly comparable to the
+        wire/checkpoint sizes built from the same fields."""
+        total = 0
+        with self._lock:
+            for s in self._by_uuid.values():
+                total += 17 * len(s.records) + 24 * len(s.replay)
+                c = s.carry
+                if c is not None:
+                    for key in ("scores", "edge", "offset"):
+                        arr = c.get(key)
+                        nb = getattr(arr, "nbytes", None)
+                        total += (int(nb) if nb is not None
+                                  else 4 * len(arr or ()))
+                    total += 16  # x, y, t, active, committed scalars
+        return total
+
 
 class SessionEngine:
     """The streaming match engine serve/service.py mounts inside its

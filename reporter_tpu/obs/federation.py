@@ -157,6 +157,30 @@ def snapshot_scalar(snap: dict, name: str,
     return None
 
 
+def snapshot_total(snap: dict, name: str,
+                   match: Optional[Dict[str, str]] = None) -> Optional[float]:
+    """Sum every scalar sample of a family in a registry snapshot,
+    optionally filtered by label values ({"state": "serving"}) — how the
+    router rolls a replica's per-state cost counters up to one number.
+    None when the family is absent entirely."""
+    fam = (snap or {}).get(name)
+    if not fam:
+        return None
+    names = list(fam.get("labelnames", ()))
+    total = 0.0
+    seen = False
+    for lv, sample in fam.get("samples", ()):
+        if isinstance(sample, dict):
+            continue
+        if match:
+            d = dict(zip(names, lv))
+            if any(d.get(k) != str(v) for k, v in match.items()):
+                continue
+        total += float(sample)
+        seen = True
+    return total if seen else None
+
+
 def render_snapshots(snaps: Dict[str, dict],
                      skip_meta: Optional[set] = None) -> str:
     """Federated Prometheus text: every family from every replica's

@@ -288,6 +288,17 @@ class Registry:
         with self._lock:
             self._collectors.append(fn)
 
+    def unregister_collect(self, fn: Callable[[], None]) -> None:
+        """Remove a collector registered with register_collect (no-op if
+        absent) — a component with a bounded lifetime (a stopped
+        EconomicsEngine, a torn-down test service) must not leave its
+        collector running on every future scrape."""
+        with self._lock:
+            try:
+                self._collectors.remove(fn)
+            except ValueError:
+                pass
+
     def _collect(self) -> None:
         with self._lock:
             collectors = list(self._collectors)

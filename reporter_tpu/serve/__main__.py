@@ -125,6 +125,10 @@ def main(argv):
         # sampling cadence + agreement objective; REPORTER_QUALITY_*
         # env knobs override the config "quality" block
         quality=conf.get("quality"),
+        # fleet economics (docs/economics.md): price-per-chip-hour,
+        # demand-history dir/bounds, capacity window; REPORTER_COST_* /
+        # REPORTER_HISTORY_* env knobs override the config block
+        economics=conf.get("economics"),
     )
     httpd = service.make_server(host, int(port))
     # log the BOUND port: with port 0 the OS picks one, and supervisors /
@@ -323,6 +327,8 @@ def main(argv):
         # httpd.shutdown() used to fall through to `return 0` with the
         # socket still open (ADVICE r05)
         httpd.server_close()
+        # flush the demand-history ring and drop the scrape collectors
+        service.economics.stop()
     return 0
 
 

@@ -97,7 +97,8 @@ from .service import _resolve_num
 log = logging.getLogger(__name__)
 
 ACTIONS = {"report", "trace_attributes_batch", "health", "metrics", "fleet",
-           "statusz", "traces", "slo", "attrib", "profile", "sessions"}
+           "statusz", "traces", "slo", "attrib", "profile", "sessions",
+           "cost"}
 
 # the router pins re-dispatched / hedged replica legs with this header so
 # the replica-side flight recorder retains its half of the trace for
@@ -1251,11 +1252,15 @@ class FleetRouter:
                     name: st.get("burn")
                     for name, st in (slo_sum.get("objectives")
                                      or {}).items()},
+                # the economics line off the replica's own statusz
+                # (docs/economics.md): cost + measured headroom per row
+                "economics": (statusz or {}).get("economics"),
             })
         return 200, {
             "role": "router",
             "uptime_s": round(_time.time() - self._t_boot, 1),
             "fleet": rows,
+            "economics": self.fleet_economics(rows),
             "slo": self.slo.summary(),
             # the self-driving plane on the one-screen view: current
             # replica count, the adaptive hedge's live value, and the
@@ -1274,6 +1279,64 @@ class FleetRouter:
                 "replicas": ages,
             },
             "metrics": obs.REGISTRY.snapshot(),
+        }
+
+    def fleet_economics(self, rows: Optional[list] = None) -> dict:
+        """The fleet cost roll-up (docs/economics.md): total chip-
+        seconds / dollars / headroom summed over every replica's last
+        federated snapshot, fleet $-per-million-matched-points from the
+        summed points ledger.  A dead replica's LAST snapshot still
+        counts — its spend happened — and the supervisor's cross-
+        incarnation ledger (tools/fleet.py) owns SIGKILL exactness."""
+        total_cs = total_usd = total_points = 0.0
+        headroom = ceiling = None
+        chips = 0
+        for f in self.federator.feeds():
+            statusz = f.statusz or {}
+            econ = statusz.get("economics") or {}
+            snap = statusz.get("metrics") or {}
+            total_cs += float(econ.get("chip_seconds_total") or 0.0)
+            total_usd += float(econ.get("usd") or 0.0)
+            chips += int(econ.get("chips") or 0)
+            total_points += float(obs_fed.snapshot_scalar(
+                snap, "reporter_points_matched_total") or 0.0)
+            hr = econ.get("headroom_traces_per_sec")
+            if hr is not None:
+                headroom = (headroom or 0.0) + float(hr)
+            cl = econ.get("ceiling_traces_per_sec")
+            if cl is not None:
+                ceiling = (ceiling or 0.0) + float(cl)
+        return {
+            "replicas": len(self.replicas),
+            "chips": chips,
+            "chip_seconds_total": round(total_cs, 3),
+            "usd": round(total_usd, 6),
+            "points_total": int(total_points),
+            "usd_per_million_points": (
+                round(total_usd / total_points * 1e6, 6)
+                if total_points > 0 else None),
+            "ceiling_traces_per_sec": (round(ceiling, 4)
+                                       if ceiling is not None else None),
+            "headroom_traces_per_sec": (round(headroom, 4)
+                                        if headroom is not None else None),
+        }
+
+    def handle_cost(self, query: dict) -> Tuple[int, dict]:
+        """Router ``GET /debug/cost``: the fleet roll-up plus each
+        replica's full cost block out of its last federated statusz
+        snapshot (``?pull=1`` forces a synchronous federation pull
+        first, the rehearsals' point-in-time read)."""
+        if query.get("pull", ["0"])[0] not in ("", "0", "false"):
+            self.federator.pull_all()
+        per_replica = {}
+        for f in self.federator.feeds():
+            econ = (f.statusz or {}).get("economics")
+            if econ is not None:
+                per_replica[f.label] = econ
+        return 200, {
+            "scope": "fleet",
+            "fleet": self.fleet_economics(),
+            "replicas": per_replica,
         }
 
     def handle_slo(self, query: dict) -> Tuple[int, dict]:
@@ -1579,6 +1642,8 @@ class FleetRouter:
                         return self._answer(*router.handle_traces(query))
                     if action == "slo":
                         return self._answer(*router.handle_slo(query))
+                    if action == "cost":  # GET /debug/cost[?pull=1]
+                        return self._answer(*router.handle_cost(query))
                     if action in ("attrib", "profile"):
                         status, rhdrs, body = router.proxy_debug(
                             action, query, self._trace_id)

@@ -41,8 +41,10 @@ from urllib.parse import parse_qs, urlsplit
 
 from .. import faults
 from ..matching import MatcherConfig, SegmentMatcher
+from ..matching.matcher import C_POINTS as C_POINTS_MATCHED
 from ..matching.session import SessionCheckpointer, SessionEngine, SessionStore
 from ..obs import adaptive as obs_adaptive
+from ..obs import economics as obs_econ
 from ..obs import flight as obs_flight
 from ..obs import log as obs_log
 from ..obs import metrics as obs
@@ -56,7 +58,8 @@ from ..tiles.network import RoadNetwork, grid_city
 log = logging.getLogger(__name__)
 
 ACTIONS = {"report", "trace_attributes_batch", "health", "sessions",
-           "metrics", "statusz", "profile", "traces", "attrib", "slo"}
+           "metrics", "statusz", "profile", "traces", "attrib", "slo",
+           "cost", "history"}
 
 
 def _env_num(name: str, default: float) -> float:
@@ -774,6 +777,7 @@ class ReporterService:
         quality: Optional[dict] = None,
         session_max_batch: int = 256,
         session_wait_ms: float = 2.0,
+        economics: Optional[dict] = None,
     ):
         """``matcher=None`` defers the engine: the HTTP socket can bind and
         /health can answer before the accelerator backend is even
@@ -871,6 +875,21 @@ class ReporterService:
         self.replica_id = (
             os.environ.get("REPORTER_REPLICA_ID", "").strip()
             or "%s-%d" % (_socket.gethostname()[:32], os.getpid()))
+        # fleet economics (docs/economics.md): the chip-second cost
+        # ledger, on-disk demand history (REPORTER_HISTORY_DIR, or the
+        # config "economics" block's history_dir), and the measured
+        # capacity-headroom estimator — the sensor plane behind
+        # GET /debug/cost and /debug/history
+        econ_spec = dict(economics or {})
+        hist_dir = (os.environ.get("REPORTER_HISTORY_DIR", "").strip()
+                    or econ_spec.get("history_dir"))
+        self.economics = obs_econ.EconomicsEngine(
+            self.replica_id, chips=1, spec=econ_spec,
+            history_path=(os.path.join(hist_dir,
+                                       "%s.jsonl" % self.replica_id)
+                          if hist_dir else None))
+        # the tick thread and scrape-time collectors arm in make_server()
+        # — a service object that never serves must not leak either
         if matcher is not None:
             self.attach_matcher(matcher)
         self._t_boot = _time.time()
@@ -900,6 +919,7 @@ class ReporterService:
             return
         self.draining = True
         G_DRAINING.set(1)
+        self.economics.ledger.set_draining(True)
         obs_log.event(log, "drain_begin", level=logging.WARNING,
                       replica=self.replica_id)
 
@@ -907,9 +927,13 @@ class ReporterService:
     def _track_active(self):
         with self._active_lock:
             self._n_active += 1
+        # the cost ledger's serving/idle attribution seam: chip-seconds
+        # bill as "serving" while any matching handler is inflight
+        self.economics.ledger.note_active(True)
         try:
             yield
         finally:
+            self.economics.ledger.note_active(False)
             with self._active_lock:
                 self._n_active -= 1
 
@@ -929,6 +953,8 @@ class ReporterService:
             threshold = int(os.environ.get("THRESHOLD_SEC", matcher.cfg.threshold_sec))
         self.threshold_sec = int(threshold)
         self.matcher = matcher
+        self.economics.ledger.set_chips(
+            int(getattr(matcher.cfg, "devices", 1)))
         self.batcher = self._make_batcher(matcher)
         # session plane: the store survives matcher/batcher swaps (carries
         # live pinned-host), so a degraded window or re-attach never drops
@@ -996,6 +1022,7 @@ class ReporterService:
             # commits nothing — the degraded path re-applies the points
             self.session_engine.invalidate_inflight()
         G_DEGRADED.set(1)
+        self.economics.ledger.set_degraded(True)
         obs_log.event(log, "degraded_enter", level=logging.ERROR,
                       reason=reason)
         if self._reattach_probe_s > 0:
@@ -1070,6 +1097,7 @@ class ReporterService:
         with self._degraded_lock:
             self.degraded = False
         G_DEGRADED.set(0)
+        self.economics.ledger.set_degraded(False)
         C_REATTACH.inc()
         obs_log.event(log, "engine_reattach", level=logging.WARNING,
                       backend=self.matcher.backend)
@@ -1348,6 +1376,69 @@ class ReporterService:
             self._n_requests += 1
             if not ok:
                 self._n_errors += 1
+
+    def _econ_sample(self) -> dict:
+        """The economics tick's signal read (obs/economics.py): cheap
+        live-registry/state reads only — the engine differences the
+        cumulative counters itself.  Admitted = terminal ok+degraded,
+        shed = terminal 429s; the device-step histogram feeds the
+        capacity ceiling's windowed p95."""
+        b = self.batcher
+        step = None
+        try:
+            samp = M_DEVICE_STEP._default()._sample()
+            if samp["count"] or b is not None:
+                step = (samp["buckets"], samp["counts"])
+        except Exception:  # noqa: BLE001 - a sensor read must never raise
+            pass
+        burn = None
+        max_burn = None
+        try:
+            objectives = obs_slo.engine().summary()["objectives"]
+            burn = {}
+            for name, st in objectives.items():
+                rates = [float(v) for v in (st.get("burn") or {}).values()
+                         if isinstance(v, (int, float))]
+                burn[name] = round(max(rates), 4) if rates else None
+            rates = [v for v in burn.values() if v is not None]
+            max_burn = max(rates) if rates else None
+        except Exception:  # noqa: BLE001
+            pass
+        return {
+            "queue_depth": b._q.qsize() if b is not None else 0,
+            "admitted_total": obs_econ.counter_total(
+                C_REQUESTS, {"outcome": ("ok", "degraded")}),
+            "shed_total": obs_econ.counter_total(
+                C_REQUESTS, {"outcome": "shed"}),
+            "points_total": C_POINTS_MATCHED.value,
+            "device_step": step,
+            "max_batch": float(b.max_batch) if b is not None else None,
+            "burn": burn,
+            "max_burn": max_burn,
+            "sessions": (self.session_store.summary()["sessions"]
+                         if self.session_store is not None else None),
+        }
+
+    def handle_cost(self, query: dict) -> Tuple[int, dict]:
+        """GET /debug/cost — the replica's cost ledger: chip-seconds by
+        lifecycle state, accrued dollars, $-per-million-matched-points,
+        the measured capacity block, and the demand-history ring's
+        location/size (docs/economics.md)."""
+        return 200, self.economics.cost_report()
+
+    def handle_history(self, query: dict) -> Tuple[int, dict]:
+        """GET /debug/history[?window=S] — the on-disk demand-history
+        ring's records (oldest first), optionally clipped to the last
+        ``window`` seconds.  404-free: history disabled just returns an
+        empty series with an explanation."""
+        window = None
+        raw = query.get("window", [None])[0]
+        if raw is not None:
+            try:
+                window = max(1.0, float(raw))
+            except (TypeError, ValueError):
+                return 400, {"error": "window must be a number (seconds)"}
+        return 200, self.economics.history_report(window_s=window)
 
     def handle_health(self) -> Tuple[int, dict]:
         """Liveness/ops snapshot (additive: the reference exposes no such
@@ -1669,6 +1760,13 @@ class ReporterService:
             "checkpoint": (self.session_checkpointer.summary()
                            if self.session_checkpointer is not None
                            else None),
+            # fleet economics (docs/economics.md): accrued chip-seconds /
+            # $, $/M points, and the measured headroom line — ceiling,
+            # headroom, time-to-exhaustion
+            "economics": self.economics.summary(),
+            # the memory plane: device in_use/limit + exact host bytes
+            # for the UBODT tiers and the session store
+            "memory": obs_econ.memory_summary(m, self.session_store),
             "metrics": obs.REGISTRY.snapshot(),
         }
 
@@ -1782,6 +1880,14 @@ class ReporterService:
 
     def make_server(self, host: str = "0.0.0.0", port: int = 8002) -> ThreadingHTTPServer:
         service = self
+        # the economics sensor plane (docs/economics.md) arms with the
+        # real server: the per-tick sampler thread plus the scrape-time
+        # ledger/memory collectors (the memory lambda reads whatever
+        # matcher/store are attached at scrape time)
+        self.economics.start(
+            self._econ_sample,
+            collect=(lambda: obs_econ.publish_memory(self.matcher,
+                                                     self.session_store),))
 
         # connection-concurrency bound, honouring the reference's env knobs
         # (reporter_service.py:37-45: THREAD_POOL_COUNT, or
@@ -1951,6 +2057,12 @@ class ReporterService:
                     if action == "slo":  # GET /debug/slo?window=S
                         self._drain_body(post)
                         return self._answer(*service.handle_slo(query))
+                    if action == "cost":  # GET /debug/cost
+                        self._drain_body(post)
+                        return self._answer(*service.handle_cost(query))
+                    if action == "history":  # GET /debug/history?window=S
+                        self._drain_body(post)
+                        return self._answer(*service.handle_history(query))
                     if post:
                         n = self._content_length()
                         if n is None:  # malformed header: framing unknown

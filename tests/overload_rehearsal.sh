@@ -55,6 +55,13 @@ export REPORTER_MAX_QUEUE=48
 # the same thing on every machine.  Phase C boots its OWN fleet with the
 # throttle unset (streaming latency is its gate).
 export REPORTER_FAULT_DEVICE_HANG="0.15"
+# the fleet-economics plane (docs/economics.md): a pinned price so the
+# ledger assertions are deterministic, and a fast history tick so the
+# phase-B headroom-vs-shed-onset gate has per-second resolution.  The
+# supervisor defaults REPORTER_HISTORY_DIR to <workdir>/history for its
+# children and writes the cross-checked ledger to <workdir>/cost_ledger.json
+export REPORTER_COST_PER_CHIP_HOUR=3.60
+export REPORTER_HISTORY_TICK_S=0.5
 reh_init "${1:-}" reporter-overload
 export REPORTER_XLA_CACHE_DIR="$WORK/xla-cache"
 ROUTER_PORT=18091
@@ -172,6 +179,7 @@ EOF
 # which effectively chains all three bounded queues (3 x 48 slots)
 # before a shed ever reaches the client — so the client needs enough
 # workers to keep the whole chain full (in-flight ~ rate x queue wait)
+T_B0=$(date +%s)
 python tools/loadgen.py --url "$ROUTER_URL" \
     --rate 140 --duration 25 \
     --vehicles 24 --points 48 --window 16 --grid 8 \
@@ -210,6 +218,44 @@ assert p99 is not None and p99 <= 8000.0, (
 print("phase B overload: %d requests, shed %.1f%%, admitted %.1f/s at "
       "p99 %.0f ms — shed down to capacity, admitted tail protected"
       % (n, 100.0 * shed / n, art["admitted_rps"], p99))
+EOF
+
+# the capacity estimator is judged against observed truth: replaying the
+# persistent demand-history rings (docs/economics.md leg 3), measured
+# headroom must cross <= 0 within a bounded window of the replica's REAL
+# first shed in phase B — an estimator that never goes negative under a
+# genuine overload (or only long after the shedding started) is lying
+python - "$WORK" "$T_B0" <<'EOF'
+import glob, sys
+
+sys.path.insert(0, ".")
+from reporter_tpu.obs.economics import read_ring
+
+work, t_b0 = sys.argv[1], float(sys.argv[2])
+ONSET_SLACK_S = 15.0
+verdicts = []
+for ring in sorted(glob.glob(work + "/history/rep-*.jsonl")):
+    ticks = [r for r in read_ring(ring) if r.get("t", 0) >= t_b0]
+    t_shed = next((r["t"] for r in ticks
+                   if (r.get("shed_rps") or 0) > 0), None)
+    if t_shed is None:
+        continue  # this replica never shed in phase B (e.g. late spawn)
+    t_zero = next((r["t"] for r in ticks
+                   if r.get("headroom") is not None
+                   and r["headroom"] <= 0.0), None)
+    verdicts.append((ring.rsplit("/", 1)[1], t_shed,
+                     None if t_zero is None else t_zero - t_shed))
+assert verdicts, (
+    "phase B shed on the client but NO replica history ring recorded a "
+    "shed tick — the demand history is not persisting what happened")
+ok = [(name, dt) for name, _, dt in verdicts
+      if dt is not None and abs(dt) <= ONSET_SLACK_S]
+assert ok, (
+    "measured headroom never crossed zero within %.0fs of the real shed "
+    "onset on any shedding replica: %r" % (ONSET_SLACK_S, verdicts))
+print("phase B headroom: crossed zero within %.0fs of shed onset on %s "
+      "(all shedding replicas: %r)"
+      % (ONSET_SLACK_S, [n for n, _ in ok], verdicts))
 EOF
 
 # ---- phase C: SIGKILL preemption + crawling drain under a stream ----------
@@ -367,6 +413,56 @@ assert os.path.exists(work + "/slow_drain_observed"), (
 print("phase C preemption: ledger EXACT (%d == %d answered points), "
       "handoffs %r, slow_drain stall absorbed by the handoff"
       % (fleet["points_total"], n200, ho))
+EOF
+
+# the cost-ledger consistency invariant (docs/economics.md leg 1): the
+# supervisor's cross-check — Σ per-replica chip-seconds vs supervised
+# wall-clock × chips — must hold EXACTLY THROUGH the SIGKILL + respawn
+# above: the FleetCostLedger banks a killed incarnation's accrual when
+# its counters go backwards, so nothing billed is lost and nothing is
+# double-billed.  Poll through federation ticks (5 s cadence) until the
+# post-churn report lands.  The fleet-level demand-history ring must
+# have recorded the churn window too.
+python - "$WORKC" <<'EOF'
+import json, os, sys, time
+
+sys.path.insert(0, ".")
+from reporter_tpu.obs.economics import read_ring
+
+workc = sys.argv[1]
+path = os.path.join(workc, "cost_ledger.json")
+deadline = time.monotonic() + 30.0
+rep = None
+# every replica starts at 1 incarnation, so 3 replicas + the SIGKILL'd
+# one's banked respawn means the fleet total must reach >= 4
+while time.monotonic() < deadline:
+    try:
+        rep = json.load(open(path))
+        if rep.get("incarnations", 0) >= 4:
+            break
+    except (OSError, ValueError):
+        pass  # federation tick mid-write / not yet written
+    time.sleep(1.0)
+assert rep is not None, "the supervisor never wrote %s" % path
+assert rep.get("incarnations", 0) >= 4, (
+    "the SIGKILL'd replica's respawn never registered as a banked "
+    "incarnation: %r" % rep)
+assert rep["consistent"], (
+    "chip-second ledger INCONSISTENT through SIGKILL+respawn: ledger "
+    "%.1f chip-s vs supervised %.1f expected (rel_err %.3f > tol %.3f "
+    "+ boot slack): %r"
+    % (rep["totals"]["chip_seconds"], rep["expected_chip_seconds"],
+       rep.get("rel_err", -1), rep.get("tolerance", -1),
+       rep.get("replicas")))
+assert rep["price_per_chip_hour"] == 3.60, rep["price_per_chip_hour"]
+fleet_ticks = read_ring(os.path.join(workc, "history", "fleet.jsonl"))
+assert fleet_ticks, "the supervisor's fleet demand-history ring is empty"
+assert any(r.get("replicas_live") is not None for r in fleet_ticks)
+print("phase C economics: ledger CONSISTENT through SIGKILL+respawn "
+      "(%.1f chip-s vs %.1f supervised, %d incarnation(s) banked, "
+      "rel_err %.3f); fleet history ring %d ticks"
+      % (rep["totals"]["chip_seconds"], rep["expected_chip_seconds"],
+         rep["incarnations"], rep.get("rel_err", 0.0), len(fleet_ticks)))
 EOF
 
 # ---- graceful fleet drain: exit 0, nothing stranded -----------------------

@@ -32,6 +32,10 @@ set -euo pipefail
 reh_init "${1:-}" reporter-slo
 PORT=18061
 PORT2=18062
+# the fleet-economics plane rides along (docs/economics.md): history on
+# so /debug/history has a ring to serve, and its dump + /debug/cost land
+# in $WORK with the other uploaded artifacts
+export REPORTER_HISTORY_DIR="$WORK/history"
 echo "slo rehearsal workdir: $WORK"
 
 # one length bucket (every loadgen window is 16 points) keeps the warmup
@@ -76,6 +80,27 @@ python tools/perf_gate.py BENCH_r0*.json \
     --fresh "$WORK/loadgen_nofault.json" \
     > "$WORK/perf_gate_loadgen.json"
 echo "loadgen artifact accepted by tools/perf_gate.py"
+
+# the economics surfaces ride the uploaded artifacts: the live cost
+# ledger and the demand-history window the run just wrote (CI uploads
+# $WORK wholesale), plus the artifact's own measured cost block
+curl -fsS "http://127.0.0.1:$PORT/debug/cost" > "$WORK/debug_cost.json"
+curl -fsS "http://127.0.0.1:$PORT/debug/history?window=600" \
+    > "$WORK/debug_history.json"
+python - "$WORK" <<'EOF'
+import json, sys
+
+work = sys.argv[1]
+cost = json.load(open(work + "/debug_cost.json"))
+assert cost["chip_seconds"]["total"] > 0, cost
+hist = json.load(open(work + "/debug_history.json"))
+assert hist["enabled"] and hist["n"] > 0, hist
+art = json.load(open(work + "/loadgen_nofault.json"))
+assert art["cost"]["source"] == "server", art.get("cost")
+print("economics artifacts: %.1f chip-s accrued, %d history ticks, "
+      "loadgen cost block source=server"
+      % (cost["chip_seconds"]["total"], hist["n"]))
+EOF
 
 kill "$SERVE_PID" 2>/dev/null || true
 wait "$SERVE_PID" 2>/dev/null || true

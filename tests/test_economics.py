@@ -1,0 +1,431 @@
+"""obs/economics.py — the fleet economics observability plane.
+
+Pins the chip-second cost ledger (state attribution, price resolution,
+monotone publish), the persistent demand-history ring (rotation bound,
+crash-truncated tails, restart continuity), the measured capacity
+estimator (windowed device-step p95, shed-onset re-anchoring,
+time-to-exhaustion), the engine tick + /debug endpoints, the fleet
+roll-up (router + supervisor FleetCostLedger with SIGKILL reset
+detection), and the memory-accounting surfaces."""
+
+import json
+import math
+import os
+
+import pytest
+
+from reporter_tpu.obs import economics as econ
+from reporter_tpu.obs import metrics as obs
+
+
+class Clock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def tick(self, dt):
+        self.t += dt
+
+
+# -- price resolution --------------------------------------------------------
+
+def test_price_default(monkeypatch):
+    monkeypatch.delenv("REPORTER_COST_PER_CHIP_HOUR", raising=False)
+    assert econ.resolve_price() == econ.DEFAULT_PRICE_PER_CHIP_HOUR
+
+
+def test_price_config_beats_default(monkeypatch):
+    monkeypatch.delenv("REPORTER_COST_PER_CHIP_HOUR", raising=False)
+    assert econ.resolve_price({"price_per_chip_hour": 4.5}) == 4.5
+
+
+def test_price_env_beats_config(monkeypatch):
+    monkeypatch.setenv("REPORTER_COST_PER_CHIP_HOUR", "9.25")
+    assert econ.resolve_price({"price_per_chip_hour": 4.5}) == 9.25
+
+
+# -- the cost ledger ---------------------------------------------------------
+
+def test_ledger_attributes_states_exactly():
+    clk = Clock()
+    led = econ.CostLedger(chips=2, price_per_chip_hour=3.6, clock=clk)
+    clk.tick(10.0)                       # idle
+    led.note_active(True)
+    clk.tick(5.0)                        # serving
+    led.note_active(False)
+    led.set_degraded(True)
+    clk.tick(3.0)                        # degraded
+    led.set_degraded(False)
+    led.set_draining(True)
+    clk.tick(2.0)                        # draining
+    cs = led.chip_seconds()
+    assert cs["idle"] == pytest.approx(20.0)       # 10 s x 2 chips
+    assert cs["serving"] == pytest.approx(10.0)
+    assert cs["degraded"] == pytest.approx(6.0)
+    assert cs["draining"] == pytest.approx(4.0)
+    assert cs["total"] == pytest.approx(40.0)
+
+
+def test_ledger_draining_outranks_degraded():
+    clk = Clock()
+    led = econ.CostLedger(chips=1, price_per_chip_hour=1.0, clock=clk)
+    led.set_degraded(True)
+    led.set_draining(True)
+    clk.tick(7.0)
+    cs = led.chip_seconds()
+    assert cs["draining"] == pytest.approx(7.0)
+    assert cs["degraded"] == 0.0
+
+
+def test_ledger_usd_and_per_point_math():
+    clk = Clock()
+    led = econ.CostLedger(chips=1, price_per_chip_hour=3600.0, clock=clk)
+    clk.tick(10.0)
+    snap = led.snapshot(points=2_000_000)
+    assert snap["usd"] == pytest.approx(10.0)      # $1/chip-second
+    assert snap["usd_per_million_points"] == pytest.approx(5.0)
+    assert snap["state"] == "idle"
+
+
+def test_ledger_no_points_yields_none():
+    led = econ.CostLedger(clock=Clock())
+    assert led.snapshot(points=0)["usd_per_million_points"] is None
+
+
+def test_ledger_set_chips_rebills_forward_only():
+    clk = Clock()
+    led = econ.CostLedger(chips=1, price_per_chip_hour=1.0, clock=clk)
+    clk.tick(10.0)
+    led.set_chips(4)
+    clk.tick(10.0)
+    assert led.chip_seconds()["total"] == pytest.approx(10.0 + 40.0)
+
+
+def test_ledger_publish_is_monotone():
+    clk = Clock()
+    led = econ.CostLedger(chips=1, price_per_chip_hour=3600.0, clock=clk)
+    base = econ.counter_total(econ.C_CHIP_SECONDS)
+    clk.tick(5.0)
+    led.publish()
+    mid = econ.counter_total(econ.C_CHIP_SECONDS)
+    clk.tick(5.0)
+    led.publish()
+    led.publish()                        # double publish must not double-count
+    end = econ.counter_total(econ.C_CHIP_SECONDS)
+    assert mid - base == pytest.approx(5.0)
+    assert end - mid == pytest.approx(5.0)
+
+
+# -- the demand-history ring -------------------------------------------------
+
+def test_history_append_read_roundtrip(tmp_path):
+    h = econ.DemandHistory(str(tmp_path / "r.jsonl"), wall=Clock(100.0))
+    h.append({"queue_depth": 1})
+    h.append({"queue_depth": 2})
+    recs = h.read()
+    assert [r["queue_depth"] for r in recs] == [1, 2]
+    assert all("t" in r for r in recs)
+    h.close()
+
+
+def test_history_window_filters_old_records(tmp_path):
+    clk = Clock(100.0)
+    h = econ.DemandHistory(str(tmp_path / "r.jsonl"), wall=clk)
+    h.append({"i": 0})
+    clk.tick(100.0)
+    h.append({"i": 1})
+    assert [r["i"] for r in h.read(window_s=50.0)] == [1]
+    h.close()
+
+
+def test_history_rotation_bounds_disk(tmp_path):
+    p = str(tmp_path / "r.jsonl")
+    h = econ.DemandHistory(p, max_bytes=4096)
+    for i in range(500):
+        h.append({"i": i, "pad": "x" * 40})
+    assert h.size_bytes() <= 4096
+    assert os.path.exists(p + ".1")      # the rotated epoch exists
+    recs = h.read()
+    assert recs                          # the recent window survived
+    assert recs[-1]["i"] == 499
+    h.close()
+
+
+def test_history_tolerates_torn_tail(tmp_path):
+    p = str(tmp_path / "r.jsonl")
+    h = econ.DemandHistory(p)
+    h.append({"i": 0})
+    h.close()
+    with open(p, "a") as f:
+        f.write('{"i": 1, "tor')        # SIGKILL mid-append
+    h2 = econ.DemandHistory(p)
+    assert [r["i"] for r in h2.read()] == [0]
+    h2.append({"i": 2})                  # and the ring keeps working
+    assert [r["i"] for r in h2.read()] == [0, 2]
+    h2.close()
+
+
+def test_history_restart_continuity(tmp_path):
+    p = str(tmp_path / "r.jsonl")
+    h = econ.DemandHistory(p)
+    h.append({"i": 0})
+    h.close()
+    h2 = econ.DemandHistory(p)           # a respawned replica reopens
+    h2.append({"i": 1})
+    assert [r["i"] for r in h2.read()] == [0, 1]
+    h2.close()
+
+
+def test_read_ring_standalone_reader(tmp_path):
+    p = str(tmp_path / "r.jsonl")
+    h = econ.DemandHistory(p, wall=Clock(100.0))
+    h.append({"i": 0})
+    h.close()
+    recs = econ.read_ring(p)
+    assert [r["i"] for r in recs] == [0]
+    assert econ.read_ring(str(tmp_path / "missing.jsonl")) == []
+
+
+# -- the capacity estimator --------------------------------------------------
+
+BOUNDS = [0.01, 0.02, 0.05, 0.1]
+
+
+def _counts(n_fast, n_slow=0):
+    # n_fast obs in the <=0.02 slot, n_slow in the <=0.1 slot, 0 overflow
+    return [0, n_fast, 0, n_slow, 0]
+
+
+def test_capacity_model_ceiling_from_windowed_p95():
+    clk = Clock()
+    cap = econ.CapacityEstimator(window_s=60.0, clock=clk)
+    cap.observe_hist(BOUNDS, _counts(0))
+    clk.tick(1.0)
+    cap.observe_hist(BOUNDS, _counts(100))
+    cap.update(max_batch=64, admitted_rate=10.0, shed_rate=0.0)
+    s = cap.snapshot()
+    # all deltas landed in the (0.01, 0.02] slot -> p95 ~ 0.02 (the
+    # quantile interpolates inside the bucket), ceiling = 64 / p95
+    assert s["step_p95_s"] == pytest.approx(0.02, rel=0.05)
+    assert s["ceiling_traces_per_sec"] == pytest.approx(
+        64.0 / s["step_p95_s"])
+    assert s["headroom_traces_per_sec"] == pytest.approx(
+        s["ceiling_traces_per_sec"] - 10.0)
+
+
+def test_capacity_reanchors_at_shed_onset():
+    clk = Clock()
+    cap = econ.CapacityEstimator(window_s=60.0, clock=clk)
+    cap.observe_hist(BOUNDS, _counts(0))
+    clk.tick(1.0)
+    cap.observe_hist(BOUNDS, _counts(100))
+    cap.update(max_batch=64, admitted_rate=10.0, shed_rate=0.0)
+    # shed onset while actually admitting 1600/s: the model (3200) is
+    # 2x optimistic -> anchor clamps the ceiling to the observed rate
+    cap.update(max_batch=64, admitted_rate=1600.0, shed_rate=5.0)
+    s = cap.snapshot()
+    # anchor = admitted/model, so the re-anchored ceiling IS the
+    # observed admitted rate at onset
+    assert 0.4 < s["anchor"] < 0.6
+    assert s["ceiling_traces_per_sec"] == pytest.approx(1600.0)
+    # overloaded: headroom <= 0, exhaustion now
+    assert s["headroom_traces_per_sec"] <= 0.0
+    assert s["exhaustion_s"] == 0.0
+
+
+def test_capacity_exhaustion_from_demand_slope():
+    clk = Clock()
+    cap = econ.CapacityEstimator(window_s=600.0, clock=clk)
+    cap.observe_hist(BOUNDS, _counts(0))
+    for i in range(10):
+        clk.tick(1.0)
+        cap.observe_hist(BOUNDS, _counts(100 * (i + 1)))
+        # demand grows 10/s per tick against a ~3200 ceiling
+        cap.update(max_batch=64, admitted_rate=100.0 + 10.0 * i,
+                   shed_rate=0.0)
+    s = cap.snapshot()
+    assert s["exhaustion_s"] is not None and s["exhaustion_s"] > 0
+    # headroom / slope: ~(3200 - 190) / 10 within estimator noise
+    assert 100.0 < s["exhaustion_s"] < 600.0
+
+
+def test_capacity_publish_exhaustion_sentinel():
+    clk = Clock()
+    cap = econ.CapacityEstimator(clock=clk)
+    cap.update(max_batch=8, admitted_rate=0.0, shed_rate=0.0)
+    cap.publish()
+    assert econ.G_EXHAUST.value == -1.0
+
+
+# -- the engine --------------------------------------------------------------
+
+def _sampler(depth=3.0, admitted=100.0, shed=0.0, points=1000.0,
+             burn=None):
+    counts = {"n": 0}
+
+    def fn():
+        counts["n"] += 1
+        return {
+            "queue_depth": depth,
+            "admitted_total": admitted * counts["n"],
+            "shed_total": shed * counts["n"],
+            "points_total": points,
+            "device_step": (BOUNDS, _counts(10 * counts["n"])),
+            "max_batch": 32.0,
+            "burn": burn or {},
+            "max_burn": max((burn or {}).values(), default=0.0),
+            "sessions": 5,
+        }
+    return fn
+
+
+def test_engine_tick_writes_history_and_gauges(tmp_path, monkeypatch):
+    monkeypatch.delenv("REPORTER_COST_PER_CHIP_HOUR", raising=False)
+    clk = Clock()
+    wall = Clock(5000.0)
+    e = econ.EconomicsEngine("rep-t", chips=2,
+                             history_path=str(tmp_path / "rep-t.jsonl"),
+                             clock=clk, wall=wall)
+    e._sampler = _sampler(burn={"avail_fast": 1.5})
+    clk.tick(1.0)
+    wall.tick(1.0)
+    e.tick()
+    clk.tick(1.0)
+    wall.tick(1.0)
+    e.tick()
+    recs = e.history.read()
+    assert len(recs) == 2
+    r = recs[-1]
+    assert r["replica"] == "rep-t"
+    assert r["admitted_rps"] == pytest.approx(100.0)
+    assert r["max_burn"] == pytest.approx(1.5)
+    assert r["chip_seconds_total"] > 0
+    assert econ.G_SESS_PER_CHIP.value == pytest.approx(2.5)  # 5 / 2 chips
+    rep = e.cost_report()
+    assert rep["replica"] == "rep-t"
+    assert rep["chips"] == 2
+    assert rep["history"]["ticks"] == 2
+    hist = e.history_report(window_s=3600.0)
+    assert hist["enabled"] and hist["n"] == 2
+    e.stop()
+
+
+def test_engine_without_history_reports_disabled():
+    e = econ.EconomicsEngine("rep-x", clock=Clock(), wall=Clock())
+    assert e.cost_report()["history"] is None
+    h = e.history_report(window_s=60.0)
+    assert h["enabled"] is False and h["ticks"] == []
+    e.stop()
+
+
+def test_engine_summary_shape():
+    e = econ.EconomicsEngine("rep-s", clock=Clock(), wall=Clock())
+    s = e.summary()
+    for k in ("chips", "price_per_chip_hour", "chip_seconds_total", "usd",
+              "usd_per_million_points", "ceiling_traces_per_sec",
+              "headroom_traces_per_sec", "exhaustion_s", "history"):
+        assert k in s
+    e.stop()
+
+
+# -- the service endpoints ---------------------------------------------------
+
+def test_service_cost_and_history_endpoints(tmp_path, monkeypatch):
+    monkeypatch.setenv("REPORTER_HISTORY_DIR", str(tmp_path))
+    monkeypatch.setenv("REPORTER_COST_PER_CHIP_HOUR", "2.4")
+    from reporter_tpu.serve.service import ReporterService
+
+    s = ReporterService(None)
+    try:
+        code, rep = s.handle_cost({})
+        assert code == 200
+        assert rep["price_per_chip_hour"] == 2.4
+        assert rep["history"]["path"].startswith(str(tmp_path))
+        code, hist = s.handle_history({"window": ["60"]})
+        assert code == 200 and hist["enabled"]
+        code, _ = s.handle_history({"window": ["bogus"]})
+        assert code == 400
+        code, st = s.handle_statusz()
+        assert "economics" in st and "memory" in st
+        assert st["economics"]["price_per_chip_hour"] == 2.4
+    finally:
+        s.economics.stop()
+
+
+# -- the fleet roll-up -------------------------------------------------------
+
+def _feed_statusz(cs, usd, chips=1, points=500.0, headroom=10.0):
+    return {
+        "economics": {"chip_seconds_total": cs, "usd": usd, "chips": chips,
+                      "price_per_chip_hour": 1.2,
+                      "headroom_traces_per_sec": headroom,
+                      "ceiling_traces_per_sec": headroom + 5.0},
+        "metrics": {"reporter_points_matched_total":
+                    {"labelnames": [], "samples": [[[], points]]}},
+    }
+
+
+def test_router_fleet_economics_rolls_up():
+    from reporter_tpu.serve.router import FleetRouter
+
+    r = FleetRouter(["http://127.0.0.1:1", "http://127.0.0.1:2"])
+    feeds = r.federator.feeds()
+    feeds[0].statusz = _feed_statusz(10.0, 0.01, points=1_000.0)
+    feeds[1].statusz = _feed_statusz(30.0, 0.03, points=1_000.0)
+    e = r.fleet_economics()
+    assert e["chip_seconds_total"] == pytest.approx(40.0)
+    assert e["usd"] == pytest.approx(0.04)
+    assert e["points_total"] == 2000
+    assert e["usd_per_million_points"] == pytest.approx(20.0)
+    assert e["headroom_traces_per_sec"] == pytest.approx(20.0)
+    code, rep = r.handle_cost({})
+    assert code == 200 and rep["scope"] == "fleet"
+    assert len(rep["replicas"]) == 2
+
+
+def test_fleet_cost_ledger_survives_resets():
+    led = econ.FleetCostLedger(tolerance=0.15)
+    led.observe("rep-0", 10.0, usd=0.1, points=100, chips=1)
+    led.observe("rep-0", 20.0, usd=0.2, points=200, chips=1)
+    led.observe("rep-0", 2.0, usd=0.02, points=10, chips=1)   # SIGKILL
+    led.observe("rep-0", 8.0, usd=0.08, points=40, chips=1)
+    rep = led.report({"rep-0": 30.0})
+    row = rep["replicas"]["rep-0"]
+    assert row["chip_seconds"] == pytest.approx(28.0)
+    assert row["incarnations"] == 2
+    assert rep["consistent"]                  # |28-30| within tol+slack
+    assert rep["totals"]["points"] == 240
+
+
+def test_fleet_cost_ledger_flags_inconsistency():
+    led = econ.FleetCostLedger(tolerance=0.05)
+    led.BOOT_SLACK_S = 0.0
+    led.observe("rep-0", 10.0)
+    rep = led.report({"rep-0": 100.0})
+    assert not rep["consistent"]
+    assert rep["rel_err"] == pytest.approx(0.9)
+
+
+# -- memory accounting -------------------------------------------------------
+
+def test_session_store_resident_bytes_grows():
+    from reporter_tpu.matching.session import SessionStore
+
+    st = SessionStore()
+    base = st.resident_bytes()
+    sess = st.get_or_open("veh-1", 0.0)
+    for i in range(32):
+        sess.records.append((i, 0.0, False, 0.0))
+    assert st.resident_bytes() > base
+
+
+def test_memory_summary_reports_sessions():
+    from reporter_tpu.matching.session import SessionStore
+
+    st = SessionStore()
+    st.get_or_open("veh-1", 0.0)
+    out = econ.memory_summary(None, st)
+    assert out["host.sessions"] >= 0
+    assert "sessions_resident" in out

@@ -1,0 +1,143 @@
+#!/usr/bin/env python3
+"""demand_export: turn a recorded demand-history window back into a
+replayable loadgen schedule (docs/economics.md "Replaying demand").
+
+Reads the persistent demand history — an on-disk JSONL ring
+(``REPORTER_HISTORY_DIR/<replica>.jsonl``, obs/economics.py
+DemandHistory; the supervisor's fleet ring works too) or a live
+server's ``GET /debug/history?window=S`` — extracts the offered-rate
+series (admitted + shed by default) and writes the
+``{"points": [[t, mult], ...]}`` schedule file that
+``tools/loadgen.py --profile schedule:<file>`` piecewise-linearly
+interpolates against ``--rate``.  Multipliers are normalized around the
+window's MEAN rate, printed as the recommended ``--rate``:
+
+    python tools/demand_export.py \
+        --history /tmp/fleet/history/rep-0.jsonl --out /tmp/sched.json
+    python tools/loadgen.py --url http://... \
+        --rate <recommended> --duration <recommended> \
+        --profile schedule:/tmp/sched.json
+
+reproduces the recorded shape at the recorded intensity; a different
+``--duration`` replays the same shape time-warped (loadgen stretches
+the recorded span onto the run).
+
+Exit codes: 0 ok, 2 unusable input (no records, zero demand).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import urllib.request
+from typing import List, Optional
+
+sys.path.insert(0, os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+
+from reporter_tpu.obs.economics import read_ring  # noqa: E402
+
+
+def rate_of(rec: dict, signal: str) -> Optional[float]:
+    """One record's demand rate under the chosen signal; None when the
+    record carries none of the fields (e.g. a malformed tick)."""
+    a = rec.get("admitted_rps")
+    s = rec.get("shed_rps")
+    if signal == "admitted":
+        return float(a) if a is not None else None
+    if a is None and s is None:
+        return None
+    return float(a or 0.0) + float(s or 0.0)
+
+
+def export_schedule(records: List[dict], signal: str = "offered",
+                    min_points: int = 2) -> dict:
+    """The schedule dict from raw history records: t-sorted
+    ``[t, multiplier]`` points normalized around the mean rate, plus the
+    provenance header loadgen ignores but humans read.  Raises
+    ValueError on fewer than ``min_points`` usable records or a window
+    with zero demand throughout."""
+    pts = []
+    for r in records:
+        t = r.get("t")
+        v = rate_of(r, signal)
+        if t is None or v is None:
+            continue
+        pts.append((float(t), max(0.0, v)))
+    pts.sort()
+    if len(pts) < min_points:
+        raise ValueError("only %d usable records (need >= %d)"
+                         % (len(pts), min_points))
+    mean = sum(v for _, v in pts) / len(pts)
+    if mean <= 0:
+        raise ValueError("window carries zero demand — nothing to replay")
+    t0 = pts[0][0]
+    return {
+        "signal": signal,
+        "base_rate": round(mean, 4),
+        "span_s": round(pts[-1][0] - t0, 3),
+        "records": len(pts),
+        "t0_unix": round(t0, 3),
+        "points": [[round(t - t0, 3), round(v / mean, 4)] for t, v in pts],
+    }
+
+
+def fetch_history(url: str, window_s: Optional[float]) -> List[dict]:
+    q = "?window=%d" % int(window_s) if window_s else ""
+    with urllib.request.urlopen(url.rstrip("/") + "/debug/history" + q,
+                                timeout=10) as r:
+        body = json.loads(r.read().decode())
+    return list(body.get("ticks") or ())
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    src = ap.add_mutually_exclusive_group(required=True)
+    src.add_argument("--history",
+                     help="demand-history JSONL ring on disk "
+                          "(REPORTER_HISTORY_DIR/<replica>.jsonl; the "
+                          "rotated .1 epoch is read automatically)")
+    src.add_argument("--url",
+                     help="live server base url: reads GET /debug/history")
+    ap.add_argument("--window", type=float, default=None,
+                    help="only the last S seconds of the ring (default: "
+                         "everything on disk / the server default)")
+    ap.add_argument("--signal", choices=("offered", "admitted"),
+                    default="offered",
+                    help="offered = admitted + shed (what clients ASKED "
+                         "for — the default, so replay re-creates the "
+                         "overload); admitted = what actually got in")
+    ap.add_argument("--out", default=None,
+                    help="schedule file path (default stdout)")
+    args = ap.parse_args(argv)
+
+    try:
+        if args.history:
+            records = read_ring(args.history, window_s=args.window)
+        else:
+            records = fetch_history(args.url, args.window)
+        sched = export_schedule(records, signal=args.signal)
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        sys.stderr.write("demand_export: %s\n" % (e,))
+        return 2
+
+    blob = json.dumps(sched, indent=1)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(blob + "\n")
+    else:
+        print(blob)
+    sys.stderr.write(
+        "demand_export: %d records over %.1fs -> %s\n"
+        "replay with: tools/loadgen.py --rate %.4g --duration %.4g "
+        "--profile schedule:%s\n"
+        % (sched["records"], sched["span_s"], args.out or "stdout",
+           sched["base_rate"], max(sched["span_s"], 1.0),
+           args.out or "<file>"))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -185,6 +185,14 @@ class Fleet:
             base["REPORTER_SESSION_CHECKPOINT_DIR"] = self.ckpt_dir
             if args.session_checkpoint_sync:
                 base["REPORTER_SESSION_CHECKPOINT_SYNC"] = "1"
+        # fleet economics (docs/economics.md): every child persists its
+        # demand-history ring under one shared workdir tree unless the
+        # operator already pinned a directory; the supervisor's own
+        # fleet-level series and the cross-incarnation cost ledger land
+        # next to them on the federation cadence
+        base.setdefault("REPORTER_HISTORY_DIR",
+                        os.path.join(self.workdir, "history"))
+        self.history_dir = base["REPORTER_HISTORY_DIR"]
         self._base_env = base
         self.replicas = []
         self._next_idx = 0
@@ -217,6 +225,16 @@ class Fleet:
         self.backoff = RespawnBackoff(
             base_s=args.respawn_backoff_base,
             max_s=args.respawn_backoff_max)
+        # chip-second accounting across incarnations (obs/economics.py
+        # FleetCostLedger): _uptime banks each completed incarnation's
+        # supervised wall-seconds per child so the expected side of the
+        # cost invariant survives SIGKILL + respawn too
+        from reporter_tpu.obs.economics import FleetCostLedger
+
+        self.cost_ledger = FleetCostLedger()
+        self._uptime = {}           # name -> completed-incarnation seconds
+        self._econ_prev = None      # (t, admitted_total, shed_total)
+        self._fleet_hist = None     # lazy DemandHistory, federate thread
 
     def _make_replica(self) -> Child:
         i = self._next_idx
@@ -308,6 +326,7 @@ class Fleet:
                 break
             log.info("rolling restart: draining %s", c.name)
             rc = c.drain(self.args.drain_grace + 10.0)
+            self._bank_uptime(c)
             if rc != 0:
                 log.error("%s exited %s during rolling drain", c.name, rc)
                 ok = False
@@ -345,6 +364,112 @@ class Fleet:
                 fed.dump(path, extra={"router": self.router.url})
             except OSError as e:
                 log.warning("federation dump failed: %s", e)
+            try:
+                self._econ_tick(fed)
+            except Exception as e:  # noqa: BLE001 - bookkeeping only
+                log.warning("economics tick failed: %s", e)
+
+    # -- fleet economics (docs/economics.md) ---------------------------------
+
+    def _bank_uptime(self, c: Child) -> None:
+        """A child incarnation ended on purpose (drain): bank its
+        supervised wall-seconds.  Unexpected deaths bank in monitor()."""
+        if c.t_spawn:
+            self._uptime[c.name] = (
+                self._uptime.get(c.name, 0.0)
+                + max(0.0, time.monotonic() - c.t_spawn))
+
+    def _expected_uptime(self) -> dict:
+        """rid -> supervised wall-seconds across ALL incarnations: the
+        banked completed ones plus the live one — the expected side of
+        the chip-seconds invariant (`cost_ledger.json` "consistent")."""
+        now = time.monotonic()
+        out = dict(self._uptime)
+        out.pop("router", None)     # the router bills no chips
+        with self._lock:
+            replicas = list(self.replicas)
+        for c in replicas:
+            if c.alive():
+                out[c.rid] = out.get(c.rid, 0.0) + (now - c.t_spawn)
+        return out
+
+    def _econ_tick(self, fed) -> None:
+        """One economics tick per federation pull: feed every replica's
+        statusz economics block into the cross-incarnation cost ledger,
+        write <workdir>/cost_ledger.json atomically, and append one
+        fleet-level record to the demand-history ring — the series
+        tools/demand_export.py replays."""
+        from reporter_tpu.obs import economics as econ
+        from reporter_tpu.obs import federation as obs_fed
+
+        now = time.monotonic()
+        price = None
+        qdepth = admitted = shed = 0.0
+        headroom = None
+        n_live = 0
+        for f in fed.feeds():
+            statusz = f.statusz or {}
+            e = statusz.get("economics") or {}
+            snap = statusz.get("metrics") or {}
+            if e:
+                self.cost_ledger.observe(
+                    f.label, e.get("chip_seconds_total"), e.get("usd"),
+                    obs_fed.snapshot_scalar(
+                        snap, "reporter_points_matched_total"),
+                    e.get("chips") or 1)
+                price = price if price is not None else \
+                    e.get("price_per_chip_hour")
+                hr = e.get("headroom_traces_per_sec")
+                if hr is not None:
+                    headroom = (headroom or 0.0) + float(hr)
+            if f.ok:
+                n_live += 1
+            qdepth += obs_fed.snapshot_scalar(
+                snap, "reporter_microbatch_queue_depth") or 0.0
+            for outcome in ("ok", "degraded"):
+                admitted += obs_fed.snapshot_total(
+                    snap, "reporter_requests_total",
+                    {"outcome": outcome}) or 0.0
+            shed += obs_fed.snapshot_total(
+                snap, "reporter_requests_total", {"outcome": "shed"}) or 0.0
+
+        rep = self.cost_ledger.report(self._expected_uptime(), price=price)
+        rep["t_unix"] = round(time.time(), 3)
+        path = os.path.join(self.workdir, "cost_ledger.json")
+        tmp = path + ".tmp"
+        with open(tmp, "w") as fh:
+            json.dump(rep, fh, indent=1)
+        os.replace(tmp, path)
+
+        if self._fleet_hist is None:
+            try:
+                os.makedirs(self.history_dir, exist_ok=True)
+                self._fleet_hist = econ.DemandHistory(
+                    os.path.join(self.history_dir, "fleet.jsonl"))
+            except OSError as e:
+                log.warning("fleet history disabled: %s", e)
+                return
+        admitted_rps = shed_rps = 0.0
+        if self._econ_prev is not None:
+            t0, a0, s0 = self._econ_prev
+            dt = max(1e-6, now - t0)
+            admitted_rps = max(0.0, admitted - a0) / dt
+            shed_rps = max(0.0, shed - s0) / dt
+        self._econ_prev = (now, admitted, shed)
+        offered = admitted_rps + shed_rps
+        self._fleet_hist.append({
+            "replica": "fleet",
+            "replicas_live": n_live,
+            "queue_depth": round(qdepth, 3),
+            "admitted_rps": round(admitted_rps, 4),
+            "shed_rps": round(shed_rps, 4),
+            "shed_fraction": (round(shed_rps / offered, 4)
+                              if offered > 0 else 0.0),
+            "headroom": (round(headroom, 4)
+                         if headroom is not None else None),
+            "chip_seconds_total": rep["totals"]["chip_seconds"],
+            "usd": rep["totals"]["usd"],
+        })
 
     # -- preemption re-home (docs/serving-fleet.md) --------------------------
 
@@ -411,6 +536,8 @@ class Fleet:
                     # first sight of this death: back off, re-home
                     rc = c.proc.returncode
                     uptime = now - c.t_spawn
+                    self._uptime[c.name] = (
+                        self._uptime.get(c.name, 0.0) + uptime)
                     delay = self.backoff.next_delay(c.name, uptime)
                     log.warning("%s died rc=%s after %.1fs; respawn in "
                                 "%.2fs", c.name, rc, uptime, delay)
@@ -508,6 +635,7 @@ class Fleet:
             self._scale_event(event="draining", direction="down",
                               replica=c.rid, url=c.url, reason=reason)
             rc = c.drain(self.args.drain_grace + 10.0)
+            self._bank_uptime(c)
             try:
                 _post_json(self.router.url + "/fleet",
                            {"remove": c.url, "reason": reason},

@@ -56,6 +56,14 @@ def _fmt(v, fmt="%d") -> str:
     return "-" if v is None else fmt % v
 
 
+def _fmt_age(v: Optional[float]) -> str:
+    """Snapshot age; the federator publishes a -1 sentinel for a feed it
+    has NEVER scraped — render that as "never", not a negative age."""
+    if v is None or v < 0:
+        return "never"
+    return "%.1f" % v
+
+
 def replica_ids(metrics: dict) -> List[str]:
     """Every replica id present in the federated scrape (from the
     staleness gauge, which exists for every feed — alive or not)."""
@@ -82,9 +90,15 @@ def render_frame(metrics: dict, prev: Optional[dict], statusz: dict,
     rows = {r.get("id") or r.get("url"): r
             for r in statusz.get("fleet", [])}
     lines.append("")
-    lines.append("replica        state      age_s  q  infl  deg  "
-                 "p50ms  p95ms  p99ms  req/s")
-    for rid in replica_ids(metrics) or sorted(rows):
+    ids = replica_ids(metrics) or sorted(rows)
+    # the replica column grows to the longest id so the layout never
+    # shears when an id exceeds the default width
+    w = max(14, max((len(r) for r in ids), default=0))
+    fmt = "%-" + str(w) + "s %-10s %6s %2s %5s %4s %6s %6s %6s %6s %7s %7s"
+    lines.append(fmt % ("replica", "state", "age_s", "q", "infl", "deg",
+                        "p50ms", "p95ms", "p99ms", "req/s", "$/Mpts",
+                        "headrm"))
+    for rid in ids:
         row = rows.get(rid, {})
         sel = {"replica": rid, "route": "report"}
         cur = hist_buckets(metrics, "reporter_slo_latency_seconds",
@@ -102,9 +116,10 @@ def render_frame(metrics: dict, prev: Optional[dict], statusz: dict,
         state = str(row.get("state") or "?")
         if stale:
             state += "*"  # * = snapshot stale (last numbers, not live)
-        lines.append("%-14s %-10s %5s %2s %5s %4s %6s %6s %6s %6s" % (
-            rid[:14], state[:10],
-            _fmt(age, "%.1f"),
+        econ = row.get("economics") or {}
+        lines.append(fmt % (
+            rid, state[:10],
+            _fmt_age(age),
             _fmt(row.get("queue_depth")),
             _fmt(row.get("inflight")),
             ("y" if row.get("degraded") else
@@ -112,7 +127,9 @@ def render_frame(metrics: dict, prev: Optional[dict], statusz: dict,
             _fmt_ms(hist_quantile(d, 0.50)),
             _fmt_ms(hist_quantile(d, 0.95)),
             _fmt_ms(hist_quantile(d, 0.99)),
-            _fmt(rate, "%.1f") if rate is not None else "-"))
+            _fmt(rate, "%.1f") if rate is not None else "-",
+            _fmt(econ.get("usd_per_million_points"), "%.2f"),
+            _fmt(econ.get("headroom_traces_per_sec"), "%.1f")))
     lines.append("")
     slo = statusz.get("slo") or {}
     verdict = "OK" if slo.get("ok") else "VIOLATING"
@@ -128,6 +145,18 @@ def render_frame(metrics: dict, prev: Optional[dict], statusz: dict,
     lines.append("masking debt: %s" % (
         "  ".join("%s=%.3f" % kv for kv in hot.items()) if hot
         else "0 (no replica burn hidden by failover)"))
+    # the economics line (docs/economics.md): what the fleet has SPENT
+    # and how much ceiling is left, from the router's federated roll-up
+    econ = statusz.get("economics") or {}
+    if econ:
+        lines.append(
+            "fleet cost: %s chip-s  $%s  %s/Mpts  headroom %s tr/s "
+            "(%s chips)" % (
+                _fmt(econ.get("chip_seconds_total"), "%.1f"),
+                _fmt(econ.get("usd"), "%.4f"),
+                _fmt(econ.get("usd_per_million_points"), "$%.2f"),
+                _fmt(econ.get("headroom_traces_per_sec"), "%.1f"),
+                _fmt(econ.get("chips"))))
     # the self-driving plane (docs/serving-fleet.md "Self-driving
     # fleet"): replica count, the adaptive hedge's live value, and the
     # most recent scale decision off the router's event ring

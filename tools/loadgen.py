@@ -44,6 +44,7 @@ Exit codes: 0 = objectives met (and server agrees, with --server-slo),
 from __future__ import annotations
 
 import argparse
+import bisect
 import json
 import os
 import random
@@ -332,12 +333,48 @@ def profile_rate_fn(profile: str, base_rate: float, duration: float):
       "flash:<f0>:<f1>:<m>" a flash crowd: --rate baseline, multiplied
                             by <m> between fractions <f0> and <f1> of
                             the duration (e.g. flash:0.3:0.7:5)
+      "schedule:<path>"     replay a RECORDED demand shape: a JSON file
+                            ({"points": [[t, mult], ...]}, what
+                            tools/demand_export.py writes from a
+                            /debug/history window) — the recorded span
+                            is stretched onto --duration and the
+                            multiplier piecewise-linearly interpolated
+                            against --rate
     """
     import math
 
     if profile == "diurnal":
         return lambda t: base_rate * (
             1.0 - 0.75 * math.cos(2.0 * math.pi * t / max(duration, 1e-9)))
+    if profile.startswith("schedule:"):
+        path = profile[len("schedule:"):]
+        try:
+            with open(path) as f:
+                spec = json.load(f)
+            pts = sorted((float(t), float(m)) for t, m in spec["points"])
+            assert pts and all(m >= 0 for _, m in pts)
+        except (OSError, ValueError, KeyError, TypeError, AssertionError):
+            raise ValueError(
+                "--profile schedule:<path> wants a JSON file with "
+                '{"points": [[t, mult], ...]} '
+                "(tools/demand_export.py writes one)") from None
+        span = pts[-1][0] - pts[0][0]
+        t_base = pts[0][0]
+        xs = [t - t_base for t, _ in pts]
+        ms = [m for _, m in pts]
+
+        def fn(t):
+            x = (t / max(duration, 1e-9)) * span if span > 0 else 0.0
+            i = bisect.bisect_right(xs, x)
+            if i <= 0:
+                return base_rate * ms[0]
+            if i >= len(xs):
+                return base_rate * ms[-1]
+            x0, x1 = xs[i - 1], xs[i]
+            w = (x - x0) / (x1 - x0) if x1 > x0 else 0.0
+            return base_rate * (ms[i - 1] + w * (ms[i] - ms[i - 1]))
+
+        return fn
     if profile.startswith("flash:"):
         try:
             _, f0, f1, mult = profile.split(":")
@@ -348,8 +385,8 @@ def profile_rate_fn(profile: str, base_rate: float, duration: float):
                              "with 0 <= f0 < f1 <= 1") from None
         t0, t1 = f0 * duration, f1 * duration
         return lambda t: base_rate * (mult if t0 <= t < t1 else 1.0)
-    raise ValueError("unknown --profile %r (diurnal | flash:f0:f1:mult)"
-                     % profile)
+    raise ValueError("unknown --profile %r (diurnal | flash:f0:f1:mult | "
+                     "schedule:path)" % profile)
 
 
 def profile_schedule(rate: float, duration: float, profile: str,
@@ -596,6 +633,38 @@ def fetch_json(url: str, timeout: float = 10.0) -> Optional[dict]:
     except Exception as e:  # noqa: BLE001 - surfaced in the artifact
         sys.stderr.write("loadgen: GET %s failed: %s\n" % (url, e))
         return None
+
+
+def cost_block(base: str) -> dict:
+    """The artifact's cost block (docs/economics.md): the server's own
+    chip-second ledger via GET /debug/cost, normalized to one header
+    whether the target is a single replica or the fleet router."""
+    rep = fetch_json(base + "/debug/cost")
+    if rep is None:
+        return {"source": "unavailable"}
+    if rep.get("scope") == "fleet":
+        f = rep.get("fleet") or {}
+        return {
+            "source": "server", "scope": "fleet",
+            "chips": f.get("chips"),
+            "chip_seconds": f.get("chip_seconds_total"),
+            "usd": f.get("usd"),
+            "points_total": f.get("points_total"),
+            "usd_per_million_points": f.get("usd_per_million_points"),
+            "headroom_traces_per_sec": f.get("headroom_traces_per_sec"),
+            "per_replica": rep.get("replicas"),
+        }
+    return {
+        "source": "server", "scope": "replica",
+        "chips": rep.get("chips"),
+        "price_per_chip_hour": rep.get("price_per_chip_hour"),
+        "chip_seconds": (rep.get("chip_seconds") or {}).get("total"),
+        "usd": rep.get("usd"),
+        "points_total": rep.get("points_total"),
+        "usd_per_million_points": rep.get("usd_per_million_points"),
+        "headroom_traces_per_sec": (rep.get("capacity") or {})
+        .get("headroom_traces_per_sec"),
+    }
 
 
 # -- main -------------------------------------------------------------------
@@ -926,6 +995,10 @@ def main(argv=None) -> int:
         },
         "ramp": steps_out if args.ramp else None,
         "knee_rps": knee if args.ramp else None,
+        # what this load COST: the serving side's own chip-second ledger
+        # (docs/economics.md) — every loadgen artifact carries it so a
+        # perf number is never quoted without its price
+        "cost": cost_block(base),
     }
     if args.dump_samples:
         with open(args.dump_samples, "w") as f:

@@ -19,8 +19,11 @@ judges it against LIKE-PROVENANCE history only:
 
 The judged metrics are ``points_per_sec`` (the work-normalised headline
 basis), ``vs_baseline`` (self-normalising on CPU, where absolute rates
-move with machine load), and ``kernel_points_per_sec`` when both sides
-carry it.  Noise awareness: the baseline is the like-provenance history
+move with machine load), ``kernel_points_per_sec`` when both sides
+carry it, and ``cost_usd_per_million_points`` (flattened from the
+artifact ``cost`` block, docs/economics.md) — the one LOWER-is-better
+metric: a run that got faster by burning disproportionately more chips
+fails on cost, judged against the same like-provenance median.  Noise awareness: the baseline is the like-provenance history
 MEDIAN, and the failure threshold is max(--threshold, the history's own
 relative spread) — two historical runs that disagree by 30% cannot
 justify failing a fresh run 15% below their median.
@@ -54,8 +57,17 @@ import sys
 # them and must stay judgeable.
 REQUIRED_KEYS = ("metric", "value", "unit", "platform")
 ATTRIB_KEYS = ("last_onchip", "attrib")
-# judged metrics: (key, how much history context it needs)
-METRICS = ("points_per_sec", "vs_baseline", "kernel_points_per_sec")
+# judged metrics -> the GOOD direction.  Throughput families regress
+# when they DROP; cost families (docs/economics.md — the chip-second
+# ledger's $-per-million-matched-points rides every artifact) regress
+# when they RISE.  Nested artifact cost blocks are flattened to the
+# ``cost_usd_per_million_points`` key by load_bench_line.
+METRICS = {
+    "points_per_sec": "higher",
+    "vs_baseline": "higher",
+    "kernel_points_per_sec": "higher",
+    "cost_usd_per_million_points": "lower",
+}
 
 # default relative-drop thresholds per provenance: CPU rates move with
 # machine load (bench-schema.md interpretation guardrails), so the CPU
@@ -84,6 +96,11 @@ def load_bench_line(path: str) -> dict:
     else:
         line = dict(d)
         line.setdefault("_rc", 0)
+    cost = line.get("cost")
+    if isinstance(cost, dict) and isinstance(
+            cost.get("usd_per_million_points"), (int, float)):
+        line.setdefault("cost_usd_per_million_points",
+                        cost["usd_per_million_points"])
     line["_path"] = path
     return line
 
@@ -152,7 +169,7 @@ def judge(candidate: dict, baselines: "list[dict]", threshold: float) -> dict:
     history's own relative spread widening the threshold."""
     comparisons = {}
     regressed = False
-    for key in METRICS:
+    for key, direction in METRICS.items():
         cv = candidate.get(key)
         hv = [h[key] for h in baselines if isinstance(h.get(key), (int, float))]
         if not isinstance(cv, (int, float)) or not hv:
@@ -162,9 +179,13 @@ def judge(candidate: dict, baselines: "list[dict]", threshold: float) -> dict:
         spread = (max(hv) - min(hv)) / med if med > 0 and len(hv) > 1 else 0.0
         tol = max(threshold, spread)
         ratio = cv / med if med > 0 else None
-        bad = ratio is not None and ratio < 1.0 - tol
+        if direction == "lower":
+            bad = ratio is not None and ratio > 1.0 + tol
+        else:
+            bad = ratio is not None and ratio < 1.0 - tol
         comparisons[key] = {
             "candidate": cv,
+            "direction": direction,
             "history_median": round(med, 3),
             "history_n": len(hv),
             "history_spread": round(spread, 3),
