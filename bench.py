@@ -777,6 +777,79 @@ def run_device() -> int:
     except Exception as e:  # noqa: BLE001 - diagnostics must not sink the bench
         _stderr("oracle comparison failed: %s" % (e,))
 
+    # streaming session leg (kind="session"; ROADMAP item 1's BENCH_r06
+    # session entry): a fleet of per-vehicle sessions streamed step by
+    # step through the SessionEngine with the device-resident arena on —
+    # the serving entrypoint's configuration — so the artifact carries
+    # per-point step latency, session throughput, and the arena-residency
+    # sizing signal (sessions_resident_per_chip) next to the batch
+    # numbers.  The readback counter is sampled across the timed window:
+    # a steady-state packed step performs zero per-step host<->device
+    # beam transfers, so the delta must stay 0 (docs/performance.md
+    # "Device-resident session arenas").  BENCH_SESSION=0 skips the leg.
+    session_bench = None
+    if os.environ.get("BENCH_SESSION", "1").lower() not in (
+            "0", "false", "no", "off"):
+        try:
+            from reporter_tpu.matching.session import (
+                SessionEngine, SessionStore)
+
+            _write_status(phase="benching", step="session", platform=platform)
+            n_veh = int(os.environ.get("BENCH_SESSION_VEHICLES", "256"))
+            step_pts = int(os.environ.get("BENCH_SESSION_STEP_POINTS", "4"))
+            scfg = MatcherConfig(viterbi_kernel=primary_kernel,
+                                 session_arena=True)
+            sm = SegmentMatcher(arrays=arrays, ubodt=ubodt, config=scfg)
+            store = SessionStore(max_sessions=scfg.max_sessions)
+            eng = SessionEngine(sm, store,
+                                tail_points=scfg.session_tail_points)
+            smo = {"mode": "auto", "report_levels": [0, 1],
+                   "transition_levels": [0, 1]}
+            # vehicles ride the short cohort's traces, tiled to n_veh
+            short = [s.trace for s in cohorts[0][2]]
+            fleet = [dict(short[i % len(short)], uuid="bench-sess-%d" % i)
+                     for i in range(n_veh)]
+            pts = min(len(t["trace"]) for t in fleet)
+            rounds = pts // step_pts
+            arena = getattr(sm, "session_arena", None)
+
+            def _round(j):
+                eng.match_many([
+                    {"uuid": t["uuid"],
+                     "trace": t["trace"][j * step_pts:(j + 1) * step_pts],
+                     "match_options": smo} for t in fleet])
+
+            _round(0)  # compile + upload round, outside the timed window
+            rb0 = arena.readbacks if arena is not None else None
+            t0 = time.time()
+            for j in range(1, rounds):
+                _round(j)
+            secs = time.time() - t0
+            timed = rounds - 1
+            devs = max(1, getattr(scfg, "devices", 1))
+            tiers = (arena.tier_counts() if arena is not None
+                     else {"hot": 0, "cold": 0})
+            resident = tiers["hot"] + tiers["cold"]
+            session_bench = {
+                "vehicles": n_veh,
+                "rounds": timed,
+                "step_points": step_pts,
+                "traces_per_sec": round(n_veh * timed / secs, 1),
+                "points_per_sec": round(n_veh * timed * step_pts / secs, 1),
+                "step_latency_ms_per_vehicle": round(
+                    secs / (n_veh * timed) * 1e3, 4),
+                "step_latency_us_per_point": round(
+                    secs / (n_veh * timed * step_pts) * 1e6, 2),
+                "sessions_resident_per_chip": round(resident / devs, 1),
+                "tiers": tiers,
+                "steady_readbacks": (arena.readbacks - rb0
+                                     if arena is not None else None),
+                "arena": arena.summary() if arena is not None else None,
+            }
+            _stderr("session leg: %s" % (session_bench,))
+        except Exception as e:  # noqa: BLE001 - the leg must not sink the bench
+            _stderr("session leg failed: %s" % (e,))
+
     print(json.dumps({
         "platform": platform,
         "acquire_s": round(acquire_s, 1),
@@ -816,6 +889,10 @@ def run_device() -> int:
             ubodt.packed.shape[0] * ubodt.bucket_entries, 1), 3),
         "ubodt_max_probes": ubodt.max_probes,
         "ubodt_max_kicks": int(ubodt.max_kicks),
+        "session": session_bench,
+        "sessions_resident_per_chip": (
+            session_bench["sessions_resident_per_chip"]
+            if session_bench else None),
         "cost": _cost_block(pps, getattr(matcher.cfg, "devices", 1)),
         "memory": _memory_block(matcher),
     }))
@@ -1351,7 +1428,8 @@ def main() -> int:
               "oracle_cmp", "agreement_by_cohort", "device_mb",
               "fleet", "scenario", "edges", "ubodt_rows", "ubodt_layout",
               "ubodt_load", "ubodt_max_probes",
-              "ubodt_max_kicks", "cost", "memory"):
+              "ubodt_max_kicks", "session", "sessions_resident_per_chip",
+              "cost", "memory"):
         if k in device_json:
             out[k] = device_json[k]
     out.update({k: baseline_json[k] for k in

@@ -97,6 +97,21 @@ class MatcherConfig:
     session_tail_points: int = 64
     max_sessions: int = 65536
     session_ttl_s: float = 3600.0
+    # device-resident session arena (docs/performance.md "Device-resident
+    # session arenas"): carried Viterbi beams live in a hot HBM slab (+
+    # pinned_host cold pages), so a packed session step gathers/scatters
+    # by slot index in ONE donated in-place dispatch — zero per-step
+    # host<->device beam transfers.  Off by default (library callers and
+    # the bit-exact differential suites see the host-carry wire output
+    # unchanged); the serve entrypoint turns it on
+    # ($REPORTER_SESSION_ARENA=0 reverts bit-for-bit).
+    # session_arena_bytes sizes the hot slab (0 = a max_sessions-sized
+    # slab); session_arena_cold_bytes bounds the pinned_host cold tier
+    # (0 = 4x the hot capacity).  $REPORTER_SESSION_ARENA[_BYTES,
+    # _COLD_BYTES] override.
+    session_arena: bool = False
+    session_arena_bytes: int = 0
+    session_arena_cold_bytes: int = 0
     # sparse-gap matching model (docs/match-quality.md "Sparse gaps";
     # ROADMAP open item 4): traces whose MEDIAN inter-point gap is at/
     # above sparse_gap_s dispatch through the time-adaptive "sparse"

@@ -227,6 +227,21 @@ class SegmentMatcher:
             self.cfg, arrays.cell_size,
             mesh=(max(1, int(getattr(self.cfg, "devices", 1))) > 1
                   or max(1, int(getattr(self.cfg, "graph_devices", 1))) > 1))
+        # device-resident session arena (docs/performance.md
+        # "Device-resident session arenas"): carried session beams live
+        # in a hot HBM slab (+ pinned_host cold pages) and the packed
+        # step gathers/scatters by slot with the slab donated — zero
+        # per-step host<->device beam transfers.  Off by default (the
+        # host-carry wire output is the differential reference); the
+        # serve entrypoint enables it ($REPORTER_SESSION_ARENA=0
+        # reverts bit-for-bit).
+        env_ar = os.environ.get("REPORTER_SESSION_ARENA", "").strip().lower()
+        if env_ar:
+            self._session_arena_on = env_ar not in ("0", "false", "off", "no")
+        else:
+            self._session_arena_on = bool(
+                getattr(self.cfg, "session_arena", False))
+        self.session_arena = None
         # route-consistent interpolation default (per-request
         # match_options.interpolate overrides either way)
         env_ip = os.environ.get("REPORTER_INTERPOLATE", "").strip().lower()
@@ -350,6 +365,36 @@ class SegmentMatcher:
             self._dg = jax.device_put(self._dg, repl)
             self._du = jax.device_put(self._du, du_sharding)
             self._params = jax.device_put(self._params, repl)
+        # device-resident session arena: mutually exclusive with a device
+        # mesh (carried beams shard over dp; the arena is the
+        # single-replica HBM-residency lever, like UBODT tiering)
+        if self._session_arena_on:
+            if self._mesh is not None:
+                log.warning(
+                    "REPORTER_SESSION_ARENA ignored: the session arena "
+                    "does not compose with a device mesh (cfg.devices=%d, "
+                    "graph_devices=%d)", self.cfg.devices, self._n_gp)
+            else:
+                from .arena import SessionArena
+
+                env_b = os.environ.get(
+                    "REPORTER_SESSION_ARENA_BYTES", "").strip()
+                env_cb = os.environ.get(
+                    "REPORTER_SESSION_ARENA_COLD_BYTES", "").strip()
+                try:
+                    hot_b = int(env_b) if env_b else int(
+                        getattr(self.cfg, "session_arena_bytes", 0) or 0)
+                    cold_b = int(env_cb) if env_cb else int(
+                        getattr(self.cfg, "session_arena_cold_bytes", 0)
+                        or 0)
+                except ValueError:
+                    raise ValueError(
+                        "REPORTER_SESSION_ARENA_BYTES/_COLD_BYTES must be "
+                        "integer byte counts, got %r/%r" % (env_b, env_cb))
+                self.session_arena = SessionArena(
+                    self.cfg.beam_k, hot_b, cold_b,
+                    max_sessions=int(
+                        getattr(self.cfg, "max_sessions", 65536)))
         # all forwards speak the packed transport: one [4, B, T] f32 array in,
         # one [3, B, T] i32 array out (ops/viterbi.pack_inputs/pack_compact).
         # Each host<->device crossing pays a fixed dispatch/sync cost (~73 ms
@@ -392,6 +437,33 @@ class SegmentMatcher:
         key = (kind, kernel, qa)
         fn = self._jits.get(key)
         if fn is None:
+            if kind in ("arena_session", "sparse_arena_session"):
+                # the device-resident session-arena step: the carry slab
+                # rides as a DONATED argument, so the scatter is in-place
+                # — one dispatch, zero per-step beam transfers.  Never
+                # built on a mesh (the arena is disabled there).
+                if self._mesh is not None:
+                    raise RuntimeError(
+                        "arena session kinds do not compose with a device "
+                        "mesh (the session arena should be disabled)")
+                import functools
+
+                import jax
+
+                from ..ops.viterbi import (
+                    session_step_arena, session_step_arena_sparse,
+                )
+
+                if kind == "arena_session":
+                    self._jits[key] = jax.jit(
+                        functools.partial(session_step_arena, kernel=kernel),
+                        static_argnums=(4,), donate_argnums=(5,))
+                else:
+                    self._jits[key] = jax.jit(
+                        functools.partial(
+                            session_step_arena_sparse, kernel=kernel),
+                        static_argnums=(5,), donate_argnums=(6,))
+                return self._jits[key]
             if kind.startswith("sparse"):
                 # mesh deployments disable the model at construction; a
                 # sparse kind reaching a gp mesh is a programming error
@@ -1759,10 +1831,26 @@ class SegmentMatcher:
                         self._n_dp - px.shape[0] % self._n_dp,
                         px, py, tm, valid)
                 b_pad = px.shape[0]
-                carry = self._carry_batch(
-                    [items[i]["carry"] for i in sub]
-                    + [None] * (b_pad - len(sub)), b_pad)
                 kernel = self._kernel_for(W)
+                arena = self.session_arena
+                if arena is not None and all(
+                        "uuid" in items[i] for i in sub):
+                    h = self._dispatch_session_arena(
+                        arena, items, sub, ns, pkey, slabel, kernel,
+                        (px, py, tm, valid), b_pad, W)
+                    if h is not None:
+                        handles.append(h)
+                        continue
+                # host-carry path: the arena is off, disabled for this
+                # group (no uuids — direct library callers), or the group
+                # exceeds the hot slab (arena smaller than one beam
+                # page).  carry_host normalises any arena refs captured
+                # in the items (a counted readback — the fallback seam)
+                from .arena import carry_host
+
+                carry = self._carry_batch(
+                    [carry_host(items[i]["carry"]) for i in sub]
+                    + [None] * (b_pad - len(sub)), b_pad)
                 xin = self._put_packed(pack_inputs(px, py, tm, valid))
                 if slabel:
                     # sparse streaming step: the time-adaptive model with
@@ -1819,6 +1907,33 @@ class SegmentMatcher:
                         out[i] = ((edge[row, :n], offset[row, :n],
                                    breaks[row, :n]), None, None)
                     continue
+                if h[0] == "jax_arena":
+                    # the carried beams stayed on device: the answer is
+                    # the packed result + a slot handle per session — no
+                    # carry readback on the finish side
+                    _kind, sub, ns, packed, aux, refs = h
+                    edge, offset, breaks = unpack_compact(packed)
+                    aux_np = np.asarray(aux)
+                    for row, i in enumerate(sub):
+                        n = ns[row]
+                        out[i] = ((edge[row, :n], offset[row, :n],
+                                   breaks[row, :n]), aux_np[row], refs[row])
+                    continue
+                if h[0] == "chain_arena":
+                    _kind, i, chunk_outs, ref = h
+                    E, O, B, aux_rows = [], [], [], []
+                    for packed, aux_dev, nc in chunk_outs:
+                        e_, o_, b_ = unpack_compact(packed)
+                        E.append(e_[0, :nc])
+                        O.append(o_[0, :nc])
+                        B.append(b_[0, :nc])
+                        aux_rows.append(np.asarray(aux_dev)[0])
+                    aux = np.concatenate([
+                        [min(r[0] for r in aux_rows)],
+                        np.sum([r[1:] for r in aux_rows], axis=0)])
+                    out[i] = ((np.concatenate(E), np.concatenate(O),
+                               np.concatenate(B)), aux, ref)
+                    continue
                 if h[0] == "chain":
                     _kind, i, chunk_outs, carry_out = h
                     E, O, B, aux_rows = [], [], [], []
@@ -1864,6 +1979,113 @@ class SegmentMatcher:
             return ""
         return self.sparse.label_for_times(times) or ""
 
+    def _dispatch_session_arena(self, arena, items, sub, ns, pkey,
+                                slabel, kernel, arrays, b_pad: int, W: int):
+        """One session group through the device-resident arena
+        (docs/performance.md "Device-resident session arenas"): resolve
+        each session to a hot slot, then ONE donated in-place dispatch of
+        ops/viterbi.session_step_arena — the beams never cross the
+        interconnect.  Returns the dispatch handle, or None when the
+        group cannot fit the hot slab at once (caller falls back to the
+        host-carry path, bit-identical either way).  Acquire, dispatch
+        and slab swap run under ONE arena lock section: the old slab is
+        donated the instant the step enqueues, so no concurrent reader
+        may see it."""
+        from ..ops.viterbi import pack_inputs
+
+        px, py, tm, valid = arrays
+        with arena.lock:
+            acq = arena.acquire_batch(
+                [(str(items[i]["uuid"]), items[i].get("carry"))
+                 for i in sub])
+            if acq is None:
+                return None
+            slot_l, use_l, refs = acq
+            # padding rows carry slot == hot_slots: the gather clamps
+            # them in-bounds, the mode="drop" scatter discards them
+            slots = np.full(b_pad, arena.hot_slots, np.int32)
+            slots[: len(sub)] = slot_l
+            use = np.zeros(b_pad, bool)
+            use[: len(sub)] = use_l
+            xin = self._put_packed(pack_inputs(px, py, tm, valid))
+            t0 = _time.monotonic()
+            if slabel:
+                p, sp, _k_sp = self.sparse.params_for(slabel, pkey)
+                fn = self._get_jit("sparse_arena_session", kernel)
+                C_SPARSE_DISPATCH.labels(slabel).inc(len(sub))
+                packed, aux, slab_out = fn(
+                    self._dg, self._du, xin, p, sp, self.cfg.beam_k,
+                    arena.hot, slots, use)
+                cohort, kindname = "sparse", "sparse_arena_session"
+            else:
+                p = self._params_for(pkey)
+                fn = self._get_jit("arena_session", kernel)
+                packed, aux, slab_out = fn(
+                    self._dg, self._du, xin, p, self.cfg.beam_k,
+                    arena.hot, slots, use)
+                cohort, kindname = "step", "arena_session"
+            arena.swap_hot(slab_out)
+        C_DISPATCHES.labels(kernel).inc()
+        C_DISPATCH_COHORT.labels("session", cohort).inc()
+        # fn=None: the attrib probe re-executes registered programs,
+        # which would consume an already-donated slab
+        self._note_dispatch((b_pad, W), _time.monotonic() - t0,
+                            kind=kindname, kernel=kernel)
+        self._start_host_copy(packed)
+        return ("jax_arena", sub, ns, packed, aux, refs)
+
+    def _dispatch_session_chain_arena(self, item, idx: int, W: int,
+                                      slabel: str = ""):
+        """The over-bucket (rebuild-from-replay / fat-delta) step with
+        the arena on: the carry chains IN PLACE through one hot slot —
+        every chunk gathers the previous chunk's scattered successor, so
+        the whole chain performs zero beam transfers and lands the final
+        beam already resident."""
+        from ..ops.viterbi import pack_inputs
+
+        arena = self.session_arena
+        pts = item["points"]
+        kernel = self._kernel_for(W)
+        sp = None
+        if slabel:
+            p, sp, _k_sp = self.sparse.params_for(slabel, item["pkey"])
+            fn = self._get_jit("sparse_arena_session", kernel)
+            C_SPARSE_DISPATCH.labels(slabel).inc()
+        else:
+            p = self._params_for(item["pkey"])
+            fn = self._get_jit("arena_session", kernel)
+        kindname = "sparse_arena_session" if slabel else "arena_session"
+        chunk_outs = []
+        with arena.lock:
+            acq = arena.acquire_batch(
+                [(str(item["uuid"]), item.get("carry"))])
+            (slot,), (use0,), (ref,) = acq
+            slots = np.asarray([slot], np.int32)
+            use = np.asarray([use0], bool)
+            for c0 in range(0, len(pts), W):
+                chunk = dict(item, points=pts[c0 : c0 + W])
+                px, py, tm, valid, ns = self._fill_session_rows(
+                    [chunk], [0], W)
+                xin = self._put_packed(pack_inputs(px, py, tm, valid))
+                t0 = _time.monotonic()
+                if sp is not None:
+                    packed, aux, slab_out = fn(
+                        self._dg, self._du, xin, p, sp, self.cfg.beam_k,
+                        arena.hot, slots, use)
+                else:
+                    packed, aux, slab_out = fn(
+                        self._dg, self._du, xin, p, self.cfg.beam_k,
+                        arena.hot, slots, use)
+                arena.swap_hot(slab_out)
+                use = np.asarray([True], bool)
+                C_DISPATCHES.labels(kernel).inc()
+                C_DISPATCH_COHORT.labels("session", "chain").inc()
+                self._note_dispatch((1, W), _time.monotonic() - t0,
+                                    kind=kindname, kernel=kernel)
+                chunk_outs.append((packed, aux, ns[0]))
+        self._start_host_copy(chunk_outs[-1][0])
+        return ("chain_arena", idx, chunk_outs, ref)
+
     def _dispatch_session_chain(self, item, idx: int, W: int,
                                 slabel: str = ""):
         """One over-bucket session step as a carry chain of [B, W]
@@ -1875,10 +2097,15 @@ class SegmentMatcher:
         the carry chains on device."""
         from ..ops.viterbi import pack_inputs
 
+        from .arena import carry_host
+
+        if self.session_arena is not None and "uuid" in item:
+            return self._dispatch_session_chain_arena(item, idx, W,
+                                                      slabel=slabel)
         pts = item["points"]
         b_pad = max(1, self._n_dp)
         carry = self._carry_batch(
-            [item["carry"]] + [None] * (b_pad - 1), b_pad)
+            [carry_host(item["carry"])] + [None] * (b_pad - 1), b_pad)
         sp = None
         if slabel:
             p, sp, _k_sp = self.sparse.params_for(slabel, item["pkey"])
@@ -2020,10 +2247,22 @@ class SegmentMatcher:
                 pts = _dummy_traces(max(2, w), 1)[0]["trace"][:w]
                 for b in batch_sizes:
                     b = self._ladder_rung(max(1, int(b)))
-                    self.match_sessions([
+                    warm_items = [
                         {"points": pts, "carry": None,
                          "t0": float(pts[0]["time"]), "pkey": ()}
-                    ] * b)
+                        for _ in range(b)
+                    ]
+                    if self.session_arena is not None:
+                        # distinct uuids route through the arena program
+                        # (the serving path); throwaway slots freed
+                        # without a detach readback
+                        for j, it in enumerate(warm_items):
+                            it["uuid"] = "_warmup%d" % j
+                    self.match_sessions(warm_items)
+                    if self.session_arena is not None:
+                        for j in range(b):
+                            self.session_arena.free_uuid(
+                                "_warmup%d" % j, detach=False)
                     n_shapes += 1
                     C_WARM_SHAPES.labels(kern).inc()
         dt = _time.time() - t0

@@ -56,6 +56,7 @@ import numpy as np
 from .. import faults
 from ..obs import log as obs_log
 from ..obs import metrics as obs
+from .arena import carry_free, carry_host
 
 log = logging.getLogger(__name__)
 
@@ -141,10 +142,13 @@ class SessionState:
     def to_wire(self) -> dict:
         """JSON-able snapshot.  Carry floats ride as Python floats (f32 ->
         f64 -> f32 is an exact round trip), so a handed-off beam continues
-        bit-exact on the inheriting replica."""
+        bit-exact on the inheriting replica.  A device-resident carry
+        (an arena ref, docs/performance.md "Device-resident session
+        arenas") reads back exactly its own slot here — the counted
+        checkpoint/export/drain readback."""
         carry = None
-        if self.carry is not None:
-            c = self.carry
+        c = carry_host(self.carry)
+        if c is not None:
             carry = {
                 "scores": [float(v) for v in c["scores"]],
                 "edge": [int(v) for v in c["edge"]],
@@ -251,7 +255,7 @@ class SessionStore:
         dead = [u for u, s in self._by_uuid.items()
                 if now - s.last_used > self.ttl_s]
         for u in dead:
-            del self._by_uuid[u]
+            carry_free(self._by_uuid.pop(u).carry)
             C_SESSION_EVENTS.labels("expired").inc()
         if dead:
             G_SESSIONS.set(len(self._by_uuid))
@@ -272,8 +276,10 @@ class SessionStore:
                 return s
             if s is not None:  # params changed: restart the decode
                 del self._by_uuid[uuid]
+                carry_free(s.carry)
             while len(self._by_uuid) >= self.max_sessions:
-                self._by_uuid.popitem(last=False)
+                _u, _s = self._by_uuid.popitem(last=False)
+                carry_free(_s.carry)
                 C_SESSION_EVENTS.labels("evicted").inc()
             s = SessionState(uuid, t0, pkey)
             self._by_uuid[uuid] = s
@@ -290,6 +296,7 @@ class SessionStore:
             s = self._by_uuid.pop(uuid, None)
             G_SESSIONS.set(len(self._by_uuid))
         if s is not None:
+            carry_free(s.carry)
             self._notify_removed(uuid)
         return s is not None
 
@@ -305,6 +312,11 @@ class SessionStore:
             for u in uuids:
                 s = self._by_uuid.pop(str(u), None)
                 if s is not None:
+                    # an arena-resident beam detaches first (one counted
+                    # readback); the wire read below then sees exactly
+                    # the detached bytes, and the slot is free for the
+                    # sessions staying behind
+                    carry_free(s.carry)
                     out.append(s.to_wire())
             G_SESSIONS.set(len(self._by_uuid))
         for w in out:
@@ -402,7 +414,8 @@ class SessionStore:
                     C_SESSION_EVENTS.labels("import_merged").inc()
                     continue
                 while len(self._by_uuid) >= self.max_sessions:
-                    self._by_uuid.popitem(last=False)
+                    _u, _s = self._by_uuid.popitem(last=False)
+                    carry_free(_s.carry)
                     C_SESSION_EVENTS.labels("evicted").inc()
                 s.last_used = now
                 self._by_uuid[s.uuid] = s
@@ -455,7 +468,10 @@ class SessionStore:
             for s in self._by_uuid.values():
                 total += 17 * len(s.records) + 24 * len(s.replay)
                 c = s.carry
-                if c is not None:
+                # arena-resident carries (refs) are accounted by the
+                # arena's own memory rows (economics publish_memory), not
+                # as host store bytes
+                if isinstance(c, dict):
                     for key in ("scores", "edge", "offset"):
                         arr = c.get(key)
                         nb = getattr(arr, "nbytes", None)
@@ -589,6 +605,9 @@ class SessionEngine:
                 "carry": None if rebuild else sess.carry,
                 "t0": sess.t0,
                 "pkey": ent["pkey"],
+                # the arena dispatch path keys hot slots by uuid; the
+                # host-carry matcher ignores it
+                "uuid": ent["uuid"],
             })
         entries = list(order.values())
         H_STEP_SESSIONS.observe(len(entries))
@@ -664,8 +683,18 @@ class SessionEngine:
                           tail_points=len(win_recs),
                           rebuilt=bool(ent["rebuild"])))
 
-        # commit the session (success only: a raised step never lands here)
+        # commit the session (success only: a raised step never lands
+        # here).  An old arena slot is freed when the new carry no longer
+        # covers it (a fallback step returned a host dict) — but NOT when
+        # the step scattered into the same uuid's slot (the usual arena
+        # path: the ref is stable and the slot holds the successor).
+        old_carry = sess.carry
         sess.carry = carry_out
+        if (old_carry is not None and old_carry is not carry_out
+                and not isinstance(old_carry, dict)
+                and getattr(carry_out, "uuid", None)
+                != getattr(old_carry, "uuid", "")):
+            carry_free(old_carry)
         sess.records = tail_recs + new_recs
         sess.replay = tail_raw + [
             {"lat": p["lat"], "lon": p["lon"], "time": p["time"]}
@@ -770,6 +799,7 @@ class SessionEngine:
         # chain), beam invalidated for a replay rebuild
         sess.replay = win_raw
         sess.records = []
+        carry_free(sess.carry)
         sess.carry = None
         sess.rebuild_pending = True
         sess.trim(self.tail_points)

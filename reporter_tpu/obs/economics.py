@@ -112,8 +112,11 @@ G_MEMORY = obs.gauge(
     ("space", "subsystem"))
 G_SESS_PER_CHIP = obs.gauge(
     "reporter_sessions_resident_per_chip",
-    "Open streaming sessions divided by billed devices (the session-"
-    "arena sizing signal ROADMAP item 2 names)")
+    "Open streaming sessions divided by billed devices, by residency "
+    "tier: hot = device-slab slots, cold = pinned_host pages, host = "
+    "wire-form carries in the SessionStore (the session-arena sizing "
+    "signal ROADMAP item 2 names)",
+    ("tier",))
 
 
 def _env_num(name: str, default: float) -> float:
@@ -623,7 +626,11 @@ class EconomicsEngine:
         self.capacity.publish()
         chips = self.ledger.chips
         if s.get("sessions") is not None:
-            G_SESS_PER_CHIP.set(float(s["sessions"]) / max(1, chips))
+            tiers = s.get("session_tiers") or {"hot": 0, "cold": 0,
+                                               "host": s["sessions"]}
+            for tier in ("hot", "cold", "host"):
+                G_SESS_PER_CHIP.labels(tier).set(
+                    float(tiers.get(tier) or 0) / max(1, chips))
         offered = admitted_rate + shed_rate
         record = {
             "t": round(self._wall(), 3),
@@ -726,6 +733,17 @@ def publish_memory(matcher=None, session_store=None) -> None:
         try:
             G_MEMORY.labels("host", "sessions").set(
                 float(session_store.resident_bytes()))
+        except Exception:  # noqa: BLE001
+            pass
+    arena = (getattr(matcher, "session_arena", None)
+             if matcher is not None else None)
+    if arena is not None:
+        try:
+            asum = arena.summary()
+            G_MEMORY.labels("device", "session_arena_hot").set(
+                float(asum.get("hot_bytes") or 0.0))
+            G_MEMORY.labels("host", "session_arena_cold").set(
+                float(asum.get("cold_bytes") or 0.0))
         except Exception:  # noqa: BLE001
             pass
 
@@ -837,7 +855,8 @@ def memory_summary(matcher=None, session_store=None) -> dict:
     for lv, child in G_MEMORY._items():
         out[".".join(lv)] = child.value
     if session_store is not None:
-        out["sessions_resident"] = G_SESS_PER_CHIP.value
+        out["sessions_resident"] = sum(
+            child.value for _lv, child in G_SESS_PER_CHIP._items())
     return out
 
 

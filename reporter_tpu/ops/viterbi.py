@@ -998,6 +998,52 @@ def session_step_packed(dg: DeviceGraph, du: DeviceUBODT, xin,
     return pack_compact(_compact(res)), res.aux, carry_out
 
 
+def session_step_arena(dg: DeviceGraph, du: DeviceUBODT, xin,
+                       p: MatchParams, k: int, slab: TraceCarry,
+                       slots: jnp.ndarray, use_carry: jnp.ndarray,
+                       kernel: str = "scan"):
+    """session_step_packed against a device-resident carry slab
+    (docs/performance.md "Device-resident session arenas"): instead of
+    uploading a [B, K] carry batch and reading the successor back every
+    step, the carried beams live in an [S]-slot arena pytree that stays
+    on device across steps.  The step gathers each row's beam by slot
+    index, runs the identical per-row recursion, and scatters the
+    successor beams back in place — with the slab donated
+    (``donate_argnums``) the whole step is ONE dispatch whose only
+    host↔device traffic is the packed inputs in and the match results
+    out; the beams never cross the interconnect.
+
+    ``slots`` is [B] i32 arena rows (padding rows carry slot == S, which
+    the gather clamps and the ``mode="drop"`` scatter discards), and
+    ``use_carry`` is [B] bool — False rows decode from the inactive
+    carry exactly like a fresh session, so a slot's stale contents
+    cannot leak into a rebuilt stream.  Dispatchers must pass each live
+    slot at most once per step (the SessionEngine folds a batch to one
+    row per session), keeping the scatter well-defined.  Gather/scatter
+    moves f32/i32 leaves verbatim, so outputs are bit-identical to the
+    host-carry path — the arena differential suite pins that."""
+    import functools
+
+    px, py, times, valid = unpack_inputs(xin)
+    s_cap = slab.scores.shape[0]
+    idx = jnp.minimum(slots, s_cap - 1)
+    gathered = jax.tree_util.tree_map(lambda a: a[idx], slab)
+    inact = initial_carry_batch(px.shape[0], k)
+    use = use_carry
+
+    def _sel(g, i):
+        return jnp.where(use.reshape((-1,) + (1,) * (g.ndim - 1)), g, i)
+
+    carry = jax.tree_util.tree_map(_sel, gathered, inact)
+    fn = functools.partial(match_trace, kernel=kernel)
+    res, carry_out = jax.vmap(
+        fn, in_axes=(None, None, 0, 0, 0, 0, None, None, 0)
+    )(dg, du, px, py, times, valid, p, k, carry)
+    slab_out = jax.tree_util.tree_map(
+        lambda s, c: s.at[slots].set(c, mode="drop"), slab, carry_out)
+    return pack_compact(_compact(res)), res.aux, slab_out
+
+
 # -- sparse-gap packed entry points -------------------------------------------
 #
 # The sparse-gap matching model (docs/match-quality.md "Sparse gaps") rides
@@ -1069,6 +1115,36 @@ def session_step_packed_sparse(dg: DeviceGraph, du: DeviceUBODT, xin,
         fn, in_axes=(None, None, 0, 0, 0, 0, None, None, 0)
     )(dg, du, px, py, times, valid, p, k, carry)
     return pack_compact(_compact(res)), res.aux, carry_out
+
+
+def session_step_arena_sparse(dg: DeviceGraph, du: DeviceUBODT, xin,
+                              p: MatchParams, sp: SparseParams, k: int,
+                              slab: TraceCarry, slots: jnp.ndarray,
+                              use_carry: jnp.ndarray, kernel: str = "scan"):
+    """session_step_arena under the sparse model: the device-resident
+    slab step for sparse cohorts, gap-conditioned exactly like
+    session_step_packed_sparse.  Same gather → decode → in-place scatter
+    contract; the slab is donated by the dispatcher."""
+    import functools
+
+    px, py, times, valid = unpack_inputs(xin)
+    s_cap = slab.scores.shape[0]
+    idx = jnp.minimum(slots, s_cap - 1)
+    gathered = jax.tree_util.tree_map(lambda a: a[idx], slab)
+    inact = initial_carry_batch(px.shape[0], k)
+    use = use_carry
+
+    def _sel(g, i):
+        return jnp.where(use.reshape((-1,) + (1,) * (g.ndim - 1)), g, i)
+
+    carry = jax.tree_util.tree_map(_sel, gathered, inact)
+    fn = functools.partial(match_trace, kernel=kernel, sp=sp)
+    res, carry_out = jax.vmap(
+        fn, in_axes=(None, None, 0, 0, 0, 0, None, None, 0)
+    )(dg, du, px, py, times, valid, p, k, carry)
+    slab_out = jax.tree_util.tree_map(
+        lambda s, c: s.at[slots].set(c, mode="drop"), slab, carry_out)
+    return pack_compact(_compact(res)), res.aux, slab_out
 
 
 def initial_carry_batch(b: int, k: int) -> TraceCarry:

@@ -27,6 +27,22 @@ from .service import ReporterService, build_matcher, parse_service_config
 
 
 def main(argv):
+    # the serve-entrypoint env defaults below must not outlive main(): an
+    # in-process caller (tests drive the CLI error paths directly) would
+    # otherwise leak serving defaults into library-default code, silently
+    # flipping e.g. the session arena on for every matcher built after
+    # (the serving process itself never notices — it lives inside main()).
+    _env_defaulted = [k for k in ("REPORTER_QUALITY_AUX", "REPORTER_SPARSE",
+                                  "REPORTER_SESSION_ARENA")
+                      if k not in os.environ]
+    try:
+        return _main(argv)
+    finally:
+        for k in _env_defaulted:
+            os.environ.pop(k, None)
+
+
+def _main(argv):
     # the shared log switch (REPORTER_LOG_FORMAT=json|text,
     # REPORTER_LOG_LEVEL) + the flight recorder's SIGTERM/fatal disk dump
     obs_log.configure()
@@ -48,6 +64,15 @@ def main(argv):
     # default of off; an explicit REPORTER_SPARSE=0 reverts the serving
     # path bit-for-bit to the dense model.
     os.environ.setdefault("REPORTER_SPARSE", "1")
+    # device-resident session arenas default ON for the serving entrypoint
+    # (docs/performance.md "Device-resident session arenas"): carried
+    # Viterbi beams stay in a hot device slab between streaming submits,
+    # so a packed session step is one donated in-place dispatch with zero
+    # per-step host<->device beam transfers.  Library callers and the
+    # bit-exact differential suites keep the config default of off; an
+    # explicit REPORTER_SESSION_ARENA=0 reverts the serving path
+    # bit-for-bit to the host-carried wire form.
+    os.environ.setdefault("REPORTER_SESSION_ARENA", "1")
     # conf path: positional arg, else $MATCHER_CONF_FILE — the reference's
     # container default (README.md Env Var Overrides: MATCHER_CONF_FILE).
     # With the env set, the single positional may be the bind address.

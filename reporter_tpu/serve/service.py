@@ -1417,7 +1417,24 @@ class ReporterService:
             "max_burn": max_burn,
             "sessions": (self.session_store.summary()["sessions"]
                          if self.session_store is not None else None),
+            "session_tiers": self._session_tiers(),
         }
+
+    def _session_tiers(self) -> Optional[dict]:
+        """Per-tier resident-session counts for the economics tick: hot/
+        cold straight from the arena's slot maps, host = everything the
+        store carries that is not device-resident (wire-form carries,
+        arena-off deployments).  None when no session plane exists."""
+        if self.session_store is None:
+            return None
+        total = self.session_store.summary()["sessions"]
+        arena = (getattr(self.matcher, "session_arena", None)
+                 if self.matcher is not None else None)
+        if arena is None:
+            return {"hot": 0, "cold": 0, "host": total}
+        t = arena.tier_counts()
+        return {"hot": t["hot"], "cold": t["cold"],
+                "host": max(0, total - t["hot"] - t["cold"])}
 
     def handle_cost(self, query: dict) -> Tuple[int, dict]:
         """GET /debug/cost — the replica's cost ledger: chip-seconds by
@@ -1733,6 +1750,15 @@ class ReporterService:
             # the session plane: open per-vehicle sessions + folded points
             "sessions": (self.session_store.summary()
                          if self.session_store is not None else None),
+            # device-resident session arenas (docs/performance.md
+            # "Device-resident session arenas"): slab geometry, per-tier
+            # occupancy, and the promotion/eviction/readback counters;
+            # None = arena off (host-carried sessions)
+            "session_arena": (
+                m.session_arena.summary()
+                if m is not None
+                and getattr(m, "session_arena", None) is not None
+                else None),
             # the continent-scale data plane (docs/performance.md): hot
             # arena residency + shard assignment; None = untiered table
             "ubodt_tier": (

@@ -35,6 +35,15 @@
 #      than the windowed-rebatch baseline (--stream-window 8) at the
 #      SAME offered point rate — the window-fill wait the session
 #      matcher exists to eliminate (ISSUE 12 acceptance)
+#   5. the arena leg (ISSUE 18): the whole run holds with the
+#      device-resident session arena ON (REPORTER_SESSION_ARENA=1, the
+#      serving default) — every /statusz shows a live session_arena
+#      block, a mid-stream steady-state window shows the
+#      reporter_session_arena_readbacks_total counter FLAT (a packed
+#      step performs zero per-step host readbacks; the counter may grow
+#      only on checkpoint/drain/export), and after the drain + rebalance
+#      the surviving replicas' counters HAVE grown (the handoff's
+#      pop/export reads are exactly the reads the counter exists for)
 #
 # Usage: tests/session_rehearsal.sh [workdir]
 set -euo pipefail
@@ -54,6 +63,11 @@ export REPORTER_SLO_P99_MS=8000
 export REPORTER_SLO_P999_MS=0
 export REPORTER_SLO_DEGRADED_FRAC=0
 export REPORTER_SLO_STREAM_P99_MS=2500
+# the arena leg: carried beams device-resident (the serving default —
+# pinned explicitly so this gate keeps meaning it even if the default
+# moves); the whole drain/handoff/ledger arc below runs with slot-handle
+# sessions and must not move a bit
+export REPORTER_SESSION_ARENA=1
 reh_init "${1:-}" reporter-session
 export REPORTER_XLA_CACHE_DIR="$WORK/xla-cache"
 ROUTER_PORT=18081
@@ -91,6 +105,22 @@ if ! reh_wait_fleet "http://127.0.0.1:$ROUTER_PORT" 3 "$BASE_PORT" 3 600 warmed;
 fi
 echo "fleet up: 3 warmed replicas behind the router"
 
+# every replica serves with a live arena: /statusz session_arena non-null
+python - "$BASE_PORT" <<'EOF'
+import json, sys, urllib.request
+
+base = int(sys.argv[1])
+for i in range(3):
+    with urllib.request.urlopen(
+            "http://127.0.0.1:%d/statusz" % (base + i), timeout=15) as f:
+        st = json.loads(f.read().decode())
+    a = st.get("session_arena")
+    assert a is not None, "replica %d serves without a session arena" % i
+    assert a["hot_slots"] >= 1 and a["slot_bytes"] > 0, a
+print("session arena live on all 3 replicas (hot_slots=%d, slot_bytes=%d)"
+      % (a["hot_slots"], a["slot_bytes"]))
+EOF
+
 # ---- phase 1: the windowed-rebatch BASELINE at the same point rate --------
 # (short, chaos-free: the number the streaming path is judged against)
 python tools/loadgen.py --url "http://127.0.0.1:$ROUTER_PORT" \
@@ -111,7 +141,42 @@ python tools/loadgen.py --url "http://127.0.0.1:$ROUTER_PORT" \
     --out "$WORK/loadgen_stream.json" &
 LOADGEN_PID=$!
 
-sleep 8
+# steady-state transfer-counter window: two scrapes of every replica's
+# reporter_session_arena_readbacks_total mid-stream, BEFORE any drain or
+# export — the delta must be ZERO (a packed session step moves no beam
+# bytes host-side; only checkpoint/drain/export may grow the counter)
+_scrape_readbacks() {
+    python - "$BASE_PORT" <<'EOF'
+import sys, urllib.request
+
+sys.path.insert(0, ".")
+from reporter_tpu.obs.quantile import parse_metrics
+
+base = int(sys.argv[1])
+tot = 0
+for i in range(3):
+    with urllib.request.urlopen(
+            "http://127.0.0.1:%d/metrics" % (base + i), timeout=15) as f:
+        m = parse_metrics(f.read().decode())
+    for _lv, v in m.get("reporter_session_arena_readbacks_total",
+                        {}).items():
+        tot += int(v)
+print(tot)
+EOF
+}
+sleep 3
+RB_STEADY_0=$(_scrape_readbacks)
+sleep 4
+RB_STEADY_1=$(_scrape_readbacks)
+if [ "$RB_STEADY_0" != "$RB_STEADY_1" ]; then
+    echo "FAIL: arena readbacks grew $RB_STEADY_0 -> $RB_STEADY_1 during"
+    echo "      steady-state streaming — a per-step host transfer leaked"
+    exit 1
+fi
+echo "steady-state transfer counter flat: $RB_STEADY_0 readbacks across" \
+     "both mid-stream scrapes (zero per-step host readbacks)"
+
+sleep 1
 VICTIM_PID=$(python -c "
 import json; s = json.load(open('$WORK/fleet.json'))
 print(s['replicas'][1]['pid'])")
@@ -210,6 +275,28 @@ assert ratio >= 5.0, (
 print("per-point p99: stream %.1f ms vs windowed-rebatch %.1f ms "
       "(%.1fx lower; >= 5x required)" % (sp99, wp99, ratio))
 EOF
+
+# ...and the counter DOES grow on export — the only sanctioned readback.
+# (The drain's own export readbacks died with the drained process, and
+# the recovery rebalance may still be waiting on the respawn's warmup,
+# so drive the seam explicitly: a wire export on every live replica must
+# read each resident beam off the device exactly where the streaming
+# steps read nothing.)
+RB_BEFORE_EXPORT=$(_scrape_readbacks)
+for i in 0 1 2; do
+    curl -sf "http://127.0.0.1:$((BASE_PORT + i))/sessions?export=1" \
+        > /dev/null || true
+done
+RB_AFTER_EXPORT=$(_scrape_readbacks)
+if [ "$RB_AFTER_EXPORT" -le "$RB_BEFORE_EXPORT" ]; then
+    echo "FAIL: arena readbacks $RB_BEFORE_EXPORT -> $RB_AFTER_EXPORT"
+    echo "      across a fleet-wide wire export — the export did not read"
+    echo "      the resident beams off device (are sessions resident?)"
+    exit 1
+fi
+echo "arena readbacks grow only on export: $RB_BEFORE_EXPORT ->" \
+     "$RB_AFTER_EXPORT across an explicit fleet-wide wire export" \
+     "(steady-state window above stayed flat)"
 
 # ---- graceful fleet drain: exit 0, nothing stranded -----------------------
 reh_stop_fleet

@@ -302,7 +302,9 @@ def test_engine_tick_writes_history_and_gauges(tmp_path, monkeypatch):
     assert r["admitted_rps"] == pytest.approx(100.0)
     assert r["max_burn"] == pytest.approx(1.5)
     assert r["chip_seconds_total"] > 0
-    assert econ.G_SESS_PER_CHIP.value == pytest.approx(2.5)  # 5 / 2 chips
+    assert econ.G_SESS_PER_CHIP.labels("host").value == pytest.approx(2.5)  # 5 / 2 chips
+    # no tier split from the sampler -> everything folds to the host tier
+    assert econ.G_SESS_PER_CHIP.labels("hot").value == 0.0
     rep = e.cost_report()
     assert rep["replica"] == "rep-t"
     assert rep["chips"] == 2

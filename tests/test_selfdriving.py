@@ -562,12 +562,15 @@ def test_slow_drain_stalls_session_export(monkeypatch, engine):
         faults.reset()
         t0 = time.monotonic()
         code, body = rep.svc.handle_sessions({"export": ["1"]})
+        dt_armed = time.monotonic() - t0
         assert code == 200 and "sessions" in body
-        assert time.monotonic() - t0 >= 0.4
-        # the count-limited spec disarms after one firing
+        assert dt_armed >= 0.4
+        # the count-limited spec disarms after one firing: judge the
+        # disarmed export against the armed one (monotonic deltas), not
+        # an absolute wall ceiling a loaded single-CPU host can blow
         t0 = time.monotonic()
         rep.svc.handle_sessions({"export": ["1"]})
-        assert time.monotonic() - t0 < 0.3
+        assert time.monotonic() - t0 < dt_armed - 0.2
     finally:
         rep.close()
 

@@ -1,0 +1,320 @@
+"""Device-resident session arenas: the arena-on vs host-carry
+differential suite (docs/performance.md "Device-resident session
+arenas").
+
+The bit-exact contract: with ``session_arena=True`` the carried Viterbi
+beams live in a device slab (hot) / pinned_host pages (cold) and every
+packed step is one donated in-place dispatch — yet the wire output, the
+per-point records, and every seam (eviction churn mid-stream, an arena
+smaller than the dispatch group, drain/handoff, checkpoint/restore,
+``REPORTER_SESSION_ARENA=0``) stay BYTE-identical to the PR 12
+host-carried path, across both viterbi kernels × both UBODT layouts ×
+sparse on/off.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from reporter_tpu.matching import MatcherConfig, SegmentMatcher
+from reporter_tpu.matching.session import (
+    SessionCheckpointer, SessionEngine, SessionStore, read_checkpoints,
+)
+from reporter_tpu.synth import TraceSynthesizer
+from reporter_tpu.tiles.arrays import build_graph_arrays
+from reporter_tpu.tiles.network import grid_city
+from reporter_tpu.tiles.ubodt import build_ubodt
+
+MO = {"mode": "auto", "report_levels": [0, 1], "transition_levels": [0, 1]}
+# one slot's exact payload: 12 bytes per beam entry + 17 fixed
+SLOT_B = 12 * 8 + 17
+
+
+@pytest.fixture(scope="module")
+def setup():
+    city = grid_city(rows=8, cols=8, spacing_m=150.0)
+    arrays = build_graph_arrays(city, cell_size=100.0)
+    ubodt = build_ubodt(arrays, delta=1500.0)
+    return arrays, ubodt
+
+
+def _matcher(setup, kernel="scan", **kw):
+    arrays, ubodt = setup
+    cfg = MatcherConfig(length_buckets=[16], session_buckets=[4, 16],
+                        viterbi_kernel=kernel, **kw)
+    return SegmentMatcher(arrays=arrays, ubodt=ubodt, config=cfg)
+
+
+def _traces(arrays, b, t, seed=11, sigma=3.0):
+    synth = TraceSynthesizer(arrays, seed=seed)
+    return [s.trace for s in synth.batch(b, t, dt=5.0, sigma=sigma)]
+
+
+def _engine(m, tail=512):
+    store = SessionStore()
+    return SessionEngine(m, store, tail_points=tail), store
+
+
+def _stream_fleet(m, trs, step=2, batched=True):
+    """Stream a fleet through a fresh engine; batched=True submits all
+    vehicles per tick in one match_many (one dispatch group), False
+    submits one vehicle at a time (round-robin — the churn shape for a
+    tiny slab)."""
+    eng, store = _engine(m)
+    pts_max = max(len(t["trace"]) for t in trs)
+    for j in range(0, pts_max, step):
+        batch = [{"uuid": t["uuid"], "trace": t["trace"][j:j + step],
+                  "match_options": MO}
+                 for t in trs if t["trace"][j:j + step]]
+        if batched:
+            eng.match_many(batch)
+        else:
+            for item in batch:
+                eng.match_many([item])
+    return store
+
+
+def _records(store, uuid):
+    s = store.peek(uuid)
+    return (np.array([r[0] for r in s.records], np.int64),
+            np.array([r[1] for r in s.records], np.float32),
+            np.array([r[2] for r in s.records], bool))
+
+
+def _assert_store_equal(a, b, uuids):
+    for u in uuids:
+        ra, rb = _records(a, u), _records(b, u)
+        for xa, xb, what in zip(ra, rb, ("edge", "offset", "break")):
+            np.testing.assert_array_equal(xa, xb, err_msg="%s/%s" % (u, what))
+    wa = {w["uuid"]: w["carry"] for w in a.export_all()}
+    wb = {w["uuid"]: w["carry"] for w in b.export_all()}
+    assert wa == wb  # exact f32 wire bytes, not approx
+
+
+# -- the full differential grid ---------------------------------------------
+
+
+@pytest.mark.parametrize("kernel", ["scan", "assoc"])
+@pytest.mark.parametrize("layout", ["cuckoo", "wide32"])
+def test_bitexact_vs_host_carry_kernels_layouts(setup, kernel, layout):
+    arrays, _ = setup
+    trs = _traces(arrays, 4, 10)
+    kw = dict(kernel=kernel, ubodt_layout=layout)
+    host = _stream_fleet(_matcher(setup, **kw), trs)
+    m = _matcher(setup, session_arena=True, **kw)
+    assert m.session_arena is not None
+    arena = _stream_fleet(m, trs)
+    _assert_store_equal(host, arena, [t["uuid"] for t in trs])
+
+
+@pytest.mark.parametrize("kernel", ["scan", "assoc"])
+def test_bitexact_sparse_on(setup, kernel):
+    """Sparse cohorts ride the sparse arena twin program — still
+    bit-identical to the sparse host-carry path."""
+    arrays, _ = setup
+    trs = _traces(arrays, 3, 10)
+    kw = dict(kernel=kernel, sparse=True, sparse_gap_s=1.0)
+    host = _stream_fleet(_matcher(setup, **kw), trs)
+    arena = _stream_fleet(_matcher(setup, session_arena=True, **kw), trs)
+    _assert_store_equal(host, arena, [t["uuid"] for t in trs])
+
+
+def test_env_flag_reverts_bit_for_bit(setup, monkeypatch):
+    """REPORTER_SESSION_ARENA=0 beats cfg.session_arena=True: no arena
+    is built and the host-carry path runs (trivially bit-identical);
+    =1 enables it over a default config."""
+    arrays, _ = setup
+    monkeypatch.setenv("REPORTER_SESSION_ARENA", "0")
+    m_off = _matcher(setup, session_arena=True)
+    assert m_off.session_arena is None
+    monkeypatch.setenv("REPORTER_SESSION_ARENA", "1")
+    m_on = _matcher(setup)
+    assert m_on.session_arena is not None
+    trs = _traces(arrays, 3, 8)
+    _assert_store_equal(_stream_fleet(m_off, trs), _stream_fleet(m_on, trs),
+                        [t["uuid"] for t in trs])
+
+
+# -- tier seams --------------------------------------------------------------
+
+
+def test_eviction_churn_midstream_bitexact(setup):
+    """2 hot slots + 2 cold slots under 6 round-robin vehicles: every
+    step promotes/demotes/spills, and the output never moves a bit."""
+    arrays, _ = setup
+    trs = _traces(arrays, 6, 10)
+    host = _stream_fleet(_matcher(setup), trs, batched=False)
+    m = _matcher(setup, session_arena=True,
+                 session_arena_bytes=2 * SLOT_B,
+                 session_arena_cold_bytes=2 * SLOT_B)
+    arena = _stream_fleet(m, trs, batched=False)
+    _assert_store_equal(host, arena, [t["uuid"] for t in trs])
+    s = m.session_arena.summary()
+    assert s["hot_slots"] == 2 and s["cold_slots"] == 2
+    # the churn really happened
+    assert s["promotions"] > 0 and s["evictions"] > 0 and s["readbacks"] > 0
+
+
+def test_arena_smaller_than_dispatch_group_falls_back(setup):
+    """A dispatch group wider than the whole hot slab cannot be slotted:
+    the group rides the host-carry fallback (bit-identical), and the
+    slab never admits it."""
+    arrays, _ = setup
+    trs = _traces(arrays, 5, 8)
+    host = _stream_fleet(_matcher(setup), trs)
+    m = _matcher(setup, session_arena=True, session_arena_bytes=1 * SLOT_B)
+    assert m.session_arena.hot_slots == 1
+    arena = _stream_fleet(m, trs)
+    _assert_store_equal(host, arena, [t["uuid"] for t in trs])
+    assert m.session_arena.summary()["promotions"] == 0
+
+
+def test_steady_state_zero_readbacks(setup):
+    """The zero-per-step-transfer invariant: streaming submits never
+    read a beam back; only export does."""
+    arrays, _ = setup
+    m = _matcher(setup, session_arena=True)
+    trs = _traces(arrays, 4, 12)
+    eng, store = _engine(m)
+    for j in range(0, 12, 2):
+        eng.match_many([{"uuid": t["uuid"], "trace": t["trace"][j:j + 2],
+                         "match_options": MO} for t in trs])
+        assert m.session_arena.readbacks == 0
+    store.export_all()
+    assert m.session_arena.readbacks == len(trs)
+    # hot residency is live and visible to the economics plane
+    assert m.session_arena.tier_counts()["hot"] == len(trs)
+
+
+def test_chain_over_bucket_bitexact(setup):
+    """A submit beyond the largest session bucket chains through one
+    arena slot in place — equal to the host-carried chain."""
+    arrays, _ = setup
+    trs = _traces(arrays, 2, 40, seed=7)
+    host = _stream_fleet(_matcher(setup), trs, step=40)
+    m = _matcher(setup, session_arena=True)
+    arena = _stream_fleet(m, trs, step=40)
+    _assert_store_equal(host, arena, [t["uuid"] for t in trs])
+
+
+# -- drain / handoff / checkpoint seams --------------------------------------
+
+
+def _stream_with_drain(m, trs, drain_at, drained):
+    eng, store = _engine(m)
+    popped = None
+    for j in range(0, 12, 2):
+        eng.match_many([{"uuid": t["uuid"], "trace": t["trace"][j:j + 2],
+                         "match_options": MO}
+                        for t in trs if t["trace"][j:j + 2]])
+        if j == drain_at:
+            popped = store.pop_wire(drained)
+    return popped, store
+
+
+def test_drain_popwire_midstream_bitexact(setup):
+    """pop_wire (the SIGTERM drain's atomic export) mid-stream frees the
+    slots and hands off EXACT beam bytes while the stayers keep
+    streaming — under a churning tiny slab."""
+    arrays, _ = setup
+    trs = _traces(arrays, 4, 12)
+    drained = [t["uuid"] for t in trs[:2]]
+    stayers = [t["uuid"] for t in trs[2:]]
+    p_host, s_host = _stream_with_drain(_matcher(setup), trs, 6, drained)
+    m = _matcher(setup, session_arena=True)
+    p_arena, s_arena = _stream_with_drain(m, trs, 6, drained)
+    assert ([w["carry"] for w in p_host]
+            == [w["carry"] for w in p_arena])
+    # the drained beams WERE device-resident: the pop read them back
+    assert m.session_arena.readbacks >= len(drained)
+    _assert_store_equal(s_host, s_arena, stayers)
+
+
+def test_handoff_racing_redispatched_point_bitexact(setup):
+    """The PR 12 merge-on-conflict race with arena beams on BOTH sides:
+    replica A drains a vehicle mid-stream, the router re-dispatches a
+    point to replica B before the handoff lands, then the import merges
+    — decode and ledger equal the host-carry twins running the same
+    race."""
+    arrays, _ = setup
+    tr = _traces(arrays, 1, 12, seed=6)[0]
+    cut = 8
+
+    def race(m1, m2):
+        eng1, store1 = _engine(m1)
+        for j in range(cut):
+            eng1.match_many([{"uuid": tr["uuid"],
+                              "trace": [tr["trace"][j]],
+                              "match_options": MO}])
+        wire = json.loads(json.dumps(store1.pop_wire([tr["uuid"]])))
+        eng2, store2 = _engine(m2)
+        # the race loser: B already absorbed 2 points before the import
+        eng2.match_many([{"uuid": tr["uuid"],
+                          "trace": tr["trace"][cut:cut + 2],
+                          "match_options": MO}])
+        res = store2.import_wire(wire)
+        assert res["merged"] == 1
+        for j in range(cut + 2, 12):
+            eng2.match_many([{"uuid": tr["uuid"],
+                              "trace": [tr["trace"][j]],
+                              "match_options": MO}])
+        return store2
+
+    s_host = race(_matcher(setup), _matcher(setup))
+    s_arena = race(_matcher(setup, session_arena=True),
+                   _matcher(setup, session_arena=True))
+    _assert_store_equal(s_host, s_arena, [tr["uuid"]])
+    assert s_arena.peek(tr["uuid"]).points_total == 12
+
+
+def test_checkpoint_restore_seam_bitexact(setup, tmp_path):
+    """The preemption arc with the arena on: checkpoint sweeps read back
+    only touched slots (counted), a restored engine continues from the
+    checkpoint wire bit-exactly vs the uninterrupted host twin."""
+    arrays, _ = setup
+    tr = _traces(arrays, 1, 12, seed=9)[0]
+    ref = _stream_fleet(_matcher(setup), [tr], step=1)
+
+    m = _matcher(setup, session_arena=True)
+    eng, store = _engine(m)
+    cp = SessionCheckpointer(store, str(tmp_path / "ckpt"),
+                             cadence_s=3600.0, sync=False)
+    for j in range(8):
+        eng.match_many([{"uuid": tr["uuid"], "trace": [tr["trace"][j]],
+                         "match_options": MO}])
+    rb0 = m.session_arena.readbacks
+    assert rb0 == 0  # streaming alone reads nothing back
+    assert cp.sweep()["written"] == 1
+    assert m.session_arena.readbacks == 1  # the checkpoint's slot read
+    # the replica dies; an inheritor restores from the checkpoint dir
+    wires = read_checkpoints(cp.dir)
+    m2 = _matcher(setup, session_arena=True)
+    eng2, store2 = _engine(m2)
+    assert store2.import_wire(wires)["imported"] == 1
+    for j in range(8, 12):
+        eng2.match_many([{"uuid": tr["uuid"], "trace": [tr["trace"][j]],
+                         "match_options": MO}])
+    _assert_store_equal(ref, store2, [tr["uuid"]])
+
+
+# -- the observable surface --------------------------------------------------
+
+
+def test_summary_and_counters_shape(setup):
+    """The /statusz session_arena block's contract: geometry, occupancy,
+    and the three counters, all ints; tier_counts tracks residency."""
+    arrays, _ = setup
+    m = _matcher(setup, session_arena=True,
+                 session_arena_bytes=3 * SLOT_B)
+    trs = _traces(arrays, 2, 6)
+    _stream_fleet(m, trs)
+    s = m.session_arena.summary()
+    for k in ("hot_slots", "hot_used", "cold_slots", "cold_used",
+              "slot_bytes", "hot_bytes", "cold_bytes",
+              "promotions", "evictions", "readbacks"):
+        assert isinstance(s[k], int), k
+    assert s["slot_bytes"] == SLOT_B and s["hot_slots"] == 3
+    assert s["cold_memory_kind"] in ("pinned_host", "unpinned_host")
+    t = m.session_arena.tier_counts()
+    assert t["hot"] == s["hot_used"] and t["cold"] == s["cold_used"]

@@ -667,6 +667,45 @@ def cost_block(base: str) -> dict:
     }
 
 
+def session_arena_block(base: str) -> "Optional[dict]":
+    """The artifact's device-resident session-arena evidence
+    (docs/performance.md "Device-resident session arenas"): occupancy +
+    the promotion/eviction/readback counters, scraped from the target's
+    metrics AFTER the run so a streaming artifact carries its own
+    zero-per-step-readback proof.  Works against a single replica
+    (/metrics) and the fleet router (/metrics?pull=1 federates every
+    replica's families); None when the target serves no arena."""
+    from reporter_tpu.obs.quantile import parse_metrics
+
+    out = None
+    for q in ("/metrics?pull=1", "/metrics"):
+        try:
+            with urllib.request.urlopen(base + q, timeout=15) as r:
+                fams = parse_metrics(r.read().decode())
+        except Exception:  # noqa: BLE001 - surfaced as None in the artifact
+            continue
+        if "reporter_session_arena_readbacks_total" not in fams:
+            continue
+        def _tot(name):
+            return int(sum(fams.get(name, {}).values()))
+        # summed across replicas on a federated scrape (each row carries
+        # a prepended replica label); a single replica's scrape has one
+        # row per tier already
+        resident: dict = {}
+        for lv, v in fams.get(
+                "reporter_sessions_resident_per_chip", {}).items():
+            tier = dict(lv).get("tier", "?")
+            resident[tier] = round(resident.get(tier, 0.0) + float(v), 2)
+        out = {
+            "sessions_resident_per_chip": resident or None,
+            "promotions": _tot("reporter_session_arena_promotions_total"),
+            "evictions": _tot("reporter_session_arena_evictions_total"),
+            "readbacks": _tot("reporter_session_arena_readbacks_total"),
+        }
+        break
+    return out
+
+
 # -- main -------------------------------------------------------------------
 
 def main(argv=None) -> int:
@@ -999,6 +1038,11 @@ def main(argv=None) -> int:
         # (docs/economics.md) — every loadgen artifact carries it so a
         # perf number is never quoted without its price
         "cost": cost_block(base),
+        # device-resident session arenas (docs/performance.md): occupancy
+        # by tier + the transfer counters, so a streaming artifact proves
+        # the zero-per-step-readback claim it rides on; None when the
+        # target serves host-carried sessions
+        "session_arena": session_arena_block(base),
     }
     if args.dump_samples:
         with open(args.dump_samples, "w") as f:

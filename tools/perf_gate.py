@@ -67,6 +67,11 @@ METRICS = {
     "vs_baseline": "higher",
     "kernel_points_per_sec": "higher",
     "cost_usd_per_million_points": "lower",
+    # device-resident session arenas (docs/performance.md): streaming
+    # sessions held per chip by the hot/cold arena tiers — residency
+    # regresses when it DROPS (fewer vehicles fit before the host-carry
+    # fallback), so higher is better like the throughput families
+    "sessions_resident_per_chip": "higher",
 }
 
 # default relative-drop thresholds per provenance: CPU rates move with
