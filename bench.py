@@ -233,6 +233,63 @@ def _memory_block(matcher):
         return None
 
 
+def _mesh_measure(arrays, ubodt, traces, n_traces, n_points_total,
+                  primary_kernel, mesh_devs, reps):
+    """The timed mesh pass shared by the in-process accelerator path and
+    the BENCH_ROLE=mesh CPU worker: the same mixed fleet dispatched
+    synchronously (one execution wave at a time — the dispatch pattern
+    the mesh differential suites pin as rendezvous-safe) on a dp mesh
+    over mesh_devs devices."""
+    import time as _time
+
+    from reporter_tpu.matching import MatcherConfig, SegmentMatcher
+
+    mcfg = MatcherConfig(viterbi_kernel=primary_kernel, devices=mesh_devs)
+    mm = SegmentMatcher(arrays=arrays, ubodt=ubodt, config=mcfg)
+    mm.match_many(traces)  # compile + shard-upload round
+    t0 = _time.time()
+    for _ in range(reps):
+        mm.match_many(traces)
+    mesh_wall = _time.time() - t0
+    mtps = n_traces * reps / mesh_wall
+    mpps = n_points_total * reps / mesh_wall
+    cap = mm.capacity_summary()
+    return {
+        "devices": mesh_devs,
+        "mesh": cap.get("mesh"),
+        "traces_per_sec": round(mtps, 2),
+        "points_per_sec": round(mpps, 1),
+        "traces_per_sec_per_device": round(mtps / mesh_devs, 2),
+        "capacity": {
+            "max_device_batch": cap.get("max_device_batch"),
+            "max_device_points": cap.get("max_device_points"),
+        },
+    }
+
+
+def run_mesh() -> int:
+    """BENCH_ROLE=mesh: the mesh scaling leg in a FRESH process (the
+    device worker re-execs this on the CPU platform so a wedged
+    virtual-mesh rendezvous is killable from outside).  Rebuilds the
+    same scenario from the inherited env and prints the partial mesh
+    block as the one JSON line; the parent grafts the single-device
+    comparison fields on."""
+    from reporter_tpu.utils.jaxenv import ensure_platform
+
+    ensure_platform()
+    scenario, arrays, ubodt, cohorts = build_scenario()
+    primary_kernel = (os.environ.get("BENCH_KERNEL", "").strip().lower()
+                      or "scan")
+    mesh_devs = int(os.environ["BENCH_MESH_DEVICES_RESOLVED"])
+    reps = int(os.environ.get("BENCH_REPS", "10"))
+    traces = [s.trace for _, _, ss in cohorts for s in ss]
+    n_points_total = sum(T * len(ss) for _, T, ss in cohorts)
+    block = _mesh_measure(arrays, ubodt, traces, len(traces),
+                          n_points_total, primary_kernel, mesh_devs, reps)
+    print(json.dumps(block))
+    return 0
+
+
 def run_device() -> int:
     from reporter_tpu.utils.jaxenv import ensure_platform
 
@@ -850,6 +907,58 @@ def run_device() -> int:
         except Exception as e:  # noqa: BLE001 - the leg must not sink the bench
             _stderr("session leg failed: %s" % (e,))
 
+    # mesh scaling leg (docs/performance.md "One logical matcher per
+    # pod"; BENCH_MESH=0 skips): the SAME mixed fleet e2e pass on a dp
+    # mesh over the local devices — aggregate and per-device rates plus
+    # scaling_efficiency = (mesh tps / single tps) / devices.  On a real
+    # pod each dp rank is its own chip and efficiency near 1.0 means
+    # adding chips raised the replica's capacity linearly; on the CPU
+    # backend the "devices" are virtual and SHARE host cores, so
+    # efficiency ~1/devices is the healthy reading there (the platform
+    # label rides the artifact; docs/bench-schema.md).
+    mesh_bench = None
+    if os.environ.get("BENCH_MESH", "1").lower() not in (
+            "0", "false", "no", "off"):
+        try:
+            n_local = len(jax.devices())
+            mesh_devs = int(os.environ.get("BENCH_MESH_DEVICES",
+                                           str(n_local)))
+            if mesh_devs >= 2 and mesh_devs <= n_local:
+                _write_status(phase="benching", step="mesh", platform=platform)
+                if platform == "cpu":
+                    # fresh subprocess, timeout-bounded: a virtual-mesh
+                    # cross-module collective can wedge its rendezvous when
+                    # it shares the process with earlier legs' still-in-
+                    # flight executions (observed 2026-08-07: AllGather
+                    # participants stuck forever after the pipelined e2e
+                    # pass) — and a stuck XLA execution thread cannot be
+                    # killed from inside the process.  A real accelerator
+                    # holds a single-client grant, so only the CPU path
+                    # re-execs.
+                    rc, mesh_bench = _finish(
+                        _spawn("mesh",
+                               {"BENCH_MESH_DEVICES_RESOLVED": str(mesh_devs)}),
+                        float(os.environ.get("BENCH_MESH_TIMEOUT", "900")))
+                    if rc != 0 or not isinstance(mesh_bench, dict):
+                        _stderr("mesh worker failed (rc %s)" % (rc,))
+                        mesh_bench = None
+                else:
+                    mesh_bench = _mesh_measure(
+                        arrays, ubodt, traces, n_traces, n_points_total,
+                        primary_kernel, mesh_devs, reps)
+                if mesh_bench is not None:
+                    mtps = mesh_bench["traces_per_sec"]
+                    mesh_bench["single_device_traces_per_sec"] = round(tps, 2)
+                    mesh_bench["scaling_efficiency"] = round(
+                        mtps / tps / mesh_devs, 3)
+                    _stderr("mesh leg (%d devices): %s"
+                            % (mesh_devs, mesh_bench))
+            else:
+                _stderr("mesh leg skipped: %d local device(s), need >= 2"
+                        % n_local)
+        except Exception as e:  # noqa: BLE001 - the leg must not sink the bench
+            _stderr("mesh leg failed: %s" % (e,))
+
     print(json.dumps({
         "platform": platform,
         "acquire_s": round(acquire_s, 1),
@@ -890,6 +999,7 @@ def run_device() -> int:
         "ubodt_max_probes": ubodt.max_probes,
         "ubodt_max_kicks": int(ubodt.max_kicks),
         "session": session_bench,
+        "mesh": mesh_bench,
         "sessions_resident_per_chip": (
             session_bench["sessions_resident_per_chip"]
             if session_bench else None),
@@ -1190,6 +1300,8 @@ def main() -> int:
         return run_device()
     if role == "baseline":
         return run_baseline()
+    if role == "mesh":
+        return run_mesh()
 
     # ---- orchestrator ----
     want_cpu = os.environ.get("JAX_PLATFORMS", "") == "cpu"
@@ -1428,8 +1540,8 @@ def main() -> int:
               "oracle_cmp", "agreement_by_cohort", "device_mb",
               "fleet", "scenario", "edges", "ubodt_rows", "ubodt_layout",
               "ubodt_load", "ubodt_max_probes",
-              "ubodt_max_kicks", "session", "sessions_resident_per_chip",
-              "cost", "memory"):
+              "ubodt_max_kicks", "session", "mesh",
+              "sessions_resident_per_chip", "cost", "memory"):
         if k in device_json:
             out[k] = device_json[k]
     out.update({k: baseline_json[k] for k in

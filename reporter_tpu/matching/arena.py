@@ -132,22 +132,38 @@ class SessionArena:
     run inside ONE ``with arena.lock:`` section."""
 
     def __init__(self, beam_k: int, hot_bytes: int = 0,
-                 cold_bytes: int = 0, max_sessions: int = 65536):
+                 cold_bytes: int = 0, max_sessions: int = 65536,
+                 mesh=None, devices: int = 1):
         import jax
         import jax.numpy as jnp
 
         from ..ops.viterbi import initial_carry_batch
 
         self.beam_k = int(beam_k)
+        # the replica's device mesh (parallel/rules.py): the slab's slot
+        # axis shards over "dp", so a replica's carried beams live in
+        # POD-level HBM and the per-chip byte budget multiplies by the
+        # local device count — adding chips raises the hot-slot ceiling
+        # (docs/performance.md "One logical matcher per pod")
+        self.mesh = mesh
+        self.devices = max(1, int(devices))
+        n_dp = 1
+        if mesh is not None:
+            from ..parallel.rules import BATCH_AXIS
+
+            n_dp = mesh.shape.get(BATCH_AXIS, 1)
         # exact per-slot payload bytes: scores/edge/offset [K] at 4 B +
         # x/y/t/committed scalars at 4 B + active at 1 B — the same
         # field-width arithmetic SessionStore.resident_bytes uses
         self.slot_bytes = 12 * self.beam_k + 17
         cap = max(1, int(max_sessions))
         if hot_bytes and int(hot_bytes) > 0:
-            self.hot_slots = max(1, min(cap, int(hot_bytes) // self.slot_bytes))
+            budget = int(hot_bytes) * self.devices
+            self.hot_slots = max(1, min(cap, budget // self.slot_bytes))
         else:
             self.hot_slots = cap
+        # the sharded slab splits its slot axis evenly over dp ranks
+        self.hot_slots = -(-self.hot_slots // n_dp) * n_dp
         if cold_bytes and int(cold_bytes) > 0:
             self.cold_slots = max(0, int(cold_bytes) // self.slot_bytes)
         else:
@@ -169,25 +185,60 @@ class SessionArena:
         self.promotions = 0
         self.evictions = 0
         self.readbacks = 0
-        dev = jax.devices()[0]
-        # cold pages prefer the backend's pinned-host space (the tiering
-        # _put_pages idiom); the CPU backend's default memory IS host
-        # DRAM, so the fallback twin is semantically identical there
-        try:
-            self._cold_sharding = jax.sharding.SingleDeviceSharding(
-                dev, memory_kind="pinned_host")
-            jax.device_put(jnp.zeros((1,), jnp.float32), self._cold_sharding)
-            self.cold_memory_kind = "pinned_host"
-        except Exception:  # noqa: BLE001 - backend without host offload
-            self._cold_sharding = jax.sharding.SingleDeviceSharding(dev)
-            kind = getattr(dev, "default_memory", lambda: None)()
-            self.cold_memory_kind = getattr(kind, "kind", "device")
-            if dev.platform != "cpu":
-                log.warning(
-                    "session arena: backend %s lacks pinned_host memory; "
-                    "cold beam pages are %s-resident", dev.platform,
-                    self.cold_memory_kind)
-        self._default_sharding = jax.sharding.SingleDeviceSharding(dev)
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            from ..parallel.rules import BATCH_AXIS, resolve_spec
+
+            dev = next(iter(mesh.devices.flat))
+            # the slab itself: slot axis over "dp" (the rules table's
+            # ``slab`` row), committed so the plain jits run SPMD and the
+            # shard_map builder sees matching layouts
+            slab_spec = resolve_spec(PartitionSpec(BATCH_AXIS),
+                                     mesh.axis_names)
+            self._hot = jax.device_put(self._hot,
+                                       NamedSharding(mesh, slab_spec))
+            # single rows (promotions, handoff imports) replicate over the
+            # mesh — a row committed to one chip cannot feed a jit whose
+            # other operand spans eight
+            self._default_sharding = NamedSharding(mesh, PartitionSpec())
+            try:
+                self._cold_sharding = NamedSharding(
+                    mesh, PartitionSpec(), memory_kind="pinned_host")
+                jax.device_put(jnp.zeros((1,), jnp.float32),
+                               self._cold_sharding)
+                self.cold_memory_kind = "pinned_host"
+            except Exception:  # noqa: BLE001 - backend without host offload
+                self._cold_sharding = self._default_sharding
+                kind = getattr(dev, "default_memory", lambda: None)()
+                self.cold_memory_kind = getattr(kind, "kind", "device")
+                if dev.platform != "cpu":
+                    log.warning(
+                        "session arena: backend %s lacks pinned_host "
+                        "memory; cold beam pages are %s-resident",
+                        dev.platform, self.cold_memory_kind)
+        else:
+            dev = jax.devices()[0]
+            # cold pages prefer the backend's pinned-host space (the
+            # tiering _put_pages idiom); the CPU backend's default memory
+            # IS host DRAM, so the fallback twin is semantically
+            # identical there
+            try:
+                self._cold_sharding = jax.sharding.SingleDeviceSharding(
+                    dev, memory_kind="pinned_host")
+                jax.device_put(jnp.zeros((1,), jnp.float32),
+                               self._cold_sharding)
+                self.cold_memory_kind = "pinned_host"
+            except Exception:  # noqa: BLE001 - backend without host offload
+                self._cold_sharding = jax.sharding.SingleDeviceSharding(dev)
+                kind = getattr(dev, "default_memory", lambda: None)()
+                self.cold_memory_kind = getattr(kind, "kind", "device")
+                if dev.platform != "cpu":
+                    log.warning(
+                        "session arena: backend %s lacks pinned_host "
+                        "memory; cold beam pages are %s-resident",
+                        dev.platform, self.cold_memory_kind)
+            self._default_sharding = jax.sharding.SingleDeviceSharding(dev)
         # donated buffers the backend cannot reuse (CPU) warn per
         # dispatch; the donation is still correct, just not a win there
         warnings.filterwarnings(
@@ -466,6 +517,13 @@ class SessionArena:
                 "hot_bytes": self.hot_slots * self.slot_bytes,
                 "cold_bytes": len(self._cold) * self.slot_bytes,
                 "cold_memory_kind": self.cold_memory_kind,
+                "devices": self.devices,
+                # per-chip views: the slab is sharded, so a chip holds
+                # 1/devices of the slots/bytes (the gauge-semantics
+                # contract in obs/economics.py)
+                "hot_slots_per_chip": self.hot_slots // self.devices,
+                "hot_bytes_per_chip":
+                    self.hot_slots * self.slot_bytes // self.devices,
                 "promotions": self.promotions,
                 "evictions": self.evictions,
                 "readbacks": self.readbacks,

@@ -117,6 +117,38 @@ def _pad_rows(pad: int, *arrays):
     )
 
 
+# One collective-bearing mesh execution in flight at a time, PROCESS-wide:
+# the CPU backend runs a virtual mesh's cross-module collectives through an
+# in-process thread rendezvous, and two concurrently-executing sharded
+# programs can each park half their participant threads at the other's
+# rendezvous — a deadlock the async dispatch pipeline makes a matter of
+# time (observed 2026-08-07: bench's pipelined mesh leg wedged in an
+# AllGather after ~2000 clean runs; a real accelerator's hardware
+# collectives and strict per-device stream order cannot interleave this
+# way).  The lock is module-level because two matchers in one process
+# (serve's windowed + session batchers) share the same device threads.
+_MESH_CPU_DISPATCH_LOCK = threading.Lock()
+
+
+class _SerialDispatch:
+    """Wraps a jitted mesh program so each call dispatches under the
+    process-wide lock and blocks until ready before releasing it —
+    serialising collective-bearing executions on the CPU virtual mesh.
+    Attribute access (``.lower``, AOT inspection) passes through."""
+
+    def __init__(self, fn):
+        self._fn = fn
+
+    def __call__(self, *args, **kwargs):
+        import jax
+
+        with _MESH_CPU_DISPATCH_LOCK:
+            return jax.block_until_ready(self._fn(*args, **kwargs))
+
+    def __getattr__(self, name):
+        return getattr(self._fn, name)
+
+
 class SegmentMatcher:
     def __init__(
         self,
@@ -223,10 +255,7 @@ class SegmentMatcher:
         # parameters.  Off by default — the dense programs, the bit-exact
         # differential suites, and PR 14 wire output are untouched; the
         # serve entrypoint enables it ($REPORTER_SPARSE=0 reverts).
-        self.sparse = SparseModel(
-            self.cfg, arrays.cell_size,
-            mesh=(max(1, int(getattr(self.cfg, "devices", 1))) > 1
-                  or max(1, int(getattr(self.cfg, "graph_devices", 1))) > 1))
+        self.sparse = SparseModel(self.cfg, arrays.cell_size)
         # device-resident session arena (docs/performance.md
         # "Device-resident session arenas"): carried session beams live
         # in a hot HBM slab (+ pinned_host cold pages) and the packed
@@ -296,42 +325,43 @@ class SegmentMatcher:
         from ..ops.viterbi import MatchParams
 
         self._dg = self.arrays.to_device()
-        if self._ubodt_hot_bytes > 0 and max(
-                1, int(self.cfg.devices)) == 1:
-            # tiered table: hot-bucket arena on device, cold rows paged
-            # from host behind the lax.cond full-width fallback
-            # (tiles/tiering.py; output bit-identical to the resident
-            # table).  Mutually exclusive with a device mesh — the gp
-            # shard_map path is the in-replica HBM-scaling alternative.
-            from ..tiles.tiering import TieredTable
-
-            self.tiering = TieredTable(
-                self.ubodt, self._ubodt_hot_bytes, shard=self.ubodt_shard)
-            self._du = self.tiering.device()
-        else:
-            if self._ubodt_hot_bytes > 0:
-                log.warning(
-                    "REPORTER_UBODT_HOT_BYTES ignored: tiering does not "
-                    "compose with a device mesh (cfg.devices=%d); the gp "
-                    "shard_map path is the in-replica alternative",
-                    self.cfg.devices)
-            self._du = self.ubodt.to_device()
         self._params = MatchParams.from_config(self.cfg)
 
-        # device mesh in the product path (VERDICT r03 next #4): with
-        # cfg.devices > 1 the graph/params live replicated over the mesh and
-        # every batch array is device_put with a dp sharding before dispatch
-        # — computation follows data, so the same jits below run SPMD across
-        # chips with XLA inserting the collectives.  This is the TPU
-        # equivalent of the reference scaling by Kafka partitions
-        # (README.md:169-173).  With cfg.graph_devices > 1 the mesh gains a
-        # gp axis: the UBODT table lives in 1/gp bucket-range slices per
-        # chip (HBM scaling for region tables bigger than one chip) and the
-        # match runs under shard_map so probes resolve with pmin/pmax over
-        # the ICI (ops/hashtable._ubodt_lookup_sharded).
+        # device mesh FIRST (docs/performance.md "One logical matcher per
+        # pod"): the tiered UBODT arena, the table placement, and the
+        # session arena all size and shard against it, so it must exist
+        # before any of them.  With cfg.devices > 1 the graph/params live
+        # replicated over the mesh and every batch array is device_put with
+        # a dp sharding before dispatch — computation follows data, so the
+        # same jits below run SPMD across chips with XLA inserting the
+        # collectives.  This is the TPU equivalent of the reference scaling
+        # by Kafka partitions (README.md:169-173).  With cfg.graph_devices
+        # > 1 the mesh gains a gp axis: the UBODT table lives in 1/gp
+        # bucket-range slices per chip (HBM scaling for region tables
+        # bigger than one chip) and every program runs under the generic
+        # shard_map builder (_build_program) so probes resolve with
+        # pmin/pmax over the ICI (ops/hashtable._ubodt_lookup_sharded).
+        # Which sharding each program argument gets is the
+        # parallel/rules.py table's single decision — NOT per-call-site
+        # hand lists.
         self._mesh = None
         self._batch_sharding = None
         self._carry_sharding = None
+        # REPORTER_DEVICES / REPORTER_GRAPH_DEVICES override the config
+        # (the serve-tier env convention): the mesh-rehearsal leg forces
+        # an 8-virtual-device replica onto a stock config this way.
+        # Written back into cfg so capacity_summary, the economics
+        # ledger, and /health all see the resolved topology.
+        for env_key, field_name in (("REPORTER_DEVICES", "devices"),
+                                    ("REPORTER_GRAPH_DEVICES",
+                                     "graph_devices")):
+            raw = os.environ.get(env_key, "").strip()
+            if raw:
+                try:
+                    setattr(self.cfg, field_name, int(raw))
+                except ValueError:
+                    raise ValueError("%s must be an integer device count, "
+                                     "got %r" % (env_key, raw))
         n_total = max(1, int(self.cfg.devices))
         self._n_gp = max(1, int(self.cfg.graph_devices))
         if n_total & (n_total - 1) or self._n_gp & (self._n_gp - 1):
@@ -343,58 +373,84 @@ class SegmentMatcher:
                              % (self._n_gp, n_total))
         self._n_dp = n_total // self._n_gp
         if n_total > 1 or self._n_gp > 1:
-            from jax.sharding import NamedSharding, PartitionSpec as P
-
             from ..parallel.mesh import (
-                BATCH_AXIS, GRAPH_AXIS, check_ubodt_shardable, make_mesh,
-                make_mesh2,
+                check_ubodt_shardable, make_mesh, make_mesh2,
             )
+            from ..parallel.rules import sharding_for
 
             if self._n_gp > 1:
                 check_ubodt_shardable(self.ubodt, self._n_gp)
                 self._mesh = make_mesh2(self._n_dp, self._n_gp)
-                du_sharding = NamedSharding(self._mesh, P(GRAPH_AXIS))
             else:
                 self._mesh = make_mesh(self._n_dp)
-                du_sharding = NamedSharding(self._mesh, P())
-            repl = NamedSharding(self._mesh, P())
             # packed [4, B, T] batch arrays shard over axis 1; carry pytrees
-            # (leading [B]) over axis 0
-            self._batch_sharding = NamedSharding(self._mesh, P(None, BATCH_AXIS))
-            self._carry_sharding = NamedSharding(self._mesh, P(BATCH_AXIS))
-            self._dg = jax.device_put(self._dg, repl)
-            self._du = jax.device_put(self._du, du_sharding)
-            self._params = jax.device_put(self._params, repl)
-        # device-resident session arena: mutually exclusive with a device
-        # mesh (carried beams shard over dp; the arena is the
-        # single-replica HBM-residency lever, like UBODT tiering)
-        if self._session_arena_on:
-            if self._mesh is not None:
-                log.warning(
-                    "REPORTER_SESSION_ARENA ignored: the session arena "
-                    "does not compose with a device mesh (cfg.devices=%d, "
-                    "graph_devices=%d)", self.cfg.devices, self._n_gp)
-            else:
-                from .arena import SessionArena
+            # (leading [B]) over axis 0 — the rule table's xin/carry rows
+            self._batch_sharding = sharding_for("xin", self._mesh)
+            self._carry_sharding = sharding_for("carry", self._mesh)
+            self._dg = jax.device_put(self._dg, sharding_for("dg", self._mesh))
+            self._params = jax.device_put(
+                self._params, sharding_for("p", self._mesh))
+        # CPU virtual meshes serialise program dispatch (_SerialDispatch:
+        # the in-process collective rendezvous deadlocks under concurrent
+        # sharded executions); REPORTER_MESH_SERIAL=0/1 overrides the
+        # platform default for diagnosis
+        env_ms = os.environ.get("REPORTER_MESH_SERIAL", "").strip().lower()
+        if env_ms in ("0", "false", "no", "off"):
+            self._serial_dispatch = False
+        elif env_ms in ("1", "true", "yes", "on"):
+            self._serial_dispatch = self._mesh is not None
+        else:
+            self._serial_dispatch = (
+                self._mesh is not None
+                and jax.devices()[0].platform == "cpu")
+        if self._ubodt_hot_bytes > 0:
+            # tiered table: hot-bucket arena on device, cold rows paged
+            # from host behind the lax.cond full-width fallback
+            # (tiles/tiering.py; output bit-identical to the resident
+            # table).  On a gp mesh the arena/slot-map/pages shard by the
+            # SAME contiguous-bucket partition the sharded probe uses, so
+            # hot_bytes is a PER-CHIP budget and adding gp ranks multiplies
+            # the resident set.
+            from ..tiles.tiering import TieredTable
 
-                env_b = os.environ.get(
-                    "REPORTER_SESSION_ARENA_BYTES", "").strip()
-                env_cb = os.environ.get(
-                    "REPORTER_SESSION_ARENA_COLD_BYTES", "").strip()
-                try:
-                    hot_b = int(env_b) if env_b else int(
-                        getattr(self.cfg, "session_arena_bytes", 0) or 0)
-                    cold_b = int(env_cb) if env_cb else int(
-                        getattr(self.cfg, "session_arena_cold_bytes", 0)
-                        or 0)
-                except ValueError:
-                    raise ValueError(
-                        "REPORTER_SESSION_ARENA_BYTES/_COLD_BYTES must be "
-                        "integer byte counts, got %r/%r" % (env_b, env_cb))
-                self.session_arena = SessionArena(
-                    self.cfg.beam_k, hot_b, cold_b,
-                    max_sessions=int(
-                        getattr(self.cfg, "max_sessions", 65536)))
+            self.tiering = TieredTable(
+                self.ubodt, self._ubodt_hot_bytes, shard=self.ubodt_shard,
+                mesh=self._mesh, n_gp=self._n_gp)
+            self._du = self.tiering.device()
+        else:
+            self._du = self.ubodt.to_device()
+            if self._mesh is not None:
+                from ..parallel.rules import sharding_for
+
+                self._du = jax.device_put(
+                    self._du, sharding_for("du", self._mesh))
+        # device-resident session arena: on a mesh the beam slab's slot
+        # axis shards over dp (parallel/rules.py "slab"), so the
+        # per-chip byte budget multiplies into pod-level HBM and the
+        # donated in-place gather/scatter contract survives intact
+        # (ops/viterbi.session_step_arena_mesh)
+        if self._session_arena_on:
+            from .arena import SessionArena
+
+            env_b = os.environ.get(
+                "REPORTER_SESSION_ARENA_BYTES", "").strip()
+            env_cb = os.environ.get(
+                "REPORTER_SESSION_ARENA_COLD_BYTES", "").strip()
+            try:
+                hot_b = int(env_b) if env_b else int(
+                    getattr(self.cfg, "session_arena_bytes", 0) or 0)
+                cold_b = int(env_cb) if env_cb else int(
+                    getattr(self.cfg, "session_arena_cold_bytes", 0)
+                    or 0)
+            except ValueError:
+                raise ValueError(
+                    "REPORTER_SESSION_ARENA_BYTES/_COLD_BYTES must be "
+                    "integer byte counts, got %r/%r" % (env_b, env_cb))
+            self.session_arena = SessionArena(
+                self.cfg.beam_k, hot_b, cold_b,
+                max_sessions=int(
+                    getattr(self.cfg, "max_sessions", 65536)),
+                mesh=self._mesh, devices=n_total)
         # all forwards speak the packed transport: one [4, B, T] f32 array in,
         # one [3, B, T] i32 array out (ops/viterbi.pack_inputs/pack_compact).
         # Each host<->device crossing pays a fixed dispatch/sync cost (~73 ms
@@ -424,9 +480,11 @@ class SegmentMatcher:
         ambiguity-sensitive one).  The sparse-gap model's variants live
         under their own kinds ("sparse" / "sparse_pre" / "sparse_chain" /
         "sparse_session", docs/match-quality.md) so dense traffic keeps
-        replaying the byte-identical classic programs.  The gp-sharded
-        variants are built through _make_gp_jits; all expose packed
-        calling conventions."""
+        replaying the byte-identical classic programs.  Programs that
+        need collectives (any kind on a gp mesh; the slot-sharded arena
+        step on any mesh) are built through the generic rule-table
+        shard_map builder (_build_program); all expose packed calling
+        conventions."""
         if kind in ("pre", "sparse_pre"):
             kernel = "none"
         # the aux (confidence-diagnostics) flag selects program VARIANTS
@@ -437,15 +495,22 @@ class SegmentMatcher:
         key = (kind, kernel, qa)
         fn = self._jits.get(key)
         if fn is None:
+            if self._mesh is not None and (
+                    self._n_gp > 1
+                    or kind in ("arena_session", "sparse_arena_session")):
+                # collective-needing programs go through the generic
+                # rule-table shard_map builder: the gp-sharded probe's
+                # axis_index/pmin and the slot-sharded arena slab's
+                # psum-bit-pattern gather are not expressible in plain
+                # GSPMD.  Everything else on a dp-only mesh runs the
+                # unmodified jits below SPMD via committed input
+                # shardings (computation follows data).
+                self._jits[key] = self._build_program(kind, kernel, qa)
+                return self._finish_jit(key)
             if kind in ("arena_session", "sparse_arena_session"):
                 # the device-resident session-arena step: the carry slab
                 # rides as a DONATED argument, so the scatter is in-place
-                # — one dispatch, zero per-step beam transfers.  Never
-                # built on a mesh (the arena is disabled there).
-                if self._mesh is not None:
-                    raise RuntimeError(
-                        "arena session kinds do not compose with a device "
-                        "mesh (the session arena should be disabled)")
+                # — one dispatch, zero per-step beam transfers
                 import functools
 
                 import jax
@@ -463,14 +528,8 @@ class SegmentMatcher:
                         functools.partial(
                             session_step_arena_sparse, kernel=kernel),
                         static_argnums=(5,), donate_argnums=(6,))
-                return self._jits[key]
+                return self._finish_jit(key)
             if kind.startswith("sparse"):
-                # mesh deployments disable the model at construction; a
-                # sparse kind reaching a gp mesh is a programming error
-                if self._n_gp > 1:
-                    raise RuntimeError(
-                        "sparse dispatch kinds do not compose with the gp "
-                        "mesh (SparseModel should be disabled)")
                 import functools
 
                 import jax
@@ -504,57 +563,57 @@ class SegmentMatcher:
                         functools.partial(
                             session_step_packed_sparse, kernel=kernel),
                         static_argnums=(5,))
-                return self._jits[key]
-            if self._n_gp > 1:
-                if kind == "pre":
-                    self._jits[key] = self._make_gp_pre_jit()
-                else:
-                    built = self._make_gp_jits(kernel, aux=qa)
-                    for kd in ("compact", "carry", "chain", "session"):
-                        self._jits[(kd, kernel,
-                                    qa and kd in ("compact", "chain"))] = built[kd]
+                return self._finish_jit(key)
+            import functools
+
+            import jax
+
+            from ..ops.viterbi import (
+                chain_batch_carry_packed, chain_batch_carry_packed_aux,
+                match_batch_carry_packed, match_batch_compact_packed,
+                match_batch_compact_packed_aux, precompute_batch_packed,
+                session_step_packed,
+            )
+
+            # in-batch probe dedup applies where the UBODT probe sees a
+            # whole dispatch's key set: the bucketed "compact" program
+            # and the long-trace "pre" precompute.  The chain/carry
+            # programs probe only tiny seam [K, K] sets (and the legacy
+            # fused carry is the dedup-off differential reference).
+            if kind == "pre":
+                self._jits[key] = jax.jit(
+                    functools.partial(
+                        precompute_batch_packed,
+                        dedup=self._probe_dedup),
+                    static_argnums=(4,))
+            elif kind == "compact":
+                base = (match_batch_compact_packed_aux if qa
+                        else match_batch_compact_packed)
+                self._jits[key] = jax.jit(
+                    functools.partial(
+                        base, kernel=kernel,
+                        dedup=self._probe_dedup),
+                    static_argnums=(4,))
             else:
-                import functools
-
-                import jax
-
-                from ..ops.viterbi import (
-                    chain_batch_carry_packed, chain_batch_carry_packed_aux,
-                    match_batch_carry_packed, match_batch_compact_packed,
-                    match_batch_compact_packed_aux, precompute_batch_packed,
-                    session_step_packed,
-                )
-
-                # in-batch probe dedup applies where the UBODT probe sees a
-                # whole dispatch's key set: the bucketed "compact" program
-                # and the long-trace "pre" precompute.  The chain/carry
-                # programs probe only tiny seam [K, K] sets (and the legacy
-                # fused carry is the dedup-off differential reference).
-                if kind == "pre":
-                    self._jits[key] = jax.jit(
-                        functools.partial(
-                            precompute_batch_packed,
-                            dedup=self._probe_dedup),
-                        static_argnums=(4,))
-                elif kind == "compact":
-                    base = (match_batch_compact_packed_aux if qa
-                            else match_batch_compact_packed)
-                    self._jits[key] = jax.jit(
-                        functools.partial(
-                            base, kernel=kernel,
-                            dedup=self._probe_dedup),
-                        static_argnums=(4,))
-                else:
-                    base, k_argnum = {
-                        "carry": (match_batch_carry_packed, 4),
-                        "chain": (chain_batch_carry_packed_aux if qa
-                                  else chain_batch_carry_packed, 5),
-                        "session": (session_step_packed, 4),
-                    }[kind]
-                    self._jits[key] = jax.jit(
-                        functools.partial(base, kernel=kernel),
-                        static_argnums=(k_argnum,))
+                base, k_argnum = {
+                    "carry": (match_batch_carry_packed, 4),
+                    "chain": (chain_batch_carry_packed_aux if qa
+                              else chain_batch_carry_packed, 5),
+                    "session": (session_step_packed, 4),
+                }[kind]
+                self._jits[key] = jax.jit(
+                    functools.partial(base, kernel=kernel),
+                    static_argnums=(k_argnum,))
             fn = self._jits[key]
+        return self._finish_jit(key)
+
+    def _finish_jit(self, key):
+        """Cache tail for _get_jit: on the CPU virtual mesh, wrap the
+        program in the process-wide serial-dispatch guard (idempotent —
+        the wrapped object replaces the raw jit in the cache)."""
+        fn = self._jits[key]
+        if self._serial_dispatch and not isinstance(fn, _SerialDispatch):
+            fn = self._jits[key] = _SerialDispatch(fn)
         return fn
 
     # back-compat accessors (bench.py / tools use these to time the exact
@@ -687,112 +746,163 @@ class SegmentMatcher:
             self._cpu_params_cache[pkey] = cpu
         return cpu
 
-    def _make_gp_jits(self, kernel: str = "scan", aux: bool = False):
-        """shard_map'd compact/carry jits for the dp×gp mesh: batch arrays
-        split over dp, the UBODT's bucket ranges over gp, probes resolved
-        with collectives inside (the plain sharded-jit path cannot express
-        the axis_index/pmin the sharded probe needs).  Each returned fn
-        keeps the (…, params, k[, carry]) calling convention of the plain
-        jits so _dispatch_batch/_match_long stay oblivious (both speak the
-        packed [4, B, T] -> [3, B, T] transport; the batch axis of a packed
-        array is axis 1).  ``aux`` routes compact/chain through the
-        confidence-diagnostics variants, whose extra [B, 4] output shards
-        over the batch axis like the carry pytree."""
-        import jax
-        from jax.sharding import PartitionSpec as P
+    # the (kind, kernel) program family's calling conventions, by argument
+    # NAME: the names are what the parallel/rules.py partition table keys
+    # on, so adding a program kind means one row here and (at most) one
+    # rule there — never a hand-written in_specs list.  "k" is the static
+    # beam width (excluded from the traced signature); argument order IS
+    # the plain-jit calling convention, so dispatch sites stay oblivious.
+    _PROGRAM_ARGS = {
+        "compact": ("dg", "du", "xin", "p", "k"),
+        "carry": ("dg", "du", "xin", "p", "k", "carry"),
+        "pre": ("dg", "du", "xin", "p", "k"),
+        "chain": ("dg", "du", "pre", "xin", "p", "k", "carry"),
+        "session": ("dg", "du", "xin", "p", "k", "carry"),
+        "sparse": ("dg", "du", "xin", "p", "sp", "k"),
+        "sparse_pre": ("dg", "du", "xin", "p", "sp", "k"),
+        "sparse_chain": ("dg", "du", "pre", "xin", "p", "sp", "k", "carry"),
+        "sparse_session": ("dg", "du", "xin", "p", "sp", "k", "carry"),
+        "arena_session": ("dg", "du", "xin", "p", "k",
+                          "slab", "slots", "use"),
+        "sparse_arena_session": ("dg", "du", "xin", "p", "sp", "k",
+                                 "slab", "slots", "use"),
+    }
+    # result names per kind (qa variants append/insert "aux"); resolved
+    # against the same rule table for out_specs
+    _PROGRAM_OUTS = {
+        "compact": ("packed",),
+        "carry": ("packed", "carry"),
+        "pre": ("pre",),
+        "chain": ("packed", "carry"),
+        "session": ("packed", "aux", "carry"),
+        "sparse": ("packed", "aux"),
+        "sparse_pre": ("pre",),
+        "sparse_chain": ("packed", "aux", "carry"),
+        "sparse_session": ("packed", "aux", "carry"),
+        "arena_session": ("packed", "aux", "slab"),
+        "sparse_arena_session": ("packed", "aux", "slab"),
+    }
 
-        from ..ops.viterbi import (
-            chain_batch_carry_packed, chain_batch_carry_packed_aux,
-            match_batch_carry_packed, match_batch_compact_packed,
-            match_batch_compact_packed_aux, session_step_packed,
+    def _build_program(self, kind: str, kernel: str, qa: bool):
+        """Generic mesh program builder: ONE shard_map construction for
+        every (kind, kernel) program, with in/out specs resolved from the
+        parallel/rules.py partition table by argument name — this replaced
+        the bespoke _make_gp_* twins that hand-listed specs per program
+        and could not express sparse, tiering, or the session arena.
+
+        Batch arrays split over dp, the UBODT's bucket ranges over gp
+        (probes resolve with collectives inside — the plain sharded-jit
+        path cannot express the axis_index/pmin the sharded probe needs),
+        the session-arena slab's slot axis over dp with the donated
+        in-place contract intact (ops/viterbi.session_step_arena_mesh).
+        Each returned fn keeps the (…, params, k[, …]) calling convention
+        of the plain jits so the dispatch sites stay oblivious; since
+        shard_map bodies close over the static beam width, programs cache
+        per k inside (the sparse cohorts' k_sp varies)."""
+        import jax
+
+        from ..ops import viterbi as V
+        from ..parallel.rules import (
+            BATCH_AXIS, GRAPH_AXIS, shard_map, spec_for,
         )
-        from ..parallel.mesh import BATCH_AXIS, GRAPH_AXIS
 
-        k = self.cfg.beam_k
-        compact_fn = (match_batch_compact_packed_aux if aux
-                      else match_batch_compact_packed)
-        chain_fn = (chain_batch_carry_packed_aux if aux
-                    else chain_batch_carry_packed)
+        mesh = self._mesh
+        gp = self._n_gp > 1
+        dedup = self._probe_dedup
+        args = self._PROGRAM_ARGS[kind]
+        outs = self._PROGRAM_OUTS[kind]
+        if qa and kind in ("compact", "chain"):
+            outs = (outs[:1] + ("aux",) + outs[1:])
 
-        def body_compact(dg, du, xin, p):
-            return compact_fn(
-                dg, du.with_shard_axis(GRAPH_AXIS), xin, p, k, kernel)
+        def _du_local(du):
+            # the bucket-range-sharded probe path only exists on a gp
+            # mesh; a dp-only mesh replicates the table and the plain
+            # lookup is the bit-identical (and collective-free) program
+            return du.with_shard_axis(GRAPH_AXIS) if gp else du
 
-        def body_carry(dg, du, xin, p, carry):
-            return match_batch_carry_packed(
-                dg, du.with_shard_axis(GRAPH_AXIS), xin, p, k, carry, kernel)
+        def _body(k):
+            if kind == "compact":
+                f = (V.match_batch_compact_packed_aux if qa
+                     else V.match_batch_compact_packed)
+                return lambda dg, du, xin, p: f(
+                    dg, _du_local(du), xin, p, k, kernel, dedup=dedup)
+            if kind == "carry":
+                return lambda dg, du, xin, p, carry: \
+                    V.match_batch_carry_packed(
+                        dg, _du_local(du), xin, p, k, carry, kernel)
+            if kind == "pre":
+                return lambda dg, du, xin, p: V.precompute_batch_packed(
+                    dg, _du_local(du), xin, p, k, dedup=dedup)
+            if kind == "chain":
+                f = (V.chain_batch_carry_packed_aux if qa
+                     else V.chain_batch_carry_packed)
+                return lambda dg, du, pre, xin, p, carry: f(
+                    dg, _du_local(du), pre, xin, p, k, carry, kernel)
+            if kind == "session":
+                return lambda dg, du, xin, p, carry: V.session_step_packed(
+                    dg, _du_local(du), xin, p, k, carry, kernel)
+            if kind == "sparse":
+                return lambda dg, du, xin, p, sp: \
+                    V.match_batch_compact_packed_sparse(
+                        dg, _du_local(du), xin, p, sp, k, kernel=kernel,
+                        dedup=dedup)
+            if kind == "sparse_pre":
+                return lambda dg, du, xin, p, sp: \
+                    V.precompute_batch_packed_sparse(
+                        dg, _du_local(du), xin, p, sp, k, dedup=dedup)
+            if kind == "sparse_chain":
+                return lambda dg, du, pre, xin, p, sp, carry: \
+                    V.chain_batch_carry_packed_sparse(
+                        dg, _du_local(du), pre, xin, p, sp, k, carry,
+                        kernel=kernel)
+            if kind == "sparse_session":
+                return lambda dg, du, xin, p, sp, carry: \
+                    V.session_step_packed_sparse(
+                        dg, _du_local(du), xin, p, sp, k, carry,
+                        kernel=kernel)
+            if kind == "arena_session":
+                return lambda dg, du, xin, p, slab, slots, use: \
+                    V.session_step_arena_mesh(
+                        dg, _du_local(du), xin, p, k, slab, slots, use,
+                        kernel=kernel, batch_axis=BATCH_AXIS)
+            if kind == "sparse_arena_session":
+                return lambda dg, du, xin, p, sp, slab, slots, use: \
+                    V.session_step_arena_mesh(
+                        dg, _du_local(du), xin, p, k, slab, slots, use,
+                        kernel=kernel, sp=sp, batch_axis=BATCH_AXIS)
+            raise ValueError("unknown program kind %r" % (kind,))
 
-        def body_chain(dg, du, pre, xin, p, carry):
-            return chain_fn(
-                dg, du.with_shard_axis(GRAPH_AXIS), pre, xin, p, k, carry,
-                kernel)
+        dyn = tuple(a for a in args if a != "k")
+        in_specs = tuple(spec_for(a, mesh) for a in dyn)
+        out_specs = (spec_for(outs[0], mesh) if len(outs) == 1
+                     else tuple(spec_for(o, mesh) for o in outs))
+        # the arena slab is donated exactly like the plain arena jits:
+        # the scatter is in-place, zero per-step beam transfers
+        donate = (dyn.index("slab"),) if "slab" in dyn else ()
+        per_k: Dict[int, object] = {}
 
-        def body_session(dg, du, xin, p, carry):
-            return session_step_packed(
-                dg, du.with_shard_axis(GRAPH_AXIS), xin, p, k, carry, kernel)
+        def _built(k: int):
+            fn = per_k.get(k)
+            if fn is None:
+                fn = jax.jit(
+                    shard_map(_body(k), mesh=mesh, in_specs=in_specs,
+                              out_specs=out_specs),
+                    donate_argnums=donate)
+                per_k[k] = fn
+            return fn
 
-        bat = P(None, BATCH_AXIS)  # packed arrays: [field, B, T]
-        row = P(BATCH_AXIS)  # carry pytrees / [B, 4] aux blocks
-        sm_compact = jax.jit(jax.shard_map(
-            body_compact, mesh=self._mesh,
-            in_specs=(P(), P(GRAPH_AXIS), bat, P()),
-            out_specs=(bat, row) if aux else bat, check_vma=False,
-        ))
-        sm_carry = jax.jit(jax.shard_map(
-            body_carry, mesh=self._mesh,
-            in_specs=(P(), P(GRAPH_AXIS), bat, P(), P(BATCH_AXIS)),
-            out_specs=(bat, P(BATCH_AXIS)), check_vma=False,
-        ))
-        sm_chain = jax.jit(jax.shard_map(
-            body_chain, mesh=self._mesh,
-            in_specs=(P(), P(GRAPH_AXIS), P(BATCH_AXIS), bat, P(),
-                      P(BATCH_AXIS)),
-            out_specs=(bat, row, P(BATCH_AXIS)) if aux
-            else (bat, P(BATCH_AXIS)), check_vma=False,
-        ))
-        sm_session = jax.jit(jax.shard_map(
-            body_session, mesh=self._mesh,
-            in_specs=(P(), P(GRAPH_AXIS), bat, P(), P(BATCH_AXIS)),
-            out_specs=(bat, row, P(BATCH_AXIS)), check_vma=False,
-        ))
-        return {
-            "compact": lambda dg, du, xin, p, _k: sm_compact(dg, du, xin, p),
-            "carry": lambda dg, du, xin, p, _k, carry: sm_carry(
-                dg, du, xin, p, carry),
-            "chain": lambda dg, du, pre, xin, p, _k, carry: sm_chain(
-                dg, du, pre, xin, p, carry),
-            "session": lambda dg, du, xin, p, _k, carry: sm_session(
-                dg, du, xin, p, carry),
-        }
+        k_pos = args.index("k")
 
-    def _make_gp_pre_jit(self):
-        """shard_map'd long-trace precompute for the dp×gp mesh: same
-        sharding story as _make_gp_jits (batch over dp, UBODT bucket ranges
-        over gp), kernel-independent — the program contains no viterbi
-        forward.  The TracePre output shards over the batch axis and stays
-        on device for the chain programs."""
-        import jax
-        from jax.sharding import PartitionSpec as P
+        def dispatch(*call_args):
+            k = int(call_args[k_pos])
+            return _built(k)(*(call_args[:k_pos] + call_args[k_pos + 1:]))
 
-        from ..ops.viterbi import precompute_batch_packed
-        from ..parallel.mesh import BATCH_AXIS, GRAPH_AXIS
-
-        k = self.cfg.beam_k
-
-        def body_pre(dg, du, xin, p):
-            return precompute_batch_packed(
-                dg, du.with_shard_axis(GRAPH_AXIS), xin, p, k)
-
-        sm_pre = jax.jit(jax.shard_map(
-            body_pre, mesh=self._mesh,
-            in_specs=(P(), P(GRAPH_AXIS), P(None, BATCH_AXIS), P()),
-            out_specs=P(BATCH_AXIS), check_vma=False,
-        ))
-        return lambda dg, du, xin, p, _k: sm_pre(dg, du, xin, p)
+        return dispatch
 
     def _init_cpu(self):
         from ..baseline.cpu_matcher import CPUViterbiMatcher
 
+        self._serial_dispatch = False
         self._cpu = CPUViterbiMatcher(self.arrays, self.ubodt, self.cfg)
 
     def _put_packed(self, xin: np.ndarray):
@@ -1231,10 +1341,16 @@ class SegmentMatcher:
         """Rows per device batch for window length blen: bound B*T (the
         kernel materialises [B, T, K, K]) with a row cap on top, rounded
         DOWN to a _BATCH_LADDER rung so batch padding (which rounds UP to a
-        rung) can never overshoot the configured memory bound.  Never below
-        the dp mesh width: a chunk must split evenly across devices."""
-        cap = max(1, min(int(self.cfg.max_device_batch),
-                         int(self.cfg.max_device_points) // blen))
+        rung) can never overshoot the configured memory bound.  The
+        max_device_batch / max_device_points budgets are PER CHIP: a dp
+        mesh splits every batch 1/n_dp per device, so the replica-level
+        cap multiplies by the dp width — adding chips raises admission
+        capacity (docs/performance.md "One logical matcher per pod").
+        Never below the dp mesh width: a chunk must split evenly across
+        devices."""
+        n_dp = self._n_dp if self.backend == "jax" else 1
+        cap = max(1, min(int(self.cfg.max_device_batch) * n_dp,
+                         int(self.cfg.max_device_points) * n_dp // blen))
         rung = self._BATCH_LADDER[0]
         for r in self._BATCH_LADDER:
             if r <= cap:
@@ -1244,6 +1360,34 @@ class SegmentMatcher:
             while rung & (rung - 1):
                 rung &= rung - 1
         return max(rung, self._n_dp if self.backend == "jax" else 1)
+
+    def capacity_summary(self) -> dict:
+        """The replica's capacity plane (docs/http-api.md /health
+        "capacity"): mesh topology, per-chip-budget-scaled admission
+        caps, and the byte budgets of the device-resident state
+        (UBODT tiering arena, session-beam slab).  Everything here
+        scales with the local device count — it is what the router's
+        capacity-aware ranking and the autoscaler's headroom model
+        consume, and what the committed measurement artifact
+        (docs/measurements/) pins against chip count."""
+        if self.backend != "jax":
+            return {"devices": 1, "mesh": {"dp": 1, "gp": 1},
+                    "max_device_batch": int(self.cfg.max_device_batch),
+                    "max_device_points": int(self.cfg.max_device_points)}
+        out = {
+            "devices": self._n_dp * self._n_gp,
+            "mesh": {"dp": self._n_dp, "gp": self._n_gp},
+            # replica-level admission caps: per-chip config budgets x the
+            # dp width (the same scaling _device_cap applies per dispatch)
+            "max_device_batch": int(self.cfg.max_device_batch) * self._n_dp,
+            "max_device_points":
+                int(self.cfg.max_device_points) * self._n_dp,
+        }
+        if self.tiering is not None:
+            out["ubodt"] = self.tiering.summary()
+        if self.session_arena is not None:
+            out["session_arena"] = self.session_arena.summary()
+        return out
 
     def _fill_rows(self, traces, idxs, T):
         """Pack traces[idxs] into padded [B, T] device arrays + times lists."""
@@ -2055,17 +2199,26 @@ class SegmentMatcher:
             p = self._params_for(item["pkey"])
             fn = self._get_jit("arena_session", kernel)
         kindname = "sparse_arena_session" if slabel else "arena_session"
+        # B = 1 padded to the dp width like _dispatch_session_chain; pad
+        # rows carry the out-of-range slot sentinel (gather clamps them,
+        # the mode="drop" scatter discards them)
+        b_pad = max(1, self._n_dp)
         chunk_outs = []
         with arena.lock:
             acq = arena.acquire_batch(
                 [(str(item["uuid"]), item.get("carry"))])
             (slot,), (use0,), (ref,) = acq
-            slots = np.asarray([slot], np.int32)
-            use = np.asarray([use0], bool)
+            slots = np.full(b_pad, arena.hot_slots, np.int32)
+            slots[0] = slot
+            use = np.zeros(b_pad, bool)
+            use[0] = use0
             for c0 in range(0, len(pts), W):
                 chunk = dict(item, points=pts[c0 : c0 + W])
                 px, py, tm, valid, ns = self._fill_session_rows(
                     [chunk], [0], W)
+                if b_pad > 1:
+                    px, py, tm, valid = _pad_rows(
+                        b_pad - 1, px, py, tm, valid)
                 xin = self._put_packed(pack_inputs(px, py, tm, valid))
                 t0 = _time.monotonic()
                 if sp is not None:
@@ -2077,10 +2230,11 @@ class SegmentMatcher:
                         self._dg, self._du, xin, p, self.cfg.beam_k,
                         arena.hot, slots, use)
                 arena.swap_hot(slab_out)
-                use = np.asarray([True], bool)
+                use = use.copy()
+                use[0] = True
                 C_DISPATCHES.labels(kernel).inc()
                 C_DISPATCH_COHORT.labels("session", "chain").inc()
-                self._note_dispatch((1, W), _time.monotonic() - t0,
+                self._note_dispatch((b_pad, W), _time.monotonic() - t0,
                                     kind=kindname, kernel=kernel)
                 chunk_outs.append((packed, aux, ns[0]))
         self._start_host_copy(chunk_outs[-1][0])
@@ -2235,6 +2389,15 @@ class SegmentMatcher:
                 # plus the kernel-independent chunk-batched precompute
                 n_shapes += 1
                 C_WARM_SHAPES.labels("none").inc()
+                # on a dp mesh the pre wave's rows are chunks * n_dp, so
+                # the 3-4-chunk streaming operating point lands on a
+                # HIGHER ladder rung than the 2-chunk trace above warmed
+                # — dispatch a 4-chunk trace too (a free re-dispatch when
+                # the rungs coincide, as on a single device)
+                if self._n_dp > 1:
+                    self.match_many(_dummy_traces(4 * w, 1))
+                    n_shapes += 1
+                    C_WARM_SHAPES.labels("none").inc()
         if session_step:
             # pre-dispatch the per-vehicle incremental-step shapes: one
             # (batch rung, session bucket) grid through the REAL session

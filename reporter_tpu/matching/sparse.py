@@ -142,6 +142,12 @@ class SparseModel:
     per match_many call and nothing else."""
 
     def __init__(self, cfg, cell_size: float, mesh: bool = False):
+        # ``mesh`` is accepted for call-site compatibility but no longer
+        # disables anything: partitioning became a first-class axis of the
+        # (kind, kernel) program family (parallel/rules.py), so the sparse
+        # variants dispatch through the same rule-table-sharded programs
+        # as dense traffic on any mesh topology.
+        del mesh
         self.cfg = cfg
         self.cell_size = float(cell_size)
         env = os.environ.get("REPORTER_SPARSE", "").strip().lower()
@@ -149,14 +155,6 @@ class SparseModel:
             self.enabled = env not in ("0", "false", "off", "no")
         else:
             self.enabled = bool(getattr(cfg, "sparse", False))
-        if self.enabled and mesh:
-            # the dp/gp mesh programs do not carry sparse variants (the
-            # shard_map wrappers would need their own sp legs); like UBODT
-            # tiering, the model steps aside rather than half-applying
-            log.warning("REPORTER_SPARSE ignored: the sparse model does "
-                        "not compose with a device mesh (cfg.devices/"
-                        "graph_devices > 1)")
-            self.enabled = False
         self.gap_s = float(getattr(cfg, "sparse_gap_s", 40.0) or 40.0)
         self.calibration: Optional[dict] = None
         if self.enabled:

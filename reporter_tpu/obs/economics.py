@@ -723,8 +723,10 @@ def publish_memory(matcher=None, session_store=None) -> None:
     if tiering is not None:
         try:
             ts = tiering.summary()
+            # hot_bytes is the PER-CHIP budget; the device gauge aggregates
+            # across the mesh like the summed bytes_in_use above
             G_MEMORY.labels("device", "ubodt_hot").set(
-                float(ts.get("hot_bytes") or 0.0))
+                float(ts.get("hot_bytes_total") or ts.get("hot_bytes") or 0.0))
             G_MEMORY.labels("host", "ubodt_cold").set(
                 float(ts.get("table_bytes") or 0.0))
         except Exception:  # noqa: BLE001

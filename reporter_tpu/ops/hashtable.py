@@ -119,16 +119,20 @@ def _select(rows: jnp.ndarray, src: jnp.ndarray, dst: jnp.ndarray):
     return dist, time, first
 
 
-def _bucket_rows(u, b: jnp.ndarray) -> jnp.ndarray:
+def _bucket_rows(u, b: jnp.ndarray, valid=None) -> jnp.ndarray:
     """One bucket-row fetch [..., 128 or 256] — a plain gather from a
     device-resident packed table, or the hot-arena / host-paged two-tier
     path when the table is tiered (tiles/tiering.py: bit-identical rows
-    either way, only the executed memory traffic changes)."""
+    either way, only the executed memory traffic changes).  ``valid``
+    (None = all) marks which probes are real: the gp-sharded probe clamps
+    remote buckets to a local index and masks the rows afterwards, and
+    the tiered path must neither count those phantom probes in its EWMA
+    stats nor let them force the cold-page fallback."""
     if getattr(u, "tier", None) is None:
         return u.packed[b]
     from ..tiles.tiering import tiered_bucket_rows
 
-    return tiered_bucket_rows(u, b)
+    return tiered_bucket_rows(u, b, valid)
 
 
 def _lookup_plain(u: DeviceUBODT, src: jnp.ndarray, dst: jnp.ndarray):
@@ -249,9 +253,12 @@ def _ubodt_lookup_sharded(u: DeviceUBODT, src: jnp.ndarray, dst: jnp.ndarray):
     local range; keys are unique, so at most one rank hits and a pmin/pmax
     over the shard axis resolves every query exactly.  Communication is three
     small collectives per lookup batch, riding the ICI — the table itself
-    never moves.
+    never moves.  Works for the plain packed table AND the tiered one: the
+    local row fetch routes through _bucket_rows, so a rank's bucket range
+    can itself be a hot-arena + cold-pages tier (the contiguous-bucket
+    partition is the same shard_bucket_range either way).
     """
-    L = u.packed.shape[0]  # local bucket-range length
+    L = u.local_buckets  # local bucket-range length
     lo = jax.lax.axis_index(u.shard_axis) * L
     src, dst = jnp.broadcast_arrays(src, dst)
     b1 = device_pair_hash(src, dst, u.bmask)
@@ -260,7 +267,7 @@ def _ubodt_lookup_sharded(u: DeviceUBODT, src: jnp.ndarray, dst: jnp.ndarray):
         with stage("ubodt-probe"):
             loc = b - lo
             inr = (loc >= 0) & (loc < L)
-            r = u.packed[jnp.where(inr, loc, 0)]  # [..., 128 or 256]
+            r = _bucket_rows(u, jnp.where(inr, loc, 0), valid=inr)
             # out-of-range buckets contribute entries that match nothing (-2)
             return jnp.where(inr[..., None], r, -2)
 

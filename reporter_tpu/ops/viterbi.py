@@ -1044,6 +1044,104 @@ def session_step_arena(dg: DeviceGraph, du: DeviceUBODT, xin,
     return pack_compact(_compact(res)), res.aux, slab_out
 
 
+def _arena_gather_mesh(slab: TraceCarry, slots: jnp.ndarray,
+                       batch_axis: str) -> TraceCarry:
+    """Gather global-slot beam rows from a slot-sharded slab inside a
+    shard_map: the slab's leading [S] axis is split over ``batch_axis``
+    (S_local rows per shard) while ``slots`` is the replicated global
+    [B] slot map.  Exactly one shard owns any live slot, so each shard
+    contributes its owned rows as int32 bit patterns (zero elsewhere)
+    and a psum over the shard axis reconstructs the owner's bytes — a
+    sum of one nonzero pattern and zeros is EXACT, so the gathered
+    carry is bit-identical to a single-device ``slab[slots]`` gather
+    (including -0.0 and NaN payloads a float max-select would mangle).
+    Padding rows (slot == global S, owned by nobody) come back as
+    zeros; callers mask them with ``use_carry`` exactly like the
+    single-device step."""
+    s_local = slab.scores.shape[0]
+    lo = jax.lax.axis_index(batch_axis) * s_local
+    loc = jnp.clip(slots - lo, 0, s_local - 1)
+    owned = (slots >= lo) & (slots < lo + s_local)
+
+    def _one(leaf):
+        x = leaf[loc]
+        m = owned.reshape((-1,) + (1,) * (x.ndim - 1))
+        if jnp.issubdtype(x.dtype, jnp.floating):
+            bits = jax.lax.bitcast_convert_type(x, jnp.int32)
+            out = jax.lax.psum(jnp.where(m, bits, 0), batch_axis)
+            return jax.lax.bitcast_convert_type(out, x.dtype)
+        if x.dtype == jnp.bool_:
+            out = jax.lax.psum(
+                jnp.where(m, x.astype(jnp.int32), 0), batch_axis)
+            return out > 0
+        return jax.lax.psum(jnp.where(m, x, 0), batch_axis)
+
+    return jax.tree_util.tree_map(_one, slab)
+
+
+def _arena_scatter_mesh(slab: TraceCarry, carry_out: TraceCarry,
+                        slots: jnp.ndarray, batch_axis: str) -> TraceCarry:
+    """Scatter a shard's successor beams back into the slot-sharded
+    slab: the local [b_local] carry block is all_gather'd to the global
+    [B] batch (every shard needs rows whose slots IT owns, wherever
+    they were decoded), then each shard writes exactly its owned rows —
+    unowned and padding rows target the out-of-bounds local index and
+    the ``mode="drop"`` scatter discards them, the same contract as the
+    single-device step."""
+    s_local = slab.scores.shape[0]
+    lo = jax.lax.axis_index(batch_axis) * s_local
+    owned = (slots >= lo) & (slots < lo + s_local)
+    tgt = jnp.where(owned, jnp.clip(slots - lo, 0, s_local - 1), s_local)
+
+    def _one(s, c):
+        cg = jax.lax.all_gather(c, batch_axis, axis=0, tiled=True)
+        return s.at[tgt].set(cg, mode="drop")
+
+    return jax.tree_util.tree_map(_one, slab, carry_out)
+
+
+def session_step_arena_mesh(dg: DeviceGraph, du: DeviceUBODT, xin,
+                            p: MatchParams, k: int, slab: TraceCarry,
+                            slots: jnp.ndarray, use_carry: jnp.ndarray,
+                            kernel: str = "scan", sp=None,
+                            batch_axis: str = "dp"):
+    """session_step_arena inside a shard_map over a device mesh
+    (docs/performance.md "One logical matcher per pod"): the beam slab's
+    slot axis is sharded over ``batch_axis`` so a replica's carried
+    beams live in POD-level HBM, while the packed inputs ride the batch
+    axis as usual and ``slots``/``use_carry`` arrive replicated (every
+    shard needs the full slot map to resolve ownership).  Gather and
+    scatter move exact int32 bit patterns through one psum/all_gather
+    pair over tiny [B, K] blocks, so the step's wire output — and the
+    slab bytes — are bit-identical to the single-device arena step; the
+    slab is still donated by the dispatcher, so the in-place zero-
+    per-step-transfer contract survives the mesh.  ``sp`` selects the
+    sparse-cohort transition model (None = dense), mirroring the
+    session_step_arena / session_step_arena_sparse pair."""
+    import functools
+
+    px, py, times, valid = unpack_inputs(xin)
+    b_local = px.shape[0]
+    i0 = jax.lax.axis_index(batch_axis) * b_local
+    gathered_g = _arena_gather_mesh(slab, slots, batch_axis)
+    gathered = jax.tree_util.tree_map(
+        lambda g: jax.lax.dynamic_slice_in_dim(g, i0, b_local, axis=0),
+        gathered_g)
+    use = jax.lax.dynamic_slice_in_dim(use_carry, i0, b_local, axis=0)
+    inact = initial_carry_batch(b_local, k)
+
+    def _sel(g, i):
+        return jnp.where(use.reshape((-1,) + (1,) * (g.ndim - 1)), g, i)
+
+    carry = jax.tree_util.tree_map(_sel, gathered, inact)
+    fn = functools.partial(match_trace, kernel=kernel, sp=sp)
+    res, carry_out = jax.vmap(
+        fn, in_axes=(None, None, 0, 0, 0, 0, None, None, 0)
+    )(dg, du, px, py, times, valid, p, k, carry)
+    slab_out = _arena_scatter_mesh(slab, carry_out, slots, batch_axis)
+    return pack_compact(_compact(res)), res.aux, slab_out
+
+
 # -- sparse-gap packed entry points -------------------------------------------
 #
 # The sparse-gap matching model (docs/match-quality.md "Sparse gaps") rides

@@ -28,9 +28,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..ops.viterbi import MatchParams, MatchResult, match_batch
 from ..tiles.arrays import DeviceGraph
 from ..tiles.ubodt import DeviceUBODT
-
-BATCH_AXIS = "dp"
-GRAPH_AXIS = "gp"
+from .rules import BATCH_AXIS, GRAPH_AXIS, shard_map
 
 
 def make_mesh(n_devices: Optional[int] = None, devices: Optional[Sequence] = None) -> Mesh:
@@ -187,7 +185,7 @@ def graph_sharded_match_fn(mesh: Mesh, k: int, num_segments: int):
         return res, hist
 
     # pytree-prefix specs: one spec covers every leaf of that argument/result
-    sm = jax.shard_map(
+    sm = shard_map(
         body,
         mesh=mesh,
         in_specs=(P(), P(GRAPH_AXIS), P(BATCH_AXIS), P(BATCH_AXIS),

@@ -14,6 +14,16 @@ stall (docs/performance.md).  Paired with $REPORTER_XLA_CACHE_DIR the
 restart cost is a disk replay, not an XLA compile.  Without the flag the
 background per-bucket warm of the deferred boot runs as before (config
 key "warmup": false disables that entirely).
+
+A replica may span a local device mesh (matcher config keys ``devices``
+/ ``graph_devices``, or the REPORTER_DEVICES / REPORTER_GRAPH_DEVICES
+env overrides): one logical matcher per replica, mesh-inside-replica x
+fleet-across-replicas (docs/serving-fleet.md).  /health advertises the
+resolved "capacity" block — mesh shape, scaled admission caps, and the
+device-resident byte budgets — which the fleet router's weighted
+ranking and the supervisor's autoscaler consume; with --warmup the
+pre-dispatched programs ARE the mesh-sharded ones, so the first
+mesh-sharded request never compiles inline.
 """
 
 import logging
@@ -296,8 +306,15 @@ def _main(argv):
                         logging.exception(
                             "--warmup pass failed; serving with inline compiles")
                 service.attach_matcher(matcher)
-                logging.info("engine live (backend=%s, %d edges)",
-                             matcher.backend, matcher.arrays.num_edges)
+                cap = (matcher.capacity_summary()
+                       if hasattr(matcher, "capacity_summary") else {})
+                mesh_shape = cap.get("mesh") or {}
+                logging.info(
+                    "engine live (backend=%s, %d edges, %d device(s), "
+                    "mesh dp=%d gp=%d)", matcher.backend,
+                    matcher.arrays.num_edges, int(cap.get("devices") or 1),
+                    int(mesh_shape.get("dp") or 1),
+                    int(mesh_shape.get("gp") or 1))
                 if conf.get("warmup", True) and not full_warm:
                     # background warm of the deferred boot: requests racing
                     # it just compile their shape inline, exactly as with

@@ -128,10 +128,26 @@ class Autoscaler:
 
     # -- the decision core (deterministic; unit-tested directly) ------------
 
+    def effective_queue_high(self, sig: dict) -> float:
+        """The queue-depth threshold, scaled by the fleet's mean chips
+        per replica (each replica's advertised /health "capacity"
+        devices, summed by the supervisor): a mesh-inside-replica admits
+        n_chips x the per-chip batch budget, so the fleet legitimately
+        absorbs proportionally deeper queues before a new replica is
+        justified (docs/performance.md "One logical matcher per pod").
+        Absent capacity signals (legacy supervisors) this is exactly
+        ``queue_high``."""
+        n = float(sig.get("replicas") or 0.0)
+        chips = float(sig.get("devices") or 0.0)
+        if n > 0 and chips > n:
+            return self.queue_high * chips / n
+        return self.queue_high
+
     def observe(self, sig: dict, now: Optional[float] = None) -> None:
         now = self._clock() if now is None else now
         depth = float(sig.get("queue_depth") or 0.0)
-        self._gate.observe("queue", 503 if depth > self.queue_high else 200,
+        high = self.effective_queue_high(sig)
+        self._gate.observe("queue", 503 if depth > high else 200,
                            None, now=now)
 
     def gate_alerting(self, now: Optional[float] = None

@@ -69,6 +69,7 @@ import argparse
 import hashlib
 import json
 import logging
+import math
 import os
 import random
 import re
@@ -212,6 +213,12 @@ class Replica:
         # UBODT shard assignment "i/N" learned from the /health payload
         # (docs/serving-fleet.md "Sharded tables"); None = unsharded
         self.shard: Optional[str] = None
+        # mesh/admission capacity advertised by /health ("capacity" block,
+        # docs/http-api.md): device count, dp x gp mesh shape, scaled
+        # byte/batch budgets.  Drives the weighted rendezvous ranking —
+        # a replica spanning more chips inherits proportionally more
+        # vehicles, with zero client-visible change.
+        self.capacity: Optional[dict] = None
         self.state = "init"                  # init|healthy|draining|unhealthy
         self.probe_fail_streak = 0
         self.probe_ok_streak = 0
@@ -245,6 +252,8 @@ class Replica:
         return {
             "url": self.url, "id": self.id, "state": self.state,
             "shard": self.shard,
+            "devices": ((self.capacity or {}).get("devices")
+                        if isinstance(self.capacity, dict) else None),
             "available": self.available(now),
             "fail_streak": self.fail_streak,
             "probe_fail_streak": self.probe_fail_streak,
@@ -436,6 +445,9 @@ class FleetRouter:
         shard = info.get("ubodt_shard")
         if shard:
             r.shard = str(shard)
+        cap = info.get("capacity")
+        if isinstance(cap, dict):
+            r.capacity = cap
         r.last_probe = {"status": status,
                         "state": info.get("status"),
                         "t": round(_time.time(), 3)}
@@ -876,28 +888,55 @@ class FleetRouter:
             return 0
         return 1 if n > 0 and cell % n == idx else 0
 
+    def _capacity_weight(self, r: Replica) -> float:
+        """Ranking weight of a replica = its advertised local device
+        count (the /health "capacity" block) — a mesh-inside-replica
+        spanning N chips inherits ~N times the vehicles of a 1-chip
+        replica.  1.0 when nothing is advertised (unprobed / legacy)."""
+        cap = r.capacity if isinstance(r.capacity, dict) else None
+        try:
+            return max(1.0, float((cap or {}).get("devices") or 1))
+        except (TypeError, ValueError):
+            return 1.0
+
+    def _capacity_score(self, uuid: str, r: Replica, w: float) -> float:
+        """Weighted rendezvous score (highest-random-weight with weights):
+        map the 64-bit hash to u in (0,1) and score w / -ln(u).  Strictly
+        monotone in the hash, so for EQUAL weights the ordering is the
+        plain rendezvous ordering bit-for-bit — weighting only engages
+        when some replica advertises more chips — and the minimal-
+        remapping property survives: a capacity change on one replica
+        only remaps vehicles toward/away from THAT replica."""
+        h = rendezvous_score(uuid, r.url)
+        u = (h + 0.5) / 2.0 ** 64
+        return w / -math.log(u)
+
     def ranked(self, uuid: str,
                geo: Optional[Tuple[float, float]] = None) -> List[Replica]:
-        """Replicas in rendezvous order.  With the geo flag ON and a
-        usable coordinate, the shard-covering replica ranks first and the
-        rendezvous hash breaks ties; with the flag off (the default) the
-        ranking is the PR 9 rendezvous hash bit-for-bit — ``geo`` is
-        never even computed by the callers then."""
+        """Replicas in rendezvous order, capacity-weighted.  With the geo
+        flag ON and a usable coordinate, the shard-covering replica ranks
+        first and the weighted hash breaks ties; with the flag off (the
+        default) and a homogeneous fleet the ranking is the PR 9
+        rendezvous hash bit-for-bit — ``geo`` is never even computed by
+        the callers then, and equal weights reduce the weighted score to
+        the plain hash ordering."""
+        weights = {id(r): self._capacity_weight(r) for r in self.replicas}
+        if len(set(weights.values())) > 1:
+            score = lambda r: self._capacity_score(  # noqa: E731
+                uuid, r, weights[id(r)])
+        else:
+            score = lambda r: rendezvous_score(uuid, r.url)  # noqa: E731
         if geo is not None and self.geo_routing:
             cell = geo_cell(geo[0], geo[1], self.geo_cell_deg)
             ranked = sorted(
                 self.replicas,
-                key=lambda r: (self._geo_pref(r, cell),
-                               rendezvous_score(uuid, r.url)),
+                key=lambda r: (self._geo_pref(r, cell), score(r)),
                 reverse=True)
-            plain_top = max(self.replicas,
-                            key=lambda r: rendezvous_score(uuid, r.url))
+            plain_top = max(self.replicas, key=score)
             C_GEO.labels("aligned" if ranked[0] is plain_top
                          else "steered").inc()
             return ranked
-        return sorted(self.replicas,
-                      key=lambda r: rendezvous_score(uuid, r.url),
-                      reverse=True)
+        return sorted(self.replicas, key=score, reverse=True)
 
     def route_order(self, uuid: str,
                     geo: Optional[Tuple[float, float]] = None,
@@ -1244,6 +1283,11 @@ class FleetRouter:
                 "draining": (statusz or {}).get("draining"),
                 "degraded": (statusz or {}).get("degraded"),
                 "warming": (statusz or {}).get("warming"),
+                # advertised local mesh size (the /health "capacity"
+                # block): what the weighted ranking and the supervisor's
+                # capacity-aware queue gate consume
+                "devices": ((r.capacity or {}).get("devices")
+                            if isinstance(r.capacity, dict) else None),
                 "queue_depth": obs_fed.snapshot_scalar(
                     snap, "reporter_microbatch_queue_depth"),
                 "inflight": obs_fed.snapshot_scalar(

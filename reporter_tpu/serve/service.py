@@ -953,8 +953,14 @@ class ReporterService:
             threshold = int(os.environ.get("THRESHOLD_SEC", matcher.cfg.threshold_sec))
         self.threshold_sec = int(threshold)
         self.matcher = matcher
-        self.economics.ledger.set_chips(
-            int(getattr(matcher.cfg, "devices", 1)))
+        # chip-second accrual scales with the replica's LOCAL mesh size:
+        # prefer the matcher's resolved device count (dp x gp) over the
+        # configured one so a mesh-inside-replica bills every chip it spans
+        try:
+            chips = int((matcher.capacity_summary() or {}).get("devices", 1))
+        except Exception:  # noqa: BLE001 - cpu/legacy matchers lack the summary
+            chips = int(getattr(matcher.cfg, "devices", 1))
+        self.economics.ledger.set_chips(max(1, chips))
         self.batcher = self._make_batcher(matcher)
         # session plane: the store survives matcher/batcher swaps (carries
         # live pinned-host), so a degraded window or re-attach never drops
@@ -1509,6 +1515,15 @@ class ReporterService:
             "viterbi_kernel": getattr(m, "_kernel_mode", None) if m else None,
             "devices": int(getattr(m.cfg, "devices", 1)) if m else None,
             "graph_devices": int(getattr(m.cfg, "graph_devices", 1)) if m else None,
+            # the capacity plane (docs/http-api.md, docs/performance.md
+            # "One logical matcher per pod"): in-replica mesh topology,
+            # admission caps and device-state byte budgets, all scaled by
+            # the local device count.  The router's capacity-aware ranking
+            # term and the autoscaler's headroom model consume this —
+            # a pod-sized replica advertises pod-sized capacity.
+            "capacity": (m.capacity_summary()
+                         if m is not None and
+                         hasattr(m, "capacity_summary") else None),
             "edges": int(m.arrays.num_edges) if m else None,
             "ubodt_rows": int(m.ubodt.num_rows) if m else None,
             # fleet shard assignment + hot/cold tiering (docs/serving-

@@ -127,15 +127,21 @@ class TieredDeviceUBODT:
 
     ``hot`` resolves through the manager for the long-lived instance the
     matcher holds (so maintenance is visible to the next dispatch), and
-    holds the traced leaves for instances the tracer reconstructs."""
+    holds the traced leaves for instances the tracer reconstructs.
 
-    shard_axis = None  # tiered tables never ride the shard_map path
+    ``shard_axis`` names a mesh axis when the hot leaves are 1/N
+    bucket-range slices inside a shard_map (parallel/rules.py: the tier
+    shards by the SAME contiguous shard_bucket_range partition the fleet
+    sharding uses, so each gp rank's local slot_map holds LOCAL slot ids
+    into its local arena block and its local cold pages)."""
 
-    def __init__(self, hot, bmask: int, layout: str, tier: "TieredTable"):
+    def __init__(self, hot, bmask: int, layout: str, tier: "TieredTable",
+                 shard_axis=None):
         self._hot = hot
         self.bmask = int(bmask)
         self.layout = layout
         self.tier = tier
+        self.shard_axis = shard_axis
 
     @property
     def hot(self):
@@ -145,14 +151,19 @@ class TieredDeviceUBODT:
     def max_probes(self) -> int:
         return 1 if self.layout == "wide32" else 2
 
+    @property
+    def local_buckets(self) -> int:
+        """Bucket count of THIS view's slot map — the full table, or the
+        1/N local range inside a shard_map (the sharded prober's L)."""
+        return self.hot[1].shape[0]
+
     def with_shard_axis(self, axis: str):
-        raise ValueError(
-            "a tiered UBODT cannot be bucket-range sharded over a mesh "
-            "axis: the gp shard_map path and host-paged tiering are "
-            "alternative HBM-scaling legs (docs/performance.md)")
+        return TieredDeviceUBODT(self._hot, self.bmask, self.layout,
+                                 self.tier, shard_axis=axis)
 
     def tree_flatten(self):
-        return ((self.hot,), (self.bmask, self.layout, self.tier))
+        return ((self.hot,), (self.bmask, self.layout, self.tier,
+                              self.shard_axis))
 
     @classmethod
     def tree_unflatten(cls, aux, children):
@@ -189,7 +200,8 @@ class TieredTable:
 
     def __init__(self, ubodt: UBODT, hot_bytes: int,
                  shard: Optional[Tuple[int, int]] = None,
-                 maintain_every: int = 8, ewma_decay: float = 0.8):
+                 maintain_every: int = 8, ewma_decay: float = 0.8,
+                 mesh=None, n_gp: int = 1):
         self.ubodt = ubodt
         self.hot_bytes = int(hot_bytes)
         self.shard = shard
@@ -197,15 +209,31 @@ class TieredTable:
         self.ewma_decay = float(ewma_decay)
         self.lanes = bucket_entries(ubodt.layout) * ROW_W
         self.n_buckets = ubodt.n_buckets
+        # the replica's device mesh (parallel/rules.py): with a gp axis of
+        # size n_gp the tier's leaves shard by contiguous bucket range —
+        # each rank holds 1/n_gp of the slot map + cold pages and its OWN
+        # hot arena block, so the per-chip budget multiplies into a
+        # pod-level one.  A dp-only mesh replicates the leaves (the specs
+        # resolve gp away), which is what GSPMD needs to keep the plain
+        # jits SPMD.
+        self.mesh = mesh
+        self.n_gp = max(1, int(n_gp))
+        if self.n_buckets % self.n_gp:
+            raise ValueError(
+                "UBODT bucket count %d not divisible by gp=%d (use a "
+                "power-of-two gp axis)" % (self.n_buckets, self.n_gp))
+        self.shard_len = self.n_buckets // self.n_gp
         # the host pages: the FULL packed table, rank-2 contiguous so the
         # cold-fetch fancy-index is one C-level gather
         self.pages = np.ascontiguousarray(
             ubodt.packed.reshape(self.n_buckets, self.lanes), np.int32)
         row_bytes = self.lanes * 4
-        # hot capacity in bucket rows; a budget smaller than one row is a
-        # legal (if silly) configuration — everything cold, output still
-        # bit-identical (tests/test_tiering.py pins it)
-        self.capacity = min(self.n_buckets, self.hot_bytes // row_bytes)
+        # hot capacity in bucket rows PER DEVICE (hot_bytes is the
+        # per-chip budget; a gp mesh holds capacity rows on EACH rank);
+        # a budget smaller than one row is a legal (if silly)
+        # configuration — everything cold, output still bit-identical
+        # (tests/test_tiering.py pins it)
+        self.capacity = min(self.shard_len, self.hot_bytes // row_bytes)
         self._lock = threading.Lock()
         self._ewma = np.zeros(self.n_buckets, np.float64)
         self._counts = np.zeros(self.n_buckets, np.int64)
@@ -225,11 +253,26 @@ class TieredTable:
         self._stats_thread.start()
         self._hot_set = np.zeros(0, np.int64)
         # seed: the replica's shard partition (as much of it as fits),
-        # so a sharded fleet boots with its own bucket range resident
-        if shard is not None and self.capacity > 0:
-            lo, hi = shard_bucket_range(shard[0], shard[1], self.n_buckets)
-            self._hot_set = np.arange(lo, min(hi, lo + self.capacity),
-                                      dtype=np.int64)
+        # so a sharded fleet boots with its own bucket range resident.
+        # Under a gp mesh every rank seeds the prefix of ITS bucket range
+        # (intersected with the fleet shard when both are set) — the gp
+        # partition IS a shard assignment, and booting with all ranks'
+        # arenas resident is what the mesh-rehearsal /statusz asserts.
+        if self.capacity > 0 and (shard is not None or self.n_gp > 1):
+            if shard is not None:
+                s_lo, s_hi = shard_bucket_range(shard[0], shard[1],
+                                                self.n_buckets)
+            else:
+                s_lo, s_hi = 0, self.n_buckets
+            parts = []
+            for g in range(self.n_gp):
+                lo = max(s_lo, g * self.shard_len)
+                hi = min(s_hi, (g + 1) * self.shard_len)
+                if lo < hi:
+                    parts.append(np.arange(
+                        lo, min(hi, lo + self.capacity), dtype=np.int64))
+            if parts:
+                self._hot_set = np.concatenate(parts)
         # the cold tier: the full pages as ONE immutable array leaf in
         # host memory where the backend offers it (TPU pinned_host = XLA
         # host offload; the CPU backend's default memory IS host DRAM)
@@ -264,6 +307,23 @@ class TieredTable:
         import jax
         import jax.numpy as jnp
 
+        if self.mesh is not None:
+            dev = next(iter(self.mesh.devices.flat))
+            try:
+                pages = jax.device_put(
+                    self.pages, self._leaf_sharding("pinned_host"))
+                return pages, "pinned_host"
+            except Exception:  # noqa: BLE001 - backend without host offload
+                kind = getattr(dev, "default_memory", lambda: None)()
+                kind = getattr(kind, "kind", "device")
+                if dev.platform != "cpu":
+                    log.warning(
+                        "ubodt tiering: backend %s lacks pinned_host "
+                        "memory; cold pages are %s-resident (capacity "
+                        "win deferred to a host-offload-capable jax)",
+                        dev.platform, kind)
+                return jax.device_put(self.pages,
+                                      self._leaf_sharding()), kind
         dev = jax.devices()[0]
         try:
             sharding = jax.sharding.SingleDeviceSharding(
@@ -280,18 +340,55 @@ class TieredTable:
                     "to a host-offload-capable jax)", dev.platform, kind)
             return jnp.asarray(self.pages), kind
 
+    def _leaf_sharding(self, memory_kind: Optional[str] = None):
+        """The rule table's placement for a tier leaf on this mesh:
+        bucket-range over "gp" (axis 0) when the mesh carries that axis,
+        replicated otherwise (parallel/rules.py: the du rule)."""
+        import jax
+
+        from ..parallel.rules import GRAPH_AXIS, resolve_spec
+
+        spec = resolve_spec(jax.sharding.PartitionSpec(GRAPH_AXIS),
+                            self.mesh.axis_names)
+        if memory_kind is None:
+            return jax.sharding.NamedSharding(self.mesh, spec)
+        return jax.sharding.NamedSharding(self.mesh, spec,
+                                          memory_kind=memory_kind)
+
     def _build_hot(self, hot_set: np.ndarray):
         """(arena, slot_map) device arrays for a hot bucket set.  The
         arena always has >= 1 row so the hot-path gather's clamped index
-        is in bounds even at capacity 0."""
+        is in bounds even at capacity 0.
+
+        Under a gp mesh the arena is laid out in n_gp equal per-rank
+        blocks (rank g's hot rows at [g*rows, g*rows+len)) and the slot
+        map holds LOCAL slot ids — inside the shard_map each rank sees
+        exactly its own (arena block, slot-map range, page range) triple,
+        and the contiguous block split IS shard_bucket_range."""
+        import jax
         import jax.numpy as jnp
 
-        arena = np.zeros((max(1, len(hot_set)), self.lanes), np.int32)
-        if len(hot_set):
-            arena[: len(hot_set)] = self.pages[hot_set]
-        slot_map = np.full(self.n_buckets, -1, np.int32)
-        slot_map[hot_set] = np.arange(len(hot_set), dtype=np.int32)
-        return jnp.asarray(arena), jnp.asarray(slot_map), self._pages_dev
+        if self.n_gp <= 1:
+            arena = np.zeros((max(1, len(hot_set)), self.lanes), np.int32)
+            if len(hot_set):
+                arena[: len(hot_set)] = self.pages[hot_set]
+            slot_map = np.full(self.n_buckets, -1, np.int32)
+            slot_map[hot_set] = np.arange(len(hot_set), dtype=np.int32)
+        else:
+            rows = max(1, self.capacity)
+            arena = np.zeros((rows * self.n_gp, self.lanes), np.int32)
+            slot_map = np.full(self.n_buckets, -1, np.int32)
+            L = self.shard_len
+            for g in range(self.n_gp):
+                mine = hot_set[(hot_set >= g * L)
+                               & (hot_set < (g + 1) * L)][: self.capacity]
+                arena[g * rows: g * rows + len(mine)] = self.pages[mine]
+                slot_map[mine] = np.arange(len(mine), dtype=np.int32)
+        if self.mesh is None:
+            return jnp.asarray(arena), jnp.asarray(slot_map), self._pages_dev
+        sh = self._leaf_sharding()
+        return (jax.device_put(arena, sh), jax.device_put(slot_map, sh),
+                self._pages_dev)
 
     # -- the stats side-channel (device program -> host) --------------------
 
@@ -324,12 +421,17 @@ class TieredTable:
                 break
             b = np.asarray(b).reshape(-1)
             hot = np.asarray(hot).reshape(-1)
-            n_hit = int(np.count_nonzero(hot))
-            n_miss = b.size - n_hit
+            # mask phantom samples: the gp-sharded probe reports remote
+            # buckets as -1 (they are some OTHER rank's probes, counted
+            # there), and any out-of-range id would corrupt the bincount
+            keep = (b >= 0) & (b < self.n_buckets)
+            n_hit = int(np.count_nonzero(hot & keep))
+            n_miss = int(np.count_nonzero(keep)) - n_hit
             C_TIER_HITS.inc(n_hit)
             C_TIER_MISSES.inc(n_miss)
             with self._lock:
-                self._counts += np.bincount(b, minlength=self.n_buckets)
+                self._counts += np.bincount(b[keep],
+                                            minlength=self.n_buckets)
                 self._dispatches_since_maintain += 1
                 self._misses_since_maintain += n_miss
                 due = due or (
@@ -343,8 +445,10 @@ class TieredTable:
     def maintain(self) -> dict:
         """One admission/eviction pass: fold the window's probe counts
         into the EWMA, take the top-``capacity`` buckets as the new hot
-        set, rebuild the arena, and publish it.  Returns counters (tests
-        and /statusz)."""
+        set, rebuild the arena, and publish it.  Under a gp mesh the
+        selection runs independently per rank's bucket range (capacity
+        rows EACH), so one rank's traffic storm cannot evict another
+        rank's working set.  Returns counters (tests and /statusz)."""
         with self._lock:
             self._ewma *= self.ewma_decay
             self._ewma += self._counts
@@ -353,28 +457,17 @@ class TieredTable:
             self._misses_since_maintain = 0
             if self.capacity <= 0:
                 return {"hot_rows": 0, "admitted": 0, "evicted": 0}
-            if self.capacity >= self.n_buckets:
-                new_set = np.arange(self.n_buckets, dtype=np.int64)
+            if self.n_gp <= 1:
+                new_set = self._select_range(0, self.n_buckets,
+                                             self._hot_set)
             else:
-                # top-capacity by EWMA; ties resolve to the lowest bucket
-                # index (stable, so an all-zero score keeps the seeded set
-                # ordering deterministic)
-                top = np.argpartition(-self._ewma, self.capacity - 1)[
-                    : self.capacity]
-                new_set = np.sort(top).astype(np.int64)
-                # never evict a probed bucket for an unprobed one: drop
-                # zero-score winners in favour of the incumbent hot set
-                # (the seeded shard must not churn out under zero traffic)
-                zero = self._ewma[new_set] <= 0.0
-                n_zero = int(np.count_nonzero(zero))
-                if n_zero and len(self._hot_set):
-                    keep_old = self._hot_set[
-                        ~np.isin(self._hot_set, new_set)]
-                    fill = keep_old[:n_zero]
-                    new_set = np.sort(np.concatenate(
-                        [new_set[~zero],
-                         new_set[zero][: n_zero - len(fill)],
-                         fill])).astype(np.int64)
+                L = self.shard_len
+                new_set = np.concatenate([
+                    self._select_range(
+                        g * L, (g + 1) * L,
+                        self._hot_set[(self._hot_set >= g * L)
+                                      & (self._hot_set < (g + 1) * L)])
+                    for g in range(self.n_gp)])
             evicted = int(np.count_nonzero(
                 ~np.isin(self._hot_set, new_set)))
             admitted = int(np.count_nonzero(
@@ -386,6 +479,31 @@ class TieredTable:
             self._publish_gauges()
             return {"hot_rows": int(len(self._hot_set)),
                     "admitted": admitted, "evicted": evicted}
+
+    def _select_range(self, lo: int, hi: int,
+                      incumbent: np.ndarray) -> np.ndarray:
+        """Top-``capacity`` buckets of [lo, hi) by EWMA (caller holds the
+        lock).  Ties resolve to the lowest bucket index (stable, so an
+        all-zero score keeps the seeded set ordering deterministic), and
+        a probed bucket is never evicted for an unprobed one: zero-score
+        winners yield to the range's incumbent hot set (the seeded shard
+        must not churn out under zero traffic)."""
+        n = hi - lo
+        if self.capacity >= n:
+            return np.arange(lo, hi, dtype=np.int64)
+        top = np.argpartition(-self._ewma[lo:hi], self.capacity - 1)[
+            : self.capacity]
+        new_set = np.sort(top).astype(np.int64) + lo
+        zero = self._ewma[new_set] <= 0.0
+        n_zero = int(np.count_nonzero(zero))
+        if n_zero and len(incumbent):
+            keep_old = incumbent[~np.isin(incumbent, new_set)]
+            fill = keep_old[:n_zero]
+            new_set = np.sort(np.concatenate(
+                [new_set[~zero],
+                 new_set[zero][: n_zero - len(fill)],
+                 fill])).astype(np.int64)
+        return new_set
 
     def _publish_gauges(self) -> None:
         G_TIER_ROWS.set(len(self._hot_set))
@@ -403,10 +521,13 @@ class TieredTable:
             hot_rows = int(len(self._hot_set))
         return {
             "hot_bytes": self.hot_bytes,
+            "hot_bytes_total": self.hot_bytes * self.n_gp,
             "table_bytes": self.table_bytes,
             "n_buckets": self.n_buckets,
             "hot_rows": hot_rows,
             "capacity_rows": self.capacity,
+            "capacity_rows_total": self.capacity * self.n_gp,
+            "devices": self.n_gp,
             "residency_frac": round(hot_rows / max(1, self.n_buckets), 4),
             "layout": self.ubodt.layout,
             "cold_memory_kind": self.cold_memory_kind,
@@ -414,7 +535,7 @@ class TieredTable:
         }
 
 
-def tiered_bucket_rows(u: TieredDeviceUBODT, b):
+def tiered_bucket_rows(u: TieredDeviceUBODT, b, valid=None):
     """One bucket-row fetch [..., lanes] through the two-tier path — the
     ops/hashtable ``_bucket_rows`` seam for tiered tables.
 
@@ -428,7 +549,15 @@ def tiered_bucket_rows(u: TieredDeviceUBODT, b):
     Probe-frequency accounting rides a park-only debug.callback OUTSIDE
     the data path.  Under vmap (the carry/session seam transitions) the
     cond lowers to a select and both sides execute — correctness is
-    unaffected; only the fast-path skip is."""
+    unaffected; only the fast-path skip is.
+
+    ``valid`` (None = all) marks which probes are real: under the
+    gp-sharded probe remote buckets arrive clamped to local index 0 with
+    valid=False — they must not force the cold fallback (they are some
+    other rank's probes) and they report the -1 sentinel to the stats
+    drain instead of polluting bucket 0's EWMA.  Stats carry GLOBAL
+    bucket ids (local + axis_index * L), so the manager's counters mean
+    the same thing sharded or not."""
     import jax
     import jax.numpy as jnp
 
@@ -439,7 +568,17 @@ def tiered_bucket_rows(u: TieredDeviceUBODT, b):
     hot = slot >= 0
     with stage("tier-arena"):
         rows_hot = arena[jnp.where(hot, slot, 0)]
-    jax.debug.callback(u.tier._note, b, hot)
+    b_stat = b
+    if u.shard_axis is not None:
+        b_stat = b + jax.lax.axis_index(u.shard_axis) * slot_map.shape[0]
+    if valid is None:
+        hot_eff = hot
+        hot_stat = hot
+    else:
+        hot_eff = hot | ~valid
+        b_stat = jnp.where(valid, b_stat, -1)
+        hot_stat = hot & valid
+    jax.debug.callback(u.tier._note, b_stat, hot_stat)
 
     def _all_hot(_):
         return rows_hot
@@ -449,4 +588,4 @@ def tiered_bucket_rows(u: TieredDeviceUBODT, b):
             rows_cold = pages[b]
         return jnp.where(hot[..., None], rows_hot, rows_cold)
 
-    return jax.lax.cond(jnp.all(hot), _all_hot, _paged, None)
+    return jax.lax.cond(jnp.all(hot_eff), _all_hot, _paged, None)

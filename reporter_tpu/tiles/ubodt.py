@@ -171,6 +171,12 @@ class DeviceUBODT:
         """Architectural probe bound: one row gather per hash function."""
         return 1 if self.layout == "wide32" else 2
 
+    @property
+    def local_buckets(self) -> int:
+        """Bucket count of THIS view's packed leaf — the full table, or the
+        1/N local range inside a shard_map (the sharded prober's L)."""
+        return self.packed.shape[0]
+
     def with_shard_axis(self, axis: str) -> "DeviceUBODT":
         return DeviceUBODT(self.packed, self.bmask, shard_axis=axis,
                            layout=self.layout)

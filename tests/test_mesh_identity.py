@@ -1,0 +1,260 @@
+"""Bit-identity across partitionings (docs/performance.md "One logical
+matcher per pod"): the SAME batch dispatched on 1, 2, and 8 virtual
+devices must produce wire- and CompactMatch-identical output — across
+{scan, assoc} x {cuckoo, wide32} x sparse on/off x arena on/off,
+including seam/carry chains and a mid-stream arena eviction.
+
+The partition-rule table (parallel/rules.py) is allowed to change WHERE
+bytes compute, never WHICH bytes come out: the dp axis shards
+row-independent work, the gp axis resolves probes via exact psum
+bit-pattern reductions, and the arena gather/scatter reconstructs the
+global slab row-for-row.  Every test here is a 1-vs-N differential on
+the full matcher wire output.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from reporter_tpu.matching import MatcherConfig, SegmentMatcher
+from reporter_tpu.matching.session import SessionEngine, SessionStore
+from reporter_tpu.synth import TraceSynthesizer
+from reporter_tpu.tiles.arrays import build_graph_arrays
+from reporter_tpu.tiles.network import grid_city
+from reporter_tpu.tiles.ubodt import build_ubodt
+
+MO = {"mode": "auto", "report_levels": [0, 1], "transition_levels": [0, 1]}
+SLOT_B = 12 * 8 + 17  # one arena slot at beam_k=8
+
+
+@pytest.fixture(scope="module")
+def setup():
+    city = grid_city(rows=5, cols=5, spacing_m=150.0)
+    arrays = build_graph_arrays(city, cell_size=100.0)
+    return arrays, {layout: build_ubodt(arrays, delta=1500.0, layout=layout)
+                    for layout in ("cuckoo", "wide32")}
+
+
+def _require_devices(n):
+    import jax
+
+    if len(jax.devices()) < n:
+        pytest.skip("needs %d virtual CPU devices" % n)
+
+
+def _matcher(setup, layout="cuckoo", devices=1, **kw):
+    arrays, tables = setup
+    cfg = MatcherConfig(length_buckets=[16], session_buckets=[4, 16],
+                        ubodt_layout=layout, devices=devices, **kw)
+    return SegmentMatcher(arrays=arrays, ubodt=tables[layout], config=cfg)
+
+
+def _batch(arrays, n=6, pts=12, seed=3, dt=5.0, chain=True):
+    synth = TraceSynthesizer(arrays, seed=seed)
+    trs = [synth.synthesize(pts, dt=dt, uuid="v%d" % i, sigma=3.0,
+                            max_tries=300).trace for i in range(n)]
+    if chain:
+        # one trace past the largest bucket: the seam/carry chain rides
+        # along (dense cadence — a 40-pt route at sparse dt exceeds the
+        # 5x5 grid)
+        trs.append(synth.synthesize(40, dt=5.0, uuid="chain", sigma=3.0,
+                                    max_tries=300).trace)
+    return trs
+
+
+def wire(results):
+    return json.dumps(results, sort_keys=True)
+
+
+def _stream_fleet(m, trs, step=2, batched=True):
+    store = SessionStore()
+    eng = SessionEngine(m, store, tail_points=512)
+    pts_max = max(len(t["trace"]) for t in trs)
+    for j in range(0, pts_max, step):
+        batch = [{"uuid": t["uuid"], "trace": t["trace"][j:j + step],
+                  "match_options": MO}
+                 for t in trs if t["trace"][j:j + step]]
+        if batched:
+            eng.match_many(batch)
+        else:
+            for item in batch:
+                eng.match_many([item])
+    return store
+
+
+def _assert_store_equal(a, b, uuids):
+    for u in uuids:
+        sa, sb = a.peek(u), b.peek(u)
+        for i, what in enumerate(("edge", "offset", "break")):
+            np.testing.assert_array_equal(
+                np.array([r[i] for r in sa.records]),
+                np.array([r[i] for r in sb.records]),
+                err_msg="%s/%s" % (u, what))
+    wa = {w["uuid"]: w["carry"] for w in a.export_all()}
+    wb = {w["uuid"]: w["carry"] for w in b.export_all()}
+    assert wa == wb  # exact f32 wire bytes
+
+
+# -- dense batch + seam/carry chain: kernels x layouts x device counts -------
+
+
+@pytest.fixture(scope="module")
+def dense_refs(setup):
+    """Single-device reference wire output per (kernel, layout), computed
+    lazily so tier-1 (which runs only the scan/cuckoo cell; the rest are
+    ``slow``) pays for exactly the references it compares against."""
+    arrays, _ = setup
+    trs = _batch(arrays)
+    cache = {}
+
+    def ref(kernel, layout):
+        key = (kernel, layout)
+        if key not in cache:
+            m = _matcher(setup, layout=layout, viterbi_kernel=kernel)
+            cache[key] = wire(m.match_many(trs))
+        return cache[key]
+
+    return trs, ref
+
+
+@pytest.mark.parametrize("kernel,layout", [
+    ("scan", "cuckoo"),
+    pytest.param("assoc", "cuckoo", marks=pytest.mark.slow),
+    pytest.param("scan", "wide32", marks=pytest.mark.slow),
+    pytest.param("assoc", "wide32", marks=pytest.mark.slow),
+])
+def test_dense_identity_dp8(setup, dense_refs, kernel, layout):
+    """8-device dp mesh == 1 device, wire-identical, both kernels x both
+    layouts, seam chain included."""
+    _require_devices(8)
+    trs, refs = dense_refs
+    m = _matcher(setup, layout=layout, viterbi_kernel=kernel, devices=8)
+    assert m._mesh is not None
+    assert wire(m.match_many(trs)) == refs(kernel, layout)
+
+
+def test_dense_identity_dp2(setup, dense_refs):
+    """The intermediate partitioning: 2 devices agree with 1 and (by
+    transitivity with test_dense_identity_dp8) with 8."""
+    _require_devices(2)
+    trs, refs = dense_refs
+    m = _matcher(setup, devices=2, viterbi_kernel="scan")
+    assert wire(m.match_many(trs)) == refs("scan", "cuckoo")
+
+
+def test_dense_identity_dp2_gp4(setup, dense_refs):
+    """The 2-D mesh (batch x graph shards): probes resolve collectively
+    over gp, output still byte-identical."""
+    _require_devices(8)
+    trs, refs = dense_refs
+    m = _matcher(setup, devices=8, graph_devices=4, viterbi_kernel="scan")
+    assert m._n_gp == 4
+    assert wire(m.match_many(trs)) == refs("scan", "cuckoo")
+
+
+# -- sparse on ---------------------------------------------------------------
+
+
+@pytest.mark.slow  # tier-1 sparse mesh identity: test_sparse.py::test_sparse_mesh_identical
+@pytest.mark.parametrize("devices", [2, 8])
+def test_sparse_identity(setup, devices):
+    """Sparse-cohort dispatch (>= 45 s gaps) under the mesh equals the
+    single-device sparse path bit-for-bit."""
+    _require_devices(devices)
+    arrays, _ = setup
+    trs = _batch(arrays, n=4, dt=60.0, seed=7)
+    kw = dict(sparse=True, sparse_vmax_mps=16.0)
+    want = wire(_matcher(setup, **kw).match_many(trs))
+    m = _matcher(setup, devices=devices, **kw)
+    assert m.sparse.enabled
+    assert wire(m.match_many(trs)) == want
+
+
+# -- arena on ----------------------------------------------------------------
+
+
+@pytest.mark.parametrize("kernel", [
+    "scan", pytest.param("assoc", marks=pytest.mark.slow)])
+def test_arena_identity_dp8(setup, kernel):
+    """Session arena sharded over 8 dp devices: streaming fleet equal to
+    the 1-device host-carry reference — records and exported carry
+    bytes, both kernels."""
+    _require_devices(8)
+    arrays, _ = setup
+    trs = _batch(arrays, n=4, pts=10)
+    host = _stream_fleet(_matcher(setup, viterbi_kernel=kernel), trs)
+    m = _matcher(setup, viterbi_kernel=kernel, devices=8,
+                 session_arena=True)
+    assert m.session_arena is not None
+    assert m.session_arena.hot_slots % 8 == 0  # slab splits over dp
+    arena = _stream_fleet(m, trs)
+    _assert_store_equal(host, arena, [t["uuid"] for t in trs])
+
+
+@pytest.mark.slow
+def test_arena_identity_wide32_dp8(setup):
+    """The other table layout under the sharded arena."""
+    _require_devices(8)
+    arrays, _ = setup
+    trs = _batch(arrays, n=3, pts=10, seed=5)
+    host = _stream_fleet(_matcher(setup, layout="wide32"), trs)
+    m = _matcher(setup, layout="wide32", devices=8, session_arena=True)
+    arena = _stream_fleet(m, trs)
+    _assert_store_equal(host, arena, [t["uuid"] for t in trs])
+
+
+def test_arena_eviction_midstream_dp2(setup):
+    """Mid-stream arena eviction UNDER the mesh: a 2-hot/2-cold slab on a
+    dp-2 mesh churns (promote/evict/readback) while 6 vehicles round-
+    robin — and never moves a bit vs the host-carry reference."""
+    _require_devices(2)
+    arrays, _ = setup
+    trs = _batch(arrays, n=6, pts=10, seed=9)
+    host = _stream_fleet(_matcher(setup), trs, batched=False)
+    m = _matcher(setup, devices=2, session_arena=True,
+                 session_arena_bytes=1 * SLOT_B,
+                 session_arena_cold_bytes=2 * SLOT_B)
+    s0 = m.session_arena.summary()
+    assert s0["hot_slots"] == 2  # 1-slot budget rounds UP to the dp width
+    arena = _stream_fleet(m, trs, batched=False)
+    _assert_store_equal(host, arena, [t["uuid"] for t in trs])
+    s = m.session_arena.summary()
+    assert s["evictions"] > 0 and s["readbacks"] > 0
+
+
+@pytest.mark.slow
+def test_sparse_arena_identity_dp8(setup):
+    """Sparse AND arena both on: dp-8 equals the 1-device arena twin
+    bit-for-bit.  (The reference here is the 1-device ARENA path — the
+    partitioning axis is what this suite isolates; the arena-vs-host
+    differential itself lives in test_session_arena.py.)"""
+    _require_devices(8)
+    arrays, _ = setup
+    trs = _batch(arrays, n=3, pts=10, seed=11, dt=60.0)
+    kw = dict(sparse=True, sparse_gap_s=1.0, session_arena=True)
+    one = _stream_fleet(_matcher(setup, **kw), trs)
+    m = _matcher(setup, devices=8, **kw)
+    arena = _stream_fleet(m, trs)
+    _assert_store_equal(one, arena, [t["uuid"] for t in trs])
+
+
+# -- capacity plane ----------------------------------------------------------
+
+
+def test_capacity_summary_scales_with_devices(setup):
+    """The /health "capacity" block: admission caps and byte budgets
+    scale with the local device count (what the router's weighted
+    ranking and the measurement artifact pin)."""
+    _require_devices(8)
+    one = _matcher(setup).capacity_summary()
+    m8 = _matcher(setup, devices=8, session_arena=True,
+                  session_arena_bytes=8 * SLOT_B)
+    eight = m8.capacity_summary()
+    assert one["devices"] == 1 and eight["devices"] == 8
+    assert eight["mesh"] == {"dp": 8, "gp": 1}
+    assert eight["max_device_batch"] == 8 * one["max_device_batch"]
+    assert eight["max_device_points"] == 8 * one["max_device_points"]
+    a = eight["session_arena"]
+    assert a["devices"] == 8
+    assert a["hot_bytes"] == 8 * a["hot_bytes_per_chip"]

@@ -120,6 +120,28 @@ def test_bitexact_sparse_on(setup, kernel):
     _assert_store_equal(host, arena, [t["uuid"] for t in trs])
 
 
+@pytest.mark.parametrize("devices", [
+    pytest.param(2, marks=pytest.mark.slow), 8])
+def test_bitexact_mesh(setup, devices):
+    """The dp-sharded slab (docs/performance.md "One logical matcher per
+    pod"): arena-on over a mesh stays byte-identical to the 1-device
+    host-carry reference — the slot axis shards, hot_slots rounds up to
+    the dp width, and the gather/scatter reconstructs the global slab
+    row-for-row."""
+    import jax
+
+    if len(jax.devices()) < devices:
+        pytest.skip("needs >= %d virtual devices" % devices)
+    arrays, _ = setup
+    trs = _traces(arrays, 4, 10)
+    host = _stream_fleet(_matcher(setup), trs)
+    m = _matcher(setup, session_arena=True, devices=devices)
+    assert m.session_arena is not None
+    assert m.session_arena.hot_slots % devices == 0
+    arena = _stream_fleet(m, trs)
+    _assert_store_equal(host, arena, [t["uuid"] for t in trs])
+
+
 def test_env_flag_reverts_bit_for_bit(setup, monkeypatch):
     """REPORTER_SESSION_ARENA=0 beats cfg.session_arena=True: no arena
     is built and the host-carry path runs (trivially bit-identical);

@@ -113,6 +113,27 @@ def test_sparse_on_dense_unchanged(world):
     assert sparse_mod.C_SPARSE_DISPATCH.labels("ge60").value > 0
 
 
+@pytest.mark.parametrize("devices", [
+    pytest.param(2, marks=pytest.mark.slow), 8])
+def test_sparse_mesh_identical(world, devices):
+    """The sparse cohort dispatch under a dp mesh (docs/performance.md
+    "One logical matcher per pod"): mixed dense+sparse batches on N
+    devices reproduce the 1-device sparse wire byte-for-byte."""
+    import jax
+
+    if len(jax.devices()) < devices:
+        pytest.skip("needs >= %d virtual devices" % devices)
+    cfg, arrays, ubodt = world
+    traces = corpus(arrays)
+    cfg_sp = dataclasses.replace(cfg, sparse=True, sparse_vmax_mps=16.0)
+    want = wire(SegmentMatcher(arrays=arrays, ubodt=ubodt,
+                               config=cfg_sp).match_many(traces))
+    cfg_m = dataclasses.replace(cfg_sp, devices=devices)
+    m = SegmentMatcher(arrays=arrays, ubodt=ubodt, config=cfg_m)
+    assert m.sparse.enabled and m._mesh is not None
+    assert wire(m.match_many(traces)) == want
+
+
 def test_session_sparse_off_identical(world, monkeypatch):
     """The streaming path under REPORTER_SPARSE=0: bit-identical session
     step results (the satellite's session-path differential)."""

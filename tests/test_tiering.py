@@ -263,15 +263,33 @@ def test_shard_seeding_and_parse(tables):
     assert all(a[1] == b[0] for a, b in zip(spans, spans[1:]))
 
 
-def test_mesh_excludes_tiering(setup, tables):
-    """Tiering and the dp/gp mesh are alternative scaling legs: a meshed
-    config logs + ignores the hot budget instead of mis-composing."""
+@pytest.mark.parametrize("devices,graph_devices", [
+    pytest.param(2, 1, marks=pytest.mark.slow), (8, 4)])
+def test_mesh_composes_tiering(setup, tables, devices, graph_devices):
+    """Tiering and the dp/gp mesh COMPOSE (docs/performance.md "One
+    logical matcher per pod"): the hot-bucket arena shards by the same
+    contiguous-bucket partition the gp probe uses, hot_bytes is a
+    per-chip budget, and the meshed+tiered wire output stays
+    bit-identical to the untiered single-device matcher."""
     import jax
 
     _, arrays = setup
-    if len(jax.devices()) < 2:
-        pytest.skip("needs >= 2 devices for a dp mesh")
-    cfg = MatcherConfig(devices=2, ubodt_hot_bytes=4096,
-                        length_buckets=[16])
+    if len(jax.devices()) < devices:
+        pytest.skip("needs >= %d devices for the mesh" % devices)
+    cfg = MatcherConfig(devices=devices, graph_devices=graph_devices,
+                        ubodt_hot_bytes=4096, length_buckets=[16])
     m = SegmentMatcher(arrays=arrays, ubodt=tables["cuckoo"], config=cfg)
-    assert m.tiering is None
+    assert m.tiering is not None
+    ts = m.tiering.summary()
+    # per-chip budget: gp ranks multiply the resident set
+    assert ts.get("hot_bytes_total", ts["hot_bytes"]) \
+        == ts["hot_bytes"] * graph_devices
+    base = SegmentMatcher(arrays=arrays, ubodt=tables["cuckoo"],
+                          config=MatcherConfig(length_buckets=[16]))
+    trs = fleet_traces(arrays, n=6)
+    assert json.dumps(m.match_many(trs), sort_keys=True) == \
+        json.dumps(base.match_many(trs), sort_keys=True)
+    # churn the tier mid-stream and replay: still bit-identical
+    m.tiering.maintain()
+    assert json.dumps(m.match_many(trs), sort_keys=True) == \
+        json.dumps(base.match_many(trs), sort_keys=True)

@@ -207,6 +207,36 @@ def test_warmup_session_step_covers_streaming(engine):
     assert _compile_total() == before + 1
 
 
+@pytest.mark.slow  # the mesh rehearsal leg boots serve --warmup on the dp-8 topology
+def test_warmup_covers_mesh_programs(engine):
+    """A mesh matcher's warmup (serve --warmup on the pod topology,
+    docs/performance.md "One logical matcher per pod") pre-dispatches the
+    dp-sharded program variants through the REAL dispatch path, so the
+    first requests of a warmed mesh replica — bucketed, carry-chain long,
+    and streaming session step — pay zero request-path compiles."""
+    import jax
+
+    from reporter_tpu.matching.session import SessionEngine, SessionStore
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs the virtual multi-device CPU backend")
+    arrays, ubodt = engine
+    matcher = SegmentMatcher(
+        arrays=arrays, ubodt=ubodt,
+        config=MatcherConfig(devices=2, session_buckets=[4, 16], **CFG))
+    assert matcher._mesh is not None
+    matcher.warmup(carry_chain=True, session_step=True)
+    before = _compile_total()
+    matcher.match_many([_trace(arrays, 12, uuid="mesh-a")])
+    matcher.match_many([_trace(arrays, 80, uuid="mesh-b")])  # carry chain
+    eng = SessionEngine(matcher, SessionStore(), tail_points=64)
+    tr = _trace(arrays, 12, uuid="mesh-stream")
+    eng.match_many([{"uuid": tr["uuid"], "trace": tr["trace"][:1],
+                     "match_options": tr["match_options"]}])
+    assert _compile_total() == before, (
+        "a warmed mesh program paid a request-path compile stall")
+
+
 def test_legacy_long_path_still_selectable(engine, monkeypatch):
     """REPORTER_LONG_PRECOMPUTE=0 forces the legacy fused per-chunk carry
     program — the differential reference must stay dispatchable."""

@@ -573,11 +573,19 @@ class Fleet:
         except Exception:  # noqa: BLE001 - blind polls make no decisions
             return None
         depth = 0.0
+        devices = 0
         for row in statusz.get("fleet", ()):
             try:
                 depth += float(row.get("queue_depth") or 0.0)
             except (TypeError, ValueError):
                 pass
+            try:
+                # advertised local mesh size per replica (/health
+                # "capacity"): the autoscaler's queue gate scales its
+                # threshold by mean chips per replica
+                devices += int(row.get("devices") or 1)
+            except (TypeError, ValueError):
+                devices += 1
         alerting = False
         max_burn = 0.0
         for o in slo.get("objectives", ()):
@@ -591,7 +599,7 @@ class Fleet:
                     pass
         with self._lock:
             n = len(self.replicas)
-        return {"replicas": n, "queue_depth": depth,
+        return {"replicas": n, "queue_depth": depth, "devices": devices,
                 "burn_alerting": alerting, "max_burn": max_burn}
 
     def scale_up(self, reason: str) -> bool:
