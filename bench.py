@@ -907,6 +907,126 @@ def run_device() -> int:
         except Exception as e:  # noqa: BLE001 - the leg must not sink the bench
             _stderr("session leg failed: %s" % (e,))
 
+    # host pipeline leg (docs/performance.md "The columnar host data
+    # plane"; BENCH_HOST_PIPELINE=0 skips): the host side of the serving
+    # path, measured in isolation at the canonical [512, 64] shape —
+    # (a) packer: legacy per-trace _fill_rows loop vs the columnar
+    #     extract+pack and vs pack alone (the binary-wire ingress case,
+    #     where the _columns side channel already paid extraction);
+    # (b) wire codec: JSON vs binary request encode/decode rates and
+    #     body sizes for the same batch;
+    # (c) host_frac: a dedicated attribution capture through the REAL
+    #     match_many path (the per-cohort captures above run raw jitted
+    #     fns, which accrue no host stages), so the artifact's headline
+    #     host share covers pack/dispatch/collect of live dispatches.
+    host_pipeline = None
+    if os.environ.get("BENCH_HOST_PIPELINE", "1").lower() not in (
+            "0", "false", "no", "off"):
+        try:
+            from reporter_tpu.matching import columnar
+            from reporter_tpu.serve import wire
+
+            _write_status(phase="benching", step="host_pipeline",
+                          platform=platform)
+            hp_B = int(os.environ.get("BENCH_HOST_PIPELINE_BATCH", "512"))
+            hp_T = int(os.environ.get("BENCH_HOST_PIPELINE_POINTS", "64"))
+            short = [s.trace for s in cohorts[0][2]]
+            hp_traces = []
+            for i in range(hp_B):
+                t = dict(short[i % len(short)])
+                t["uuid"] = "bench-hp-%d" % i
+                t["trace"] = t["trace"][:hp_T]
+                hp_traces.append(t)
+            hp_idxs = list(range(hp_B))
+            hp_pts = sum(len(t["trace"]) for t in hp_traces)
+
+            def _hp_time(fn, budget=0.3, min_reps=3):
+                fn()  # warm (allocator, caches)
+                n, secs = 0, 0.0
+                while n < min_reps or secs < budget:
+                    t0 = time.time()
+                    fn()
+                    secs += time.time() - t0
+                    n += 1
+                return secs / n
+
+            legacy_s = _hp_time(
+                lambda: matcher._fill_rows(hp_traces, hp_idxs, hp_T))
+
+            def _extract_pack():
+                cols = columnar.extract_columns(hp_traces)
+                matcher._fill_rows(hp_traces, hp_idxs, hp_T, cols=cols)
+
+            extract_pack_s = _hp_time(_extract_pack)
+            # pack alone: fresh TraceColumns from pre-extracted arrays
+            # each rep, so the projection stays INSIDE the timed region
+            # (ensure_xy caches) while the dict walk stays outside — the
+            # binary-ingress cost, where _columns already paid extraction
+            _c0 = columnar.extract_columns(hp_traces)
+
+            def _pack_only():
+                cols = columnar.TraceColumns(
+                    _c0.lens, _c0.lat, _c0.lon, _c0.time)
+                matcher._fill_rows(hp_traces, hp_idxs, hp_T, cols=cols)
+
+            pack_only_s = _hp_time(_pack_only)
+
+            hp_body = {"traces": hp_traces}
+            jbytes = json.dumps(hp_body).encode("utf-8")
+            wbytes = wire.encode_request(hp_body)
+            json_enc_s = _hp_time(
+                lambda: json.dumps(hp_body).encode("utf-8"))
+            json_dec_s = _hp_time(lambda: json.loads(jbytes))
+            wire_enc_s = _hp_time(lambda: wire.encode_request(hp_body))
+            wire_dec_s = _hp_time(lambda: wire.decode_request(wbytes))
+
+            host_pipeline = {
+                "batch": hp_B,
+                "max_points": hp_T,
+                "points": hp_pts,
+                "pack": {
+                    "legacy_ms": round(legacy_s * 1e3, 3),
+                    "extract_pack_ms": round(extract_pack_s * 1e3, 3),
+                    "pack_only_ms": round(pack_only_s * 1e3, 3),
+                    "host_pack_points_per_sec": round(hp_pts / pack_only_s, 1),
+                    "extract_pack_points_per_sec": round(
+                        hp_pts / extract_pack_s, 1),
+                    "legacy_points_per_sec": round(hp_pts / legacy_s, 1),
+                    "speedup_pack_only": round(legacy_s / pack_only_s, 2),
+                    "speedup_extract_pack": round(
+                        legacy_s / extract_pack_s, 2),
+                },
+                "wire": {
+                    "json_bytes": len(jbytes),
+                    "binary_bytes": len(wbytes),
+                    "bytes_ratio": round(len(wbytes) / len(jbytes), 3),
+                    "json_encode_ms": round(json_enc_s * 1e3, 3),
+                    "json_decode_ms": round(json_dec_s * 1e3, 3),
+                    "binary_encode_ms": round(wire_enc_s * 1e3, 3),
+                    "binary_decode_ms": round(wire_dec_s * 1e3, 3),
+                    "binary_decode_points_per_sec": round(
+                        hp_pts / wire_dec_s, 1),
+                    "json_decode_points_per_sec": round(
+                        hp_pts / json_dec_s, 1),
+                },
+            }
+            try:
+                # programs=[] keeps the CPU op->stage bridge off (we only
+                # need the device total + the host window here)
+                hres = obs_attrib.capture(
+                    lambda: matcher.match_many(hp_traces[:128]),
+                    reps=2, store=False, programs=[],
+                    out_dir=os.path.join(
+                        profile_dir or os.path.join(
+                            "scratch", "bench_profile"), "host_pipeline"))
+                host_pipeline["host_frac"] = hres.get("host_frac")
+                host_pipeline["host_stages_s"] = hres.get("host_stages_s")
+            except Exception as e:  # noqa: BLE001
+                _stderr("host_frac capture failed: %s" % (e,))
+            _stderr("host pipeline leg: %s" % (host_pipeline,))
+        except Exception as e:  # noqa: BLE001 - the leg must not sink the bench
+            _stderr("host pipeline leg failed: %s" % (e,))
+
     # mesh scaling leg (docs/performance.md "One logical matcher per
     # pod"; BENCH_MESH=0 skips): the SAME mixed fleet e2e pass on a dp
     # mesh over the local devices — aggregate and per-device rates plus
@@ -999,6 +1119,8 @@ def run_device() -> int:
         "ubodt_max_probes": ubodt.max_probes,
         "ubodt_max_kicks": int(ubodt.max_kicks),
         "session": session_bench,
+        "host_pipeline": host_pipeline,
+        "host_frac": (host_pipeline or {}).get("host_frac"),
         "mesh": mesh_bench,
         "sessions_resident_per_chip": (
             session_bench["sessions_resident_per_chip"]
@@ -1540,8 +1662,8 @@ def main() -> int:
               "oracle_cmp", "agreement_by_cohort", "device_mb",
               "fleet", "scenario", "edges", "ubodt_rows", "ubodt_layout",
               "ubodt_load", "ubodt_max_probes",
-              "ubodt_max_kicks", "session", "mesh",
-              "sessions_resident_per_chip", "cost", "memory"):
+              "ubodt_max_kicks", "session", "host_pipeline", "host_frac",
+              "mesh", "sessions_resident_per_chip", "cost", "memory"):
         if k in device_json:
             out[k] = device_json[k]
     out.update({k: baseline_json[k] for k in

@@ -146,6 +146,14 @@ class MatcherConfig:
     # carry drivable boundary times — the way Meili's interpolation
     # reports every traversed segment.  Same wire record shape either way.
     interpolate: bool = False
+    # columnar host packing (matching/columnar.py; docs/performance.md
+    # "The columnar host data plane"): pack padded device batches with
+    # one vectorized scatter over flat per-point columns instead of the
+    # legacy per-trace Python loop.  Bit-identical output (the packer
+    # equivalence suite enforces it), so it defaults on; =False (or
+    # $REPORTER_HOST_PACK=0) keeps the legacy loop as the differential
+    # reference.
+    host_pack: bool = True
     # batch rungs pre-dispatched per length bucket by warmup passes
     # (serve --warmup / batch --warmup); each snaps up to a ladder rung
     warmup_batch_sizes: List[int] = field(default_factory=lambda: [1])

@@ -25,11 +25,13 @@ from typing import Dict, List, Optional, Sequence
 import numpy as np
 
 from .. import faults
+from ..obs import attrib as obs_attrib
 from ..obs import log as obs_log
 from ..obs import metrics as obs
 from ..tiles.arrays import GraphArrays, build_graph_arrays
 from ..tiles.network import RoadNetwork
 from ..tiles.ubodt import UBODT, build_ubodt
+from . import columnar
 from .assoc_native import associate_segments_batch
 from .config import MatcherConfig
 from .sparse import (
@@ -271,6 +273,19 @@ class SegmentMatcher:
             self._session_arena_on = bool(
                 getattr(self.cfg, "session_arena", False))
         self.session_arena = None
+        # columnar host packing (docs/performance.md "The columnar host
+        # data plane"): match_many/session batches pack through the
+        # vectorized matching/columnar.py plane — one column extraction
+        # per call, one fancy-indexed scatter per group — instead of the
+        # per-trace Python loop.  Bit-identical output either way (the
+        # packer equivalence suite pins it); on by default, and
+        # $REPORTER_HOST_PACK=0 reverts to the legacy loop as the
+        # differential reference.
+        env_hp = os.environ.get("REPORTER_HOST_PACK", "").strip().lower()
+        if env_hp:
+            self._host_pack = env_hp not in ("0", "false", "off", "no")
+        else:
+            self._host_pack = bool(getattr(self.cfg, "host_pack", True))
         # route-consistent interpolation default (per-request
         # match_options.interpolate overrides either way)
         env_ip = os.environ.get("REPORTER_INTERPOLATE", "").strip().lower()
@@ -1195,6 +1210,14 @@ class SegmentMatcher:
         buckets: Dict[tuple, List[int]] = {}
         long_map: Dict[tuple, List[int]] = {}
         interp_idx = self._interp_indices(traces)
+        # columnar host plane: every point dict is walked ONCE here (or
+        # not at all, when the binary wire decode attached "_columns"),
+        # and each chunk below packs with one fancy-indexed scatter
+        cols = None
+        if self._host_pack:
+            t0h = _time.monotonic()
+            cols = columnar.extract_columns(traces)
+            obs_attrib.host_add("pack", _time.monotonic() - t0h)
         max_bucket = self.cfg.length_buckets[-1] if self.cfg.length_buckets else 256
         for i, tr in enumerate(traces):
             n = len(tr["trace"])
@@ -1241,10 +1264,15 @@ class SegmentMatcher:
                                       interp=interp_idx)
 
         for pkey, slabel, blen, idxs in chunks:
-            px, py, tm, valid, times = self._fill_rows(traces, idxs, blen)
-            handle = self._dispatch_batch(
-                *self._pad_batch_staged(px, py, tm, valid), pkey=pkey,
-                slabel=slabel)
+            t0h = _time.monotonic()
+            px, py, tm, valid, times = self._fill_rows(traces, idxs, blen,
+                                                       cols=cols)
+            args = self._pad_batch_staged(px, py, tm, valid)
+            t1h = _time.monotonic()
+            handle = self._dispatch_batch(*args, pkey=pkey, slabel=slabel)
+            t2h = _time.monotonic()
+            obs_attrib.host_add("pack", t1h - t0h)
+            obs_attrib.host_add("dispatch", t2h - t1h)
             pending.append((idxs, handle, times))
             if len(pending) >= PIPELINE_DEPTH:
                 drain_one()
@@ -1260,7 +1288,7 @@ class SegmentMatcher:
             if slabel:
                 C_SPARSE_DISPATCH.labels(slabel).inc(len(lidx))
             long_handles.extend(self._dispatch_long(traces, lidx, pkey=pkey,
-                                                    slabel=slabel))
+                                                    slabel=slabel, cols=cols))
 
         def finish() -> List[dict]:
             # chaos seam: a wedged device step (the serve watchdog's prey)
@@ -1389,8 +1417,15 @@ class SegmentMatcher:
             out["session_arena"] = self.session_arena.summary()
         return out
 
-    def _fill_rows(self, traces, idxs, T):
-        """Pack traces[idxs] into padded [B, T] device arrays + times lists."""
+    def _fill_rows(self, traces, idxs, T, cols=None):
+        """Pack traces[idxs] into padded [B, T] device arrays + times lists.
+        With ``cols`` (the call-wide TraceColumns of the columnar host
+        plane) the pack is one fancy-indexed scatter per column and
+        ``times`` is a PackedTimes (list-of-lists compatible);
+        bit-identical to the legacy per-row loop below either way."""
+        if cols is not None:
+            px, py, tm, valid, times = cols.pack(self.arrays.proj, idxs, T)
+            return self._skew_rows(px, py, tm, valid, times)
         B = len(idxs)
         px = np.zeros((B, T), np.float32)
         py = np.zeros((B, T), np.float32)
@@ -1412,6 +1447,10 @@ class SegmentMatcher:
             tm[row, : len(pts)] = np.asarray(ts) - ts[0]
             valid[row, : len(pts)] = True
             times.append(ts)
+        return self._skew_rows(px, py, tm, valid, times)
+
+    @staticmethod
+    def _skew_rows(px, py, tm, valid, times):
         # chaos seam (docs/match-quality.md): an armed quality_skew fault
         # perturbs the projected coordinates the DEVICE sees — equivalent
         # to corrupting every emission score — while the shadow oracle
@@ -1505,13 +1544,17 @@ class SegmentMatcher:
         trace indices whose association runs through the route-consistent
         interpolation engine (matching/sparse.py) instead of the batch
         walk — same record shape, speed-weighted boundary times."""
+        t0h = _time.monotonic()
         B = len(idxs)
         T = edge.shape[1]
         abs_tm = np.zeros((B, T), np.float64)
         n_pts = np.zeros(B, np.int32)
-        for row in range(B):
-            n_pts[row] = len(times[row])
-            abs_tm[row, : n_pts[row]] = times[row]
+        if isinstance(times, columnar.PackedTimes):
+            times.fill_abs(abs_tm, n_pts)  # vectorized scatter
+        else:
+            for row in range(B):
+                n_pts[row] = len(times[row])
+                abs_tm[row, : n_pts[row]] = times[row]
         seg_lists = associate_segments_batch(
             self.arrays, self.ubodt,
             edge[:B], offset[:B], breaks[:B], abs_tm, n_pts,
@@ -1543,6 +1586,7 @@ class SegmentMatcher:
                     queue_thresh_mps=self.cfg.queue_speed_threshold_kph / 3.6,
                     back_tol=2.0 * self.cfg.sigma_z + 5.0,
                 )}
+        obs_attrib.host_add("collect", _time.monotonic() - t0h)
         if not self._quality_aux:
             return
         for row, i in enumerate(idxs):
@@ -1560,7 +1604,7 @@ class SegmentMatcher:
             results[i]["_quality"] = q
 
     def _dispatch_long(self, traces, idxs, pkey: tuple = (),
-                       slabel: str = ""):
+                       slabel: str = "", cols=None):
         """Dispatch carry chains for traces longer than the largest bucket:
         fixed [B, W]-windows with carried Viterbi state (ops/viterbi
         .TraceCarry), one compile set regardless of trace length, no HMM
@@ -1599,7 +1643,10 @@ class SegmentMatcher:
             group = order[g : g + cap]
             T_max = max(len(traces[i]["trace"]) for i in group)
             n_chunks = -(-T_max // W)
-            px, py, tm, valid, times = self._fill_rows(traces, group, n_chunks * W)
+            t0h = _time.monotonic()
+            px, py, tm, valid, times = self._fill_rows(
+                traces, group, n_chunks * W, cols=cols)
+            obs_attrib.host_add("pack", _time.monotonic() - t0h)
             px, py, tm, valid = self._pad_batch_staged(px, py, tm, valid)
             if self._mesh is not None and px.shape[0] % self._n_dp:
                 px, py, tm, valid = self._stage_rows(
@@ -1830,11 +1877,16 @@ class SegmentMatcher:
             b <<= 1
         return b
 
-    def _fill_session_rows(self, items, idxs, W):
+    def _fill_session_rows(self, items, idxs, W, cols=None):
         """Pack items[idxs]' points into padded [B, W] device arrays.
         Times rebase against each session's own t0 epoch (not the step's
         first point) so the carried beam's f32 time frame stays coherent
         across the whole session (matcher._fill_rows rationale)."""
+        if cols is not None:
+            t0 = np.array([float(items[i]["t0"]) for i in idxs], np.float64)
+            px, py, tm, valid, times = cols.pack(
+                self.arrays.proj, idxs, W, t0=t0)
+            return px, py, tm, valid, [int(n) for n in times.lens]
         B = len(idxs)
         px = np.zeros((B, W), np.float32)
         py = np.zeros((B, W), np.float32)
@@ -1932,6 +1984,11 @@ class SegmentMatcher:
 
         w_max = int((list(getattr(self.cfg, "session_buckets", ()) or ())
                      or [16])[-1])
+        scols = None
+        if self._host_pack:
+            t0h = _time.monotonic()
+            scols = columnar.extract_columns(items, key="points")
+            obs_attrib.host_add("pack", _time.monotonic() - t0h)
         groups: Dict[tuple, List[int]] = {}
         handles = []
         for i, it in enumerate(items):
@@ -1957,8 +2014,10 @@ class SegmentMatcher:
                 # transient device-program failure surfaces here and the
                 # session batcher's bisect-retry isolates it
                 faults.maybe_raise("ubodt_probe")
+                t0h = _time.monotonic()
                 px, py, tm, valid, ns = self._fill_session_rows(
-                    items, sub, W)
+                    items, sub, W, cols=scols)
+                obs_attrib.host_add("pack", _time.monotonic() - t0h)
                 if self.backend != "jax":
                     cpu = self._cpu if not pkey else self._cpu_for(pkey)
                     res = cpu.run_batch(px, py, tm, valid)

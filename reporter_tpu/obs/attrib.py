@@ -443,6 +443,62 @@ def roofline_block(n_traces: int, T: int, k: int, secs: float, *,
 # capture orchestration + the live store
 
 
+# -- host-stage attribution (docs/performance.md "The columnar host data
+# plane"): wall seconds the GIL-bound host spends per pipeline stage,
+# accumulated at the stage boundaries the serving path already crosses
+# (service request decode -> matcher pack -> device dispatch -> result
+# collect/associate -> service response encode).  The device side has
+# named_scope attribution; this is its host mirror, and the bench
+# host_frac (host / (host + kernel)) is what perf_gate judges.
+HOST_STAGES = ("parse", "pack", "dispatch", "collect", "serialize")
+C_HOST_STAGE = metrics.counter(
+    "reporter_host_stage_seconds_total",
+    "Wall seconds of host pipeline work by stage (parse = request-body "
+    "decode, pack = batch packing into padded device arrays, dispatch = "
+    "device program enqueue, collect = result fetch + host association, "
+    "serialize = response encode; GET /debug/attrib reports the split)",
+    ("stage",))
+_HOST_S = {s: 0.0 for s in HOST_STAGES}
+_HOST_LOCK = threading.Lock()
+
+
+def host_add(stage: str, secs: float) -> None:
+    """Accrue ``secs`` of host work to ``stage``.  Called per batch/
+    request (never per point), so the lock is uncontended noise."""
+    if secs <= 0:
+        return
+    with _HOST_LOCK:
+        _HOST_S[stage] = _HOST_S.get(stage, 0.0) + secs
+    C_HOST_STAGE.labels(stage).inc(secs)
+
+
+def host_snapshot() -> Dict[str, float]:
+    with _HOST_LOCK:
+        return dict(_HOST_S)
+
+
+def host_summary(since: Optional[Dict[str, float]] = None) -> dict:
+    """The host-stage split: cumulative (or since a snapshot) seconds per
+    stage plus each stage's share of the host total."""
+    now = host_snapshot()
+    if since:
+        now = {k: max(0.0, v - since.get(k, 0.0)) for k, v in now.items()}
+    total = sum(now.values())
+    return {
+        "stages_s": {k: round(v, 6) for k, v in now.items()},
+        "total_s": round(total, 6),
+        "split": {k: (round(v / total, 4) if total > 0 else 0.0)
+                  for k, v in now.items()},
+    }
+
+
+def host_frac(host_s: float, device_s: float) -> Optional[float]:
+    """host / (host + device) over ONE window — the bench artifact's
+    headline host share.  None when the window carries no work."""
+    denom = host_s + device_s
+    return round(host_s / denom, 4) if denom > 0 else None
+
+
 G_STAGE_S = metrics.gauge(
     "reporter_stage_device_seconds",
     "Device seconds per named kernel stage in the last parsed attribution "
@@ -509,11 +565,13 @@ def capture(run_fn: Callable[[], object], reps: int = 3,
     reps = max(1, int(reps))
     if warm:
         run_fn()
+    host0 = host_snapshot()
     with profiler.session("attrib", trace_id=trace_id, out_dir=out_dir) as d:
         t0 = time.time()
         for _ in range(reps):
             run_fn()
         wall = time.time() - t0
+    host_win = host_summary(since=host0)
     result = parse_trace_dir(d)
     if (result["platform"] == "cpu"
             and set(result["stages_ms"]) <= {UNATTRIBUTED}):
@@ -534,6 +592,13 @@ def capture(run_fn: Callable[[], object], reps: int = 3,
         "reps": reps,
         "wall_s": round(wall, 4),
         "trace_dir": d,
+        # the host half of the same window: stage split + host share of
+        # (host + device) — the split /debug/attrib and bench report
+        # alongside the kernel table (docs/bench-schema.md host_frac)
+        "host_stages_s": host_win["stages_s"],
+        "host_frac": host_frac(
+            host_win["total_s"],
+            float(result.get("device_total_ms") or 0.0) / 1e3),
     })
     if store:
         store_result(result)
@@ -557,13 +622,16 @@ def summary() -> dict:
     provenance, so a stale attribution (or a CPU-only one) is visible at
     a glance next to the serving metrics."""
     res = last()
-    out: dict = {"captured": bool(res), "last_onchip": last_onchip()}
+    out: dict = {"captured": bool(res), "last_onchip": last_onchip(),
+                 "host": host_summary()}
     if res:
         out.update({
             "age_s": round(time.time() - res.get("captured_unix", 0), 1),
             "platform": res.get("platform"),
             "device_total_ms": res.get("device_total_ms"),
         })
+        if res.get("host_frac") is not None:
+            out["host_frac"] = res["host_frac"]
         stages = {k: v for k, v in res.get("stages_ms", {}).items()
                   if k != UNATTRIBUTED}
         if stages:

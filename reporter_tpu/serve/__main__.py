@@ -43,7 +43,8 @@ def main(argv):
     # flipping e.g. the session arena on for every matcher built after
     # (the serving process itself never notices — it lives inside main()).
     _env_defaulted = [k for k in ("REPORTER_QUALITY_AUX", "REPORTER_SPARSE",
-                                  "REPORTER_SESSION_ARENA")
+                                  "REPORTER_SESSION_ARENA", "REPORTER_WIRE",
+                                  "REPORTER_HOST_PACK")
                       if k not in os.environ]
     try:
         return _main(argv)
@@ -83,6 +84,16 @@ def _main(argv):
     # explicit REPORTER_SESSION_ARENA=0 reverts the serving path
     # bit-for-bit to the host-carried wire form.
     os.environ.setdefault("REPORTER_SESSION_ARENA", "1")
+    # columnar host data plane knobs (docs/performance.md "The columnar
+    # host data plane"): both default ON everywhere (the packer is
+    # bit-identical; the binary wire is negotiated per request), so these
+    # setdefaults only make the serving defaults EXPLICIT for /statusz
+    # readers and child processes.  REPORTER_WIRE=0 stops advertising/
+    # accepting the binary wire; REPORTER_HOST_PACK=0 reverts packing to
+    # the legacy per-row loop bit-for-bit.  Both restore on main() return
+    # (_env_defaulted above) so in-process CLI callers don't leak them.
+    os.environ.setdefault("REPORTER_WIRE", "1")
+    os.environ.setdefault("REPORTER_HOST_PACK", "1")
     # conf path: positional arg, else $MATCHER_CONF_FILE — the reference's
     # container default (README.md Env Var Overrides: MATCHER_CONF_FILE).
     # With the env set, the single positional may be the bind address.

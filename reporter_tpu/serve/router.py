@@ -93,6 +93,7 @@ from ..obs.quantile import SLO_BUCKETS_S
 from ..obs.trace import Span
 from ..utils import retry
 from ..utils.httppool import HttpPool, raise_for_status
+from . import wire
 from .service import _resolve_num
 
 log = logging.getLogger(__name__)
@@ -1553,16 +1554,19 @@ class FleetRouter:
                 return n
 
             def _proxy(self, endpoint: str, payload_bytes: bytes,
-                       uuid: str, geo=None):
+                       uuid: str, geo=None, stream=None):
                 t0 = _time.monotonic()
                 # fleet-SLO route: streaming session submits classify
                 # under "report_stream" like they do replica-side, so the
                 # per-POINT latency objective is a fleet objective too
-                # (best-effort sniff; both compact and spaced JSON forms)
+                # (best-effort sniff; both compact and spaced JSON forms.
+                # binary columnar bodies pass the flag explicitly — the
+                # byte sniff cannot see into the frame)
                 slo_route = endpoint
                 if endpoint == "report" and (
-                        b'"stream":true' in payload_bytes
-                        or b'"stream": true' in payload_bytes):
+                        stream if stream is not None else (
+                            b'"stream":true' in payload_bytes
+                            or b'"stream": true' in payload_bytes)):
                     slo_route = "report_stream"
                 # the router's own hop span: admission, ranking, every
                 # dispatch attempt, total router residency — recorded
@@ -1588,8 +1592,18 @@ class FleetRouter:
                 G_INFLIGHT.inc()
                 span.mark("admission_s", _time.monotonic() - t0)
                 try:
-                    fwd = {"Content-Type": "application/json",
+                    # wire passthrough: the body forwards verbatim, so
+                    # its Content-Type (binary columnar frames), the
+                    # client's Accept preference, and any gzip
+                    # Content-Encoding must ride the hop untouched —
+                    # negotiation is end to end, the router only relays
+                    fwd = {"Content-Type": (self.headers.get("Content-Type")
+                                            or "application/json"),
                            "X-Reporter-Trace": self._trace_id}
+                    for h in ("Accept", "Content-Encoding"):
+                        v = self.headers.get(h)
+                        if v:
+                            fwd[h] = v
                     dl = self.headers.get("X-Reporter-Deadline-Ms")
                     if dl:
                         fwd["X-Reporter-Deadline-Ms"] = dl
@@ -1615,9 +1629,12 @@ class FleetRouter:
                     # client actually received, failover and hedging
                     # already absorbed (a failed-over 200 is fleet-good).
                     # degraded rides the replica's own response body.
+                    rb = rbody or b""
                     router.slo.observe(
                         slo_route, status, span.total_s,
-                        degraded=b'"degraded":true' in (rbody or b""),
+                        degraded=(wire.response_degraded(rb)
+                                  if rb[:4] == wire.MAGIC
+                                  else b'"degraded":true' in rb),
                         trace_id=span.trace_id)
                     # multi-attempt / hedged spans are pinned: the
                     # stitched view of a failover must survive sampling
@@ -1626,8 +1643,10 @@ class FleetRouter:
                             for h in span.meta.get("hops", ())):
                         span.meta.setdefault("flight_keep", "failover")
                     obs_flight.record(span)
-                    self._answer_bytes(status, rbody, rhdrs,
-                                       "application/json;charset=utf-8")
+                    self._answer_bytes(
+                        status, rbody, rhdrs,
+                        (rhdrs or {}).get("Content-Type")
+                        or "application/json;charset=utf-8")
                 finally:
                     G_INFLIGHT.dec()
                     router._gate.release()
@@ -1713,7 +1732,20 @@ class FleetRouter:
                             return self._answer(
                                 400, {"error": "No json provided"})
                         raw = query["json"][0].encode("utf-8")
-                    payload = json.loads(raw.decode("utf-8"))
+                    # binary columnar bodies (serve/wire.py) forward
+                    # verbatim; the affinity/geo extraction reads the
+                    # frame's sniff view instead of parsing JSON.  gzip
+                    # bodies also forward verbatim (the REPLICA inflates)
+                    # — their affinity fields are unreadable here, so
+                    # they route by the rendezvous hash of "".
+                    sniff = None
+                    payload = None
+                    gz = (self.headers.get("Content-Encoding")
+                          or "").strip().lower() == "gzip"
+                    if post and raw[:4] == wire.MAGIC:
+                        sniff = wire.sniff_request(raw)
+                    elif not gz:
+                        payload = json.loads(raw.decode("utf-8"))
                 except OSError as e:
                     self.close_connection = True
                     try:
@@ -1723,6 +1755,19 @@ class FleetRouter:
                 except Exception as e:
                     return self._answer(400, {"error": str(e)})
                 try:
+                    if sniff is not None:
+                        lead = sniff[0] if sniff else {}
+                        uuid = str(lead.get("uuid") or "")
+                        geo = None
+                        if (router.geo_routing
+                                and lead.get("lat") is not None):
+                            geo = (lead["lat"], lead["lon"])
+                        return self._proxy(action, raw, uuid, geo,
+                                           stream=bool(lead.get("stream")))
+                    if payload is None:
+                        # gzip passthrough: opaque here, inflated by the
+                        # replica; no affinity key to extract
+                        return self._proxy(action, raw, "", None)
                     if not isinstance(payload, dict):
                         return self._answer(
                             400,

@@ -34,6 +34,7 @@ import queue
 import socket as _socket
 import threading
 import time as _time
+import zlib
 from concurrent.futures import Future
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import List, Optional, Tuple
@@ -44,6 +45,7 @@ from ..matching import MatcherConfig, SegmentMatcher
 from ..matching.matcher import C_POINTS as C_POINTS_MATCHED
 from ..matching.session import SessionCheckpointer, SessionEngine, SessionStore
 from ..obs import adaptive as obs_adaptive
+from ..obs import attrib as obs_attrib
 from ..obs import economics as obs_econ
 from ..obs import flight as obs_flight
 from ..obs import log as obs_log
@@ -54,12 +56,34 @@ from ..obs import trace as obs_trace
 from ..obs.trace import Span
 from ..report import report as report_fn
 from ..tiles.network import RoadNetwork, grid_city
+from . import wire
 
 log = logging.getLogger(__name__)
 
 ACTIONS = {"report", "trace_attributes_batch", "health", "sessions",
            "metrics", "statusz", "profile", "traces", "attrib", "slo",
            "cost", "history"}
+
+# gzip request bodies (Content-Encoding: gzip, docs/http-api.md): bound
+# on the DECOMPRESSED size so a tiny zip bomb cannot balloon a handler
+# thread — comfortably above any real batch body, refused with a 400
+# beyond it ($REPORTER_MAX_INFLATE_MB overrides)
+try:
+    _MAX_INFLATE = int(float(os.environ["REPORTER_MAX_INFLATE_MB"])) << 20
+except (KeyError, ValueError):
+    _MAX_INFLATE = 256 << 20
+
+
+def _gunzip(raw: bytes, limit: int = 0) -> bytes:
+    """Bounded gzip-body inflate (stdlib zlib, 16+MAX_WBITS accepts the
+    gzip header).  Raises ValueError past ``limit`` decompressed bytes."""
+    limit = limit or _MAX_INFLATE
+    d = zlib.decompressobj(16 + zlib.MAX_WBITS)
+    out = d.decompress(raw, limit)
+    if d.unconsumed_tail:
+        raise ValueError(
+            "gzip body exceeds %d decompressed bytes" % limit)
+    return out + d.flush()
 
 
 def _env_num(name: str, default: float) -> float:
@@ -875,6 +899,14 @@ class ReporterService:
         self.replica_id = (
             os.environ.get("REPORTER_REPLICA_ID", "").strip()
             or "%s-%d" % (_socket.gethostname()[:32], os.getpid()))
+        # binary columnar wire (serve/wire.py, docs/http-api.md): accepted
+        # and emitted when the client negotiates it (Content-Type /
+        # Accept); $REPORTER_WIRE=0 is the emergency off switch — the
+        # service then answers binary-speaking clients in JSON and 400s
+        # binary bodies, and /health stops advertising the capability
+        self.wire_enabled = (os.environ.get("REPORTER_WIRE", "")
+                             .strip().lower()
+                             not in ("0", "false", "off", "no"))
         # fleet economics (docs/economics.md): the chip-second cost
         # ledger, on-disk demand history (REPORTER_HISTORY_DIR, or the
         # config "economics" block's history_dir), and the measured
@@ -1135,6 +1167,11 @@ class ReporterService:
         spans for flight retention, and offer the request to the
         shadow-oracle sampler (docs/match-quality.md).  Cheap: dict pops,
         two metric updates, one non-blocking enqueue at most."""
+        if isinstance(trace, dict):
+            # transport state from the binary wire decode (numpy arrays)
+            # — already consumed by the packer, must never reach a
+            # serializer
+            trace.pop("_columns", None)
         if not isinstance(match, dict):
             return None
         q = match.pop("_quality", None)
@@ -1504,6 +1541,12 @@ class ReporterService:
         return 200, {
             "status": "ok",
             "replica": self.replica_id,
+            # wire-level opt-ins a client/router may negotiate
+            # (docs/http-api.md "Wire formats"): gzip request bodies are
+            # always accepted; the binary columnar wire drops out when
+            # $REPORTER_WIRE=0
+            "capabilities": (["gzip", "wire-columnar"]
+                             if self.wire_enabled else ["gzip"]),
             "degraded": bool(self.degraded),
             # True while boot-time work is still in flight: backend init +
             # engine build (matcher fields below are null until attached)
@@ -1953,10 +1996,29 @@ class ReporterService:
             timeout = 30
 
             def _answer(self, code: int, payload: dict):
-                body = json.dumps(payload, separators=(",", ":")).encode("utf-8")
+                t0s = _time.monotonic()
+                body = None
+                ctype = "application/json;charset=utf-8"
+                if code == 200 and getattr(self, "_accept_wire", False):
+                    # the client negotiated the binary columnar wire
+                    # (Accept: application/x-reporter-columnar) — only
+                    # 200 report payloads encode; every error shape
+                    # stays JSON so clients keep one error parser
+                    try:
+                        body = wire.encode_response(
+                            payload, single=self._wire_single)
+                        ctype = wire.CONTENT_TYPE
+                    except Exception:  # noqa: BLE001 - fall back to JSON
+                        body = None
+                if body is None:
+                    body = json.dumps(
+                        payload, separators=(",", ":")).encode("utf-8")
+                if getattr(self, "_timed_route", False):
+                    obs_attrib.host_add(
+                        "serialize", _time.monotonic() - t0s)
                 self.send_response(code)
                 self.send_header("Access-Control-Allow-Origin", "*")
-                self.send_header("Content-Type", "application/json;charset=utf-8")
+                self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
                 if code in (429, 503):
                     # shed/unavailable responses carry a backoff hint both
@@ -2034,10 +2096,23 @@ class ReporterService:
                     obs_trace.accept_trace_id(
                         self.headers.get("X-Reporter-Trace"))
                     or obs_trace.new_trace_id())
+                # per-request wire state: the handler object lives for the
+                # whole keep-alive connection, so negotiation flags MUST
+                # reset here or one binary request would flip every later
+                # request on the socket
+                self._accept_wire = False
+                self._wire_single = False
+                self._timed_route = False
                 try:
                     split = urlsplit(self.path)
                     action = split.path.split("/")[-1]
                     query = parse_qs(split.query)
+                    if action in ("report", "trace_attributes_batch"):
+                        self._timed_route = True
+                        if service.wire_enabled and wire.CONTENT_TYPE in (
+                                self.headers.get("Accept") or ""):
+                            self._accept_wire = True
+                            self._wire_single = action == "report"
                     if action not in ACTIONS:
                         self._drain_body(post)
                         return self._answer(
@@ -2109,7 +2184,32 @@ class ReporterService:
                         if n is None:  # malformed header: framing unknown
                             return self._answer(
                                 400, {"error": "invalid Content-Length"})
-                        payload = json.loads(self.rfile.read(n).decode("utf-8"))
+                        raw = self.rfile.read(n)
+                        # body decode = the "parse" host stage: gzip
+                        # inflate (bounded), then the negotiated wire —
+                        # binary columnar frames by Content-Type, JSON
+                        # otherwise (docs/http-api.md "Wire formats")
+                        t0p = _time.monotonic()
+                        enc = (self.headers.get("Content-Encoding")
+                               or "").strip().lower()
+                        if enc == "gzip":
+                            raw = _gunzip(raw)
+                        elif enc not in ("", "identity"):
+                            return self._answer(
+                                415, {"error": "unsupported "
+                                      "Content-Encoding %r (gzip or "
+                                      "identity)" % enc})
+                        if wire.is_wire(
+                                self.headers.get("Content-Type")):
+                            if not service.wire_enabled:
+                                return self._answer(
+                                    415, {"error": "binary wire disabled "
+                                          "(REPORTER_WIRE=0)"})
+                            payload = wire.decode_request(raw)
+                        else:
+                            payload = json.loads(raw.decode("utf-8"))
+                        obs_attrib.host_add(
+                            "parse", _time.monotonic() - t0p)
                     else:
                         if "json" not in query:
                             return self._answer(400, {"error": "No json provided"})

@@ -1,0 +1,273 @@
+"""Packer equivalence suite: the columnar host data plane must be
+BIT-IDENTICAL to the legacy per-trace loop (docs/performance.md "The
+columnar host data plane").
+
+matching/columnar.py replaces matcher._fill_rows' per-row Python with one
+batched projection + one fancy-indexed scatter per column.  That swap is
+only allowed to be a perf change: every padded array, every carried times
+list, and every wire-format match result must equal the legacy path's
+exactly — across both viterbi kernels, both UBODT layouts, the sparse
+model, and the session path.  ``REPORTER_HOST_PACK=0`` /
+``MatcherConfig(host_pack=False)`` is the differential reference.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from reporter_tpu.matching import MatcherConfig, SegmentMatcher
+from reporter_tpu.matching import columnar
+from reporter_tpu.synth import TraceSynthesizer
+from reporter_tpu.tiles.arrays import build_graph_arrays
+from reporter_tpu.tiles.network import grid_city
+from reporter_tpu.tiles.ubodt import build_ubodt
+
+MO = {"mode": "auto", "report_levels": [0, 1], "transition_levels": [0, 1]}
+
+
+@pytest.fixture(autouse=True)
+def _no_ambient_host_pack(monkeypatch):
+    """This suite drives host_pack per-matcher through MatcherConfig; an
+    ambient REPORTER_HOST_PACK (e.g. the CI host-pipeline job forcing the
+    legacy packer suite-wide) would override both sides of every
+    differential and make them vacuous.  test_env_knob_overrides_config
+    sets the env explicitly on top of this."""
+    monkeypatch.delenv("REPORTER_HOST_PACK", raising=False)
+
+
+@pytest.fixture(scope="module")
+def setup():
+    city = grid_city(rows=6, cols=6, spacing_m=150.0)
+    arrays = build_graph_arrays(city, cell_size=100.0)
+    ubodt = build_ubodt(arrays, delta=1500.0)
+    return arrays, ubodt
+
+
+@pytest.fixture(scope="module")
+def matcher(setup):
+    arrays, ubodt = setup
+    return SegmentMatcher(arrays=arrays, ubodt=ubodt,
+                          config=MatcherConfig(length_buckets=[16, 64]))
+
+
+def _traces(arrays, b, t, seed=7, dt=5.0):
+    synth = TraceSynthesizer(arrays, seed=seed)
+    return [s.trace for s in synth.batch(b, t, dt=dt, sigma=3.0)]
+
+
+def _varied_traces(arrays, seed=3, dt=5.0):
+    """Ragged lengths + int/float/mixed time typing — the shapes the
+    packer's scatter indexing has to get exactly right."""
+    synth = TraceSynthesizer(arrays, seed=seed)
+    lens = [1, 2, 5, 16, 9, 3, 12, 7]
+    out = []
+    for i, n in enumerate(lens):
+        tr = synth.synthesize(n_points=n, uuid="veh-%d" % i, dt=dt).trace
+        for j, p in enumerate(tr["trace"]):
+            if i % 3 == 0:
+                p["time"] = int(p["time"])          # all-int column
+            elif i % 3 == 1 and j % 2 == 0:
+                p["time"] = int(p["time"])          # mixed column
+        out.append(tr)
+    return out
+
+
+# -- _fill_rows equivalence --------------------------------------------------
+
+
+class TestFillRows:
+    def _compare(self, matcher, traces, idxs, T):
+        legacy = matcher._fill_rows(traces, idxs, T, cols=None)
+        cols = columnar.extract_columns(traces)
+        packed = matcher._fill_rows(traces, idxs, T, cols=cols)
+        for a, b, name in zip(legacy[:4], packed[:4],
+                              ("px", "py", "tm", "valid")):
+            assert a.dtype == b.dtype, name
+            assert np.array_equal(a, b), name  # bitwise, not approx
+        lt, pt = legacy[4], packed[4]
+        assert len(lt) == len(pt)
+        for r in range(len(lt)):
+            assert list(lt[r]) == list(pt[r])
+
+    def test_bit_identical_all_rows(self, setup, matcher):
+        arrays, _ = setup
+        traces = _varied_traces(arrays)
+        self._compare(matcher, traces, list(range(len(traces))), 16)
+
+    def test_bit_identical_subset_and_order(self, setup, matcher):
+        """Group packing indexes an arbitrary idxs subset in arbitrary
+        order (bucket grouping does exactly this)."""
+        arrays, _ = setup
+        traces = _varied_traces(arrays)
+        self._compare(matcher, traces, [5, 1, 6], 16)
+        self._compare(matcher, traces, list(reversed(range(len(traces)))), 16)
+        self._compare(matcher, traces, [3], 16)
+
+    def test_zero_length_trace_packs_empty_row(self, setup, matcher):
+        """The legacy loop cannot see a 0-point trace (dispatch filters
+        them first); the columnar packer must still keep its row empty
+        and its neighbours intact."""
+        arrays, _ = setup
+        traces = _varied_traces(arrays)
+        traces.insert(2, {"uuid": "empty", "trace": []})
+        cols = columnar.extract_columns(traces)
+        px, py, tm, valid, times = matcher._fill_rows(
+            traces, list(range(len(traces))), 16, cols=cols)
+        assert not valid[2].any() and list(times[2]) == []
+        nonempty = [i for i in range(len(traces)) if i != 2]
+        ref = matcher._fill_rows(traces, nonempty, 16, cols=None)
+        packed_rows = np.delete(px, 2, axis=0)
+        assert np.array_equal(packed_rows, ref[0])
+
+    def test_columns_side_channel_equivalence(self, setup, matcher):
+        """A trace carrying the binary-wire "_columns" arrays must pack
+        exactly like its dict-walked twin."""
+        arrays, _ = setup
+        traces = _varied_traces(arrays)
+        with_cols = []
+        for i, tr in enumerate(traces):
+            tr = dict(tr)
+            if i % 2:
+                pts = tr["trace"]
+                tr["_columns"] = {
+                    "lat": np.array([p["lat"] for p in pts], np.float64),
+                    "lon": np.array([p["lon"] for p in pts], np.float64),
+                    "time": np.array([float(p["time"]) for p in pts],
+                                     np.float64),
+                }
+            with_cols.append(tr)
+        a = columnar.extract_columns(traces)
+        b = columnar.extract_columns(with_cols)
+        for name in ("lens", "lat", "lon", "time"):
+            assert np.array_equal(getattr(a, name), getattr(b, name)), name
+        idxs = list(range(len(traces)))
+        pa = matcher._fill_rows(traces, idxs, 16, cols=a)
+        pb = matcher._fill_rows(with_cols, idxs, 16, cols=b)
+        for x, y in zip(pa[:4], pb[:4]):
+            assert np.array_equal(x, y)
+
+
+class TestPackedTimes:
+    def test_quacks_like_list_of_lists(self):
+        pt = columnar.PackedTimes(
+            np.array([1.0, 2.0, 3.0, 10.0, 20.0], np.float64),
+            np.array([3, 0, 2], np.int64), np.array([0, 3, 3], np.int64))
+        assert len(pt) == 3
+        assert pt[0] == [1.0, 2.0, 3.0]
+        assert pt[1] == []
+        assert pt[2] == [10.0, 20.0]
+
+    def test_fill_abs_matches_row_loop(self):
+        rng = np.random.default_rng(5)
+        lens = np.array([4, 0, 7, 1], np.int64)
+        flat = rng.uniform(1e9, 2e9, int(lens.sum()))
+        offs = np.cumsum(lens) - lens
+        pt = columnar.PackedTimes(flat, lens, offs)
+        B, T = 4, 8
+        vec = np.zeros((B, T), np.float64)
+        n_vec = np.zeros(B, np.int64)
+        pt.fill_abs(vec, n_vec)
+        ref = np.zeros((B, T), np.float64)
+        n_ref = np.zeros(B, np.int64)
+        for r in range(B):
+            ts = pt[r]
+            ref[r, : len(ts)] = ts
+            n_ref[r] = len(ts)
+        assert np.array_equal(vec, ref) and np.array_equal(n_vec, n_ref)
+
+
+# -- end-to-end differential: host_pack on == host_pack off ------------------
+
+
+def _pair(setup, **cfg_kw):
+    arrays, ubodt = setup
+    on = SegmentMatcher(arrays=arrays, ubodt=ubodt,
+                        config=MatcherConfig(host_pack=True, **cfg_kw))
+    off = SegmentMatcher(arrays=arrays, ubodt=ubodt,
+                         config=MatcherConfig(host_pack=False, **cfg_kw))
+    assert on._host_pack and not off._host_pack
+    return on, off
+
+
+def _assert_identical(out_a, out_b):
+    assert json.dumps(out_a, sort_keys=True) == json.dumps(out_b,
+                                                           sort_keys=True)
+
+
+class TestMatchManyDifferential:
+    @pytest.mark.parametrize("kernel", ["scan", "assoc"])
+    def test_kernels(self, setup, kernel):
+        arrays, _ = setup
+        on, off = _pair(setup, length_buckets=[16, 64],
+                        viterbi_kernel=kernel)
+        traces = _varied_traces(arrays) + _traces(arrays, 4, 40, seed=13)
+        for tr in traces:
+            tr["match_options"] = MO
+        _assert_identical(on.match_many(traces), off.match_many(traces))
+
+    def test_wide32_layout(self, setup):
+        arrays, _ = setup
+        ubodt32 = build_ubodt(arrays, delta=1500.0, layout="wide32")
+        on = SegmentMatcher(arrays=arrays, ubodt=ubodt32,
+                            config=MatcherConfig(host_pack=True,
+                                                 length_buckets=[16]))
+        off = SegmentMatcher(arrays=arrays, ubodt=ubodt32,
+                             config=MatcherConfig(host_pack=False,
+                                                  length_buckets=[16]))
+        traces = _varied_traces(arrays, seed=9)
+        _assert_identical(on.match_many(traces), off.match_many(traces))
+
+    def test_sparse_model(self, setup):
+        """dt=45s puts the cohort over sparse_gap_s: the sparse program
+        variants must see the same packed batches either way."""
+        arrays, _ = setup
+        on, off = _pair(setup, length_buckets=[16], sparse=True)
+        traces = _traces(arrays, 6, 12, seed=21, dt=45.0)
+        _assert_identical(on.match_many(traces), off.match_many(traces))
+
+    def test_long_trace_path(self, setup):
+        """Traces beyond the top bucket take the carried-window chain
+        (which packs per window, legacy either way) — the split between
+        columnar bucket packing and the chain must not shift results."""
+        arrays, _ = setup
+        on, off = _pair(setup, length_buckets=[16])
+        traces = _varied_traces(arrays) + _traces(arrays, 2, 80, seed=17)
+        _assert_identical(on.match_many(traces), off.match_many(traces))
+
+    def test_session_path(self, setup):
+        from reporter_tpu.matching.session import SessionEngine, SessionStore
+
+        arrays, _ = setup
+        outs = []
+        for host_pack in (True, False):
+            m = SegmentMatcher(
+                arrays=arrays, ubodt=setup[1],
+                config=MatcherConfig(host_pack=host_pack,
+                                     length_buckets=[16],
+                                     session_buckets=[4, 16]))
+            eng = SessionEngine(m, SessionStore(), tail_points=256)
+            results = []
+            for tr in _traces(arrays, 3, 12, seed=31):
+                pts = tr["trace"]
+                for j in range(0, len(pts), 4):
+                    results.extend(eng.match_many([
+                        {"uuid": tr["uuid"], "trace": pts[j:j + 4],
+                         "match_options": MO}]))
+            for r in results:  # wall-clock field, not part of the contract
+                (r.get("_stream") or {}).get("session", {}).pop("age_s", None)
+            outs.append(results)
+        _assert_identical(outs[0], outs[1])
+
+
+def test_env_knob_overrides_config(setup, monkeypatch):
+    arrays, ubodt = setup
+    monkeypatch.setenv("REPORTER_HOST_PACK", "0")
+    m = SegmentMatcher(arrays=arrays, ubodt=ubodt,
+                       config=MatcherConfig(length_buckets=[16]))
+    assert m._host_pack is False
+    monkeypatch.setenv("REPORTER_HOST_PACK", "1")
+    m2 = SegmentMatcher(arrays=arrays, ubodt=ubodt,
+                        config=MatcherConfig(host_pack=False,
+                                             length_buckets=[16]))
+    assert m2._host_pack is True

@@ -459,8 +459,11 @@ def _mem_matchers(arrays, ubodt, kernel):
     return base, variants
 
 
-@pytest.mark.parametrize("seed,kernel", [(7, "scan"), (19, "assoc"),
-                                         (43, "scan"), (61, "assoc")])
+@pytest.mark.parametrize("seed,kernel", [
+    (7, "scan"),
+    pytest.param(19, "assoc", marks=pytest.mark.slow),
+    pytest.param(43, "scan", marks=pytest.mark.slow),
+    (61, "assoc")])
 def test_memory_system_wire_identical(seed, kernel, monkeypatch):
     """{cuckoo, wide32} x {dedup on, off} x {scan, assoc} over mixed
     cohorts: short (one bucket), medium (a larger bucket), and long

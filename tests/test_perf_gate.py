@@ -184,3 +184,37 @@ def test_repo_history_renders_verdict(pg):
     # must never pass the gate as a judgeable run
     if os.path.basename(verdict["candidate"]) == "BENCH_r05.json":
         assert rc == 2 and "corpse" in verdict["error"]
+
+
+def test_host_pipeline_flattening_and_directions(pg, tmp_path):
+    """The columnar-host-plane metrics: host_pack_points_per_sec and
+    host_frac flatten out of the artifact host_pipeline block, pack rate
+    regresses on a DROP and host_frac regresses on a RISE."""
+    hp = {"pack": {"host_pack_points_per_sec": 1_000_000.0},
+          "host_frac": 0.10}
+    line = pg.load_bench_line(_write(
+        tmp_path, "hp.json", _line(host_pipeline=hp)))
+    assert line["host_pack_points_per_sec"] == 1_000_000.0
+    assert line["host_frac"] == 0.10
+    assert pg.METRICS["host_pack_points_per_sec"] == "higher"
+    assert pg.METRICS["host_frac"] == "lower"
+
+    hist = [_write(tmp_path, "hh%d.json" % i,
+                   _line(host_pipeline=hp, host_frac=0.10))
+            for i in range(3)]
+    # pack rate collapse fails the gate
+    slow = _line(host_pipeline={"pack": {"host_pack_points_per_sec": 1e5},
+                                "host_frac": 0.10}, host_frac=0.10)
+    rc, verdict = pg.gate(hist, _write(tmp_path, "f_slow.json", slow))
+    assert rc == 1
+    assert verdict["metrics"]["host_pack_points_per_sec"][
+        "verdict"] == "REGRESSION"
+    # host share creeping UP fails the gate (lower-is-better direction)
+    hosty = _line(host_pipeline=hp, host_frac=0.60)
+    rc, verdict = pg.gate(hist, _write(tmp_path, "f_hosty.json", hosty))
+    assert rc == 1
+    assert verdict["metrics"]["host_frac"]["verdict"] == "REGRESSION"
+    # matching numbers pass
+    rc, _ = pg.gate(hist, _write(tmp_path, "f_ok.json",
+                                 _line(host_pipeline=hp, host_frac=0.10)))
+    assert rc == 0

@@ -128,7 +128,8 @@ def _assert_records_equal(a, b, what=""):
 # -- bit-exact differentials -------------------------------------------------
 
 
-@pytest.mark.parametrize("kernel", ["scan", "assoc"])
+@pytest.mark.parametrize("kernel", [
+    "scan", pytest.param("assoc", marks=pytest.mark.slow)])
 def test_point_at_a_time_bitexact_vs_windowed_w1_chain(setup, kernel):
     """Streaming one point per step must reproduce the windowed carry
     machinery at W=1 seams bit-exactly — CompactMatch-identical."""

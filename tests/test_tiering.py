@@ -121,7 +121,8 @@ def test_cold_miss_storm_counters(tables):
 
 
 @pytest.mark.parametrize("layout", ["cuckoo", "wide32"])
-@pytest.mark.parametrize("kernel", ["scan", "assoc"])
+@pytest.mark.parametrize("kernel", [
+    "scan", pytest.param("assoc", marks=pytest.mark.slow)])
 def test_match_wire_identical(setup, tables, layout, kernel):
     """Full matcher: bucketed + carry-chain traffic, tiered (tiny hot
     budget) vs untiered, wire-identical; eviction churn mid-stream stays

@@ -1,0 +1,423 @@
+"""Binary columnar wire codec (serve/wire.py): round-trip fuzz + the
+JSON-vs-binary service differential.
+
+The codec's contract is DICT-IDENTITY: decode(encode(body)) must equal the
+JSON body exactly — same values, same int/float typing — with unknown keys
+riding the JSON tail, so ``json.dumps(..., sort_keys=True)`` equality is
+the assertion everywhere.  Malformed/truncated frames must raise WireError
+and never over-read.  The service half: a binary request against the live
+HTTP service must produce the byte-for-byte same payload as its JSON twin,
+under every negotiation combination (binary-in/JSON-out, JSON-in/
+binary-out, gzip), with /health advertising the capability and
+REPORTER_WIRE=0 turning the whole plane off.
+"""
+
+import gzip
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from reporter_tpu.matching import MatcherConfig, SegmentMatcher
+from reporter_tpu.serve import ReporterService, wire
+from reporter_tpu.tiles.arrays import build_graph_arrays
+from reporter_tpu.tiles.network import grid_city
+from reporter_tpu.tiles.ubodt import build_ubodt
+
+
+def _strip(body):
+    """Drop the decode's ``_columns`` transport side channel."""
+    if isinstance(body, dict) and "traces" in body:
+        for tr in body["traces"]:
+            tr.pop("_columns", None)
+    elif isinstance(body, dict):
+        body.pop("_columns", None)
+    return body
+
+
+def _jeq(a, b):
+    assert json.dumps(a, sort_keys=True) == json.dumps(b, sort_keys=True)
+
+
+def _random_trace(rng, i, n_pts):
+    pts = []
+    for j in range(n_pts):
+        lat = float(rng.uniform(-90, 90))
+        lon = float(rng.uniform(-180, 180))
+        t = 1_460_000_000 + 15 * j
+        mode = rng.integers(0, 3)
+        if mode == 0:       # all-float columns
+            t = float(t) + float(rng.uniform(0, 1))
+        elif mode == 1:     # all-int lat/lon/time
+            lat, lon = int(lat), int(lon)
+        # mode 2: mixed — leave lat/lon float, time int
+        p = {"lat": lat, "lon": lon, "time": t}
+        if rng.integers(0, 2):
+            p["accuracy"] = int(rng.integers(1, 30))
+        pts.append(p)
+    tr = {"trace": pts}
+    if rng.integers(0, 4):
+        tr["uuid"] = "véh-Ω-%d" % i        # unicode uuids must survive
+    if rng.integers(0, 2):
+        tr["match_options"] = {"mode": "auto", "report_levels": [0, 1]}
+    if rng.integers(0, 3) == 0:
+        tr["stream"] = True
+    if rng.integers(0, 4) == 0:
+        del tr["trace"]                    # absent-key traces round-trip
+    return tr
+
+
+class TestRequestCodec:
+    def test_round_trip_fuzz(self):
+        rng = np.random.default_rng(42)
+        for _ in range(40):
+            body = {"traces": [
+                _random_trace(rng, i, int(rng.integers(0, 12)))
+                for i in range(int(rng.integers(0, 6)))]}
+            if rng.integers(0, 2):
+                body["mode"] = "auto"       # body-level extras
+            buf = wire.encode_request(body)
+            _jeq(_strip(wire.decode_request(buf)), body)
+
+    def test_single_trace_flag(self):
+        tr = {"uuid": "v", "trace": [
+            {"lat": 1.5, "lon": 2.5, "time": 1000}]}
+        buf = wire.encode_request(tr)
+        out = wire.decode_request(buf)
+        assert "traces" not in out
+        _jeq(_strip(out), tr)
+
+    def test_int_float_typing_exact(self):
+        tr = {"trace": [{"lat": 1, "lon": 2.0, "time": 10},
+                        {"lat": 3.5, "lon": 4, "time": 20.5}]}
+        pts = wire.decode_request(wire.encode_request(tr))["trace"]
+        assert isinstance(pts[0]["lat"], int) and isinstance(
+            pts[0]["lon"], float) and isinstance(pts[0]["time"], int)
+        assert isinstance(pts[1]["lat"], float) and isinstance(
+            pts[1]["lon"], int) and isinstance(pts[1]["time"], float)
+
+    def test_accuracy_column_typing_and_irregularity(self):
+        """Uniform per-point accuracy rides the fourth f64 column with
+        exact int/float typing; irregular presence (or non-numeric
+        values) falls back to the extras tail — both round-trip."""
+        uniform = {"trace": [
+            {"lat": 1.0, "lon": 2.0, "time": 10, "accuracy": 5},
+            {"lat": 1.5, "lon": 2.5, "time": 20, "accuracy": 7.5}]}
+        pts = wire.decode_request(wire.encode_request(uniform))["trace"]
+        assert isinstance(pts[0]["accuracy"], int)
+        assert isinstance(pts[1]["accuracy"], float)
+        for irregular in (
+                {"trace": [{"lat": 1.0, "lon": 2.0, "time": 10,
+                            "accuracy": 5},
+                           {"lat": 1.5, "lon": 2.5, "time": 20}]},
+                {"trace": [{"lat": 1.0, "lon": 2.0, "time": 10,
+                            "accuracy": "gps"}]},
+                {"trace": [{"lat": 1.0, "lon": 2.0, "time": 10,
+                            "accuracy": True}]},
+                {"trace": [{"lat": 1.0, "lon": 2.0, "time": 10,
+                            "accuracy": 1 << 60}]}):
+            out = _strip(wire.decode_request(wire.encode_request(irregular)))
+            _jeq(out, irregular)
+        # uniform accuracy must be cheaper on the wire than tail spill
+        many = {"trace": [{"lat": 1.0, "lon": 2.0, "time": 10 + i,
+                           "accuracy": 5} for i in range(64)]}
+        spilly = {"trace": [dict(p, accuracy="5") for p in many["trace"]]}
+        assert len(wire.encode_request(many)) < len(
+            wire.encode_request(spilly))
+
+    def test_rejects_uncarriable_bodies(self):
+        bad = [
+            {"trace": [{"lat": "x", "lon": 0, "time": 0}]},
+            {"trace": [{"lat": True, "lon": 0, "time": 0}]},
+            {"trace": [{"lat": 0, "lon": 0}]},                 # missing time
+            {"trace": [{"lat": 0, "lon": 0, "time": 1 << 53}]},
+            {"traces": "nope"},
+            {"trace": "nope"},
+        ]
+        for body in bad:
+            with pytest.raises(wire.WireError):
+                wire.encode_request(body)
+
+    def test_columns_side_channel(self):
+        tr = {"trace": [{"lat": 1.25, "lon": -2.5, "time": 100},
+                        {"lat": 3.0, "lon": 4.0, "time": 115}]}
+        out = wire.decode_request(wire.encode_request(tr))
+        c = out["_columns"]
+        assert c["lat"].dtype == np.float64
+        assert c["lat"].tolist() == [1.25, 3.0]
+        assert c["time"].tolist() == [100.0, 115.0]
+
+    def test_sniff_request(self):
+        body = {"traces": [
+            {"uuid": "a", "stream": True,
+             "trace": [{"lat": 10.5, "lon": -20.5, "time": 1}]},
+            {"uuid": "b", "trace": []},
+            {"trace": [{"lat": 1.0, "lon": 2.0, "time": 3}]},
+        ]}
+        sniff = wire.sniff_request(wire.encode_request(body))
+        assert sniff[0] == {"uuid": "a", "stream": True,
+                            "lat": 10.5, "lon": -20.5}
+        assert sniff[1]["uuid"] == "b" and sniff[1]["lat"] is None
+        assert sniff[2]["uuid"] is None and not sniff[2]["stream"]
+
+
+def _result(i, n_segs=3, n_reps=2):
+    segs = []
+    for s in range(n_segs):
+        segs.append({
+            "way_ids": [100 + s], "internal": bool(s % 2),
+            "queue_length": 0, "begin_shape_index": s,
+            "end_shape_index": s + 1,
+            "segment_id": 7000 + s if s else -1,
+            "start_time": -1 if s == 0 else round(1000.0 + s, 2),
+            "end_time": round(1001.0 + s, 2), "length": -1 if s == 0 else 150.0,
+        })
+    reps = [{"id": 7000 + r, "t0": 1000.0 + r, "t1": 1001.0 + r,
+             "length": 150.0, "queue_length": 0} for r in range(n_reps)]
+    if reps:
+        reps[0]["next_id"] = 7001
+        reps[0]["huge"] = 1 << 60           # spills to the tail exactly
+    return {"segment_matcher": {"segments": segs, "mode": "auto"},
+            "datastore": {"reports": reps, "mode": "auto"},
+            "stats": {"i": i}}
+
+
+class TestResponseCodec:
+    def test_batch_round_trip(self):
+        payload = {"results": [_result(0), _result(1, 0, 0),
+                               {"error": "trace too short"},  # raw rest path
+                               _result(3, 5, 1)],
+                   "units": "km"}
+        _jeq(wire.decode_response(wire.encode_response(payload)), payload)
+
+    def test_single_round_trip(self):
+        payload = _result(0)
+        buf = wire.encode_response(payload, single=True)
+        out = wire.decode_response(buf)
+        assert "results" not in out
+        _jeq(out, payload)
+
+    def test_degraded_flag_peek(self):
+        p = {"results": [_result(0)], "degraded": True}
+        buf = wire.encode_response(p)
+        assert wire.response_degraded(buf)
+        _jeq(wire.decode_response(buf), p)
+        assert not wire.response_degraded(
+            wire.encode_response({"results": []}))
+        assert not wire.response_degraded(b"RPTCgarbage")
+        assert not wire.response_degraded(b"")
+
+    def test_unknown_keys_round_trip(self):
+        """Schema growth must not need a wire version bump: unknown
+        segment/report/result keys ride the tail."""
+        res = _result(0)
+        res["segment_matcher"]["segments"][0]["new_field"] = [1, {"a": 2}]
+        res["datastore"]["reports"][0]["confidence"] = 0.75
+        res["future_block"] = {"x": None}
+        payload = {"results": [res]}
+        _jeq(wire.decode_response(wire.encode_response(payload)), payload)
+
+
+class TestMalformedFrames:
+    def test_truncation_never_overreads(self):
+        rng = np.random.default_rng(7)
+        req = wire.encode_request({"traces": [
+            _random_trace(rng, i, 6) for i in range(3)]})
+        resp = wire.encode_response({"results": [_result(0), _result(1)]})
+        for buf, dec in ((req, wire.decode_request),
+                         (resp, wire.decode_response)):
+            for cut in range(0, len(buf) - 1, 3):
+                with pytest.raises(wire.WireError):
+                    dec(buf[:cut])
+
+    def test_header_validation(self):
+        req = wire.encode_request({"traces": []})
+        with pytest.raises(wire.WireError):
+            wire.decode_request(b"XXXX" + req[4:])     # bad magic
+        with pytest.raises(wire.WireError):
+            wire.decode_request(req[:4] + b"\x09" + req[5:])  # bad version
+        with pytest.raises(wire.WireError):
+            wire.decode_request(wire.encode_response({"results": []}))
+        with pytest.raises(wire.WireError):
+            wire.decode_response(req)                  # kind mismatch
+
+    def test_lying_interior_lengths(self):
+        """A frame whose length fields point past the buffer must raise,
+        not over-read (every count is bounds-checked)."""
+        import struct
+
+        buf = bytearray(wire.encode_request(
+            {"traces": [{"trace": [{"lat": 1.0, "lon": 2.0, "time": 3}]}]}))
+        struct.pack_into("<I", buf, 8, 0xFFFFFF)       # n_traces lie
+        with pytest.raises(wire.WireError):
+            wire.decode_request(bytes(buf))
+
+    def test_is_wire(self):
+        assert wire.is_wire("application/x-reporter-columnar")
+        assert wire.is_wire("application/x-reporter-columnar; charset=x")
+        assert not wire.is_wire("application/json")
+        assert not wire.is_wire(None)
+        assert not wire.is_wire("")
+
+
+# -- live-service differential ----------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def served():
+    city = grid_city(rows=5, cols=5, spacing_m=150.0)
+    arrays = build_graph_arrays(city, cell_size=100.0)
+    ubodt = build_ubodt(arrays, delta=2000.0)
+    matcher = SegmentMatcher(arrays=arrays, ubodt=ubodt,
+                             config=MatcherConfig())
+    service = ReporterService(matcher, max_wait_ms=5.0)
+    httpd = service.make_server("127.0.0.1", 0)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    url = "http://127.0.0.1:%d" % httpd.server_port
+    yield url, arrays, service
+    httpd.shutdown()
+
+
+def _post(url, data, headers):
+    req = urllib.request.Request(url, data=data, headers=headers)
+    try:
+        with urllib.request.urlopen(req, timeout=60) as r:
+            return r.status, dict(r.headers), r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, dict(e.headers), e.read()
+
+
+def _street_trace(arrays, row=2, n=10, uuid="veh-w"):
+    nodes = [row * 5 + c for c in range(5)]
+    t = np.linspace(0.05, 0.9, n)
+    xs = np.interp(t, np.linspace(0, 1, 5), arrays.node_x[nodes])
+    ys = np.interp(t, np.linspace(0, 1, 5), arrays.node_y[nodes])
+    lat, lon = arrays.proj.to_latlon(xs, ys)
+    return {"uuid": uuid,
+            "trace": [{"lat": float(a), "lon": float(o), "time": 1000 + 15 * i}
+                      for i, (a, o) in enumerate(zip(lat, lon))],
+            "match_options": {"mode": "auto", "report_levels": [0, 1, 2],
+                              "transition_levels": [0, 1, 2]}}
+
+
+JSON_H = {"Content-Type": "application/json"}
+BIN_H = {"Content-Type": wire.CONTENT_TYPE, "Accept": wire.CONTENT_TYPE}
+
+
+class TestServiceDifferential:
+    def test_batch_json_vs_binary(self, served):
+        url, arrays, _ = served
+        body = {"traces": [_street_trace(arrays, row=r, uuid="veh-%d" % r)
+                           for r in (1, 2, 3)]}
+        _, _, jraw = _post(url + "/trace_attributes_batch",
+                           json.dumps(body).encode(), JSON_H)
+        code, hdrs, braw = _post(url + "/trace_attributes_batch",
+                                 wire.encode_request(body), BIN_H)
+        assert code == 200
+        assert wire.is_wire(hdrs.get("Content-Type"))
+        assert len(braw) < len(jraw)   # the point of the exercise
+        _jeq(wire.decode_response(braw), json.loads(jraw))
+
+    def test_single_report_json_vs_binary(self, served):
+        url, arrays, _ = served
+        tr = _street_trace(arrays)
+        _, _, jraw = _post(url + "/report", json.dumps(tr).encode(), JSON_H)
+        code, hdrs, braw = _post(url + "/report",
+                                 wire.encode_request(tr), BIN_H)
+        assert code == 200 and wire.is_wire(hdrs.get("Content-Type"))
+        _jeq(wire.decode_response(braw), json.loads(jraw))
+
+    def test_binary_in_json_out(self, served):
+        url, arrays, _ = served
+        tr = _street_trace(arrays)
+        code, hdrs, raw = _post(url + "/report", wire.encode_request(tr),
+                                {"Content-Type": wire.CONTENT_TYPE})
+        assert code == 200 and not wire.is_wire(hdrs.get("Content-Type"))
+        _, _, jraw = _post(url + "/report", json.dumps(tr).encode(), JSON_H)
+        _jeq(json.loads(raw), json.loads(jraw))
+
+    def test_gzip_request(self, served):
+        url, arrays, _ = served
+        tr = _street_trace(arrays)
+        code, _, raw = _post(
+            url + "/report", gzip.compress(json.dumps(tr).encode()),
+            {"Content-Type": "application/json",
+             "Content-Encoding": "gzip"})
+        assert code == 200
+        _, _, jraw = _post(url + "/report", json.dumps(tr).encode(), JSON_H)
+        _jeq(json.loads(raw), json.loads(jraw))
+
+    def test_health_advertises_capabilities(self, served):
+        url, _, service = served
+        with urllib.request.urlopen(url + "/health", timeout=30) as r:
+            h = json.loads(r.read())
+        assert "gzip" in h["capabilities"]
+        assert ("wire-columnar" in h["capabilities"]) == service.wire_enabled
+
+    def test_bad_gzip_is_400(self, served):
+        url, _, _ = served
+        code, _, raw = _post(
+            url + "/report", b"\x1f\x8bnot-gzip-at-all",
+            {"Content-Type": "application/json",
+             "Content-Encoding": "gzip"})
+        assert code == 400 and b"error" in raw
+
+    def test_unknown_content_encoding_is_415(self, served):
+        url, arrays, _ = served
+        code, _, _ = _post(
+            url + "/report", json.dumps(_street_trace(arrays)).encode(),
+            {"Content-Type": "application/json", "Content-Encoding": "br"})
+        assert code == 415
+
+    def test_garbage_binary_frame_is_400(self, served):
+        url, _, _ = served
+        code, _, _ = _post(url + "/report", b"RPTC\x01\x01\x00\x00junk",
+                           {"Content-Type": wire.CONTENT_TYPE})
+        assert code == 400
+
+    def test_wire_disabled_rejects_binary(self, served):
+        url, arrays, service = served
+        tr = _street_trace(arrays)
+        service.wire_enabled = False
+        try:
+            code, _, _ = _post(url + "/report", wire.encode_request(tr),
+                               BIN_H)
+            assert code == 415
+            # and the capability disappears from /health
+            with urllib.request.urlopen(url + "/health", timeout=30) as r:
+                h = json.loads(r.read())
+            assert "wire-columnar" not in h["capabilities"]
+            # Accept alone must not produce a binary response either
+            code, hdrs, _ = _post(url + "/report",
+                                  json.dumps(tr).encode(),
+                                  dict(JSON_H, Accept=wire.CONTENT_TYPE))
+            assert code == 200 and not wire.is_wire(hdrs.get("Content-Type"))
+        finally:
+            service.wire_enabled = True
+
+
+def test_cli_env_defaults_restored(tmp_path, monkeypatch):
+    """The serve entrypoint's REPORTER_WIRE / REPORTER_HOST_PACK
+    setdefaults must not outlive main(): an in-process CLI caller would
+    otherwise leak serving defaults into library-default code."""
+    import reporter_tpu.serve.__main__ as cli
+
+    for k in ("REPORTER_WIRE", "REPORTER_HOST_PACK"):
+        monkeypatch.delenv(k, raising=False)
+    conf = tmp_path / "conf.json"
+    conf.write_text(json.dumps({
+        "network": {"type": "file", "path": str(tmp_path / "missing.json")},
+        "warmup": False,
+    }))
+    rc = cli.main(["serve", str(conf), "127.0.0.1:0"])
+    assert rc == 1
+    import os
+    assert "REPORTER_WIRE" not in os.environ
+    assert "REPORTER_HOST_PACK" not in os.environ
+    # an EXPLICIT env value is the operator's, not the default's: it stays
+    monkeypatch.setenv("REPORTER_WIRE", "0")
+    cli.main(["serve", str(conf), "127.0.0.1:0"])
+    assert os.environ["REPORTER_WIRE"] == "0"

@@ -462,28 +462,33 @@ class Sample:
         return self.done - self.sent
 
 
-def _post(url: str, body: bytes,
-          timeout: float) -> Tuple[int, bool, Optional[str]]:
+def _post(url: str, body: bytes, timeout: float,
+          headers: Optional[dict] = None) -> Tuple[int, bool, Optional[str]]:
     try:
         status, hdrs, data = _POOL.request(
             "POST", url, body=body,
-            headers={"Content-Type": "application/json"},
+            headers=headers or {"Content-Type": "application/json"},
             timeout=timeout, target="loadgen")
     except Exception:  # noqa: BLE001 - timeout/reset: code 0, still counted
         return 0, False, None
     replica = hdrs.get("X-Reporter-Replica")
     degraded = False
     if status == 200:
-        try:
-            degraded = bool(json.loads(data.decode()).get("degraded"))
-        except (ValueError, UnicodeDecodeError):
-            degraded = False
+        if data[:4] == b"RPTC":  # binary columnar response frame
+            from reporter_tpu.serve import wire as _wire
+            degraded = _wire.response_degraded(data)
+        else:
+            try:
+                degraded = bool(json.loads(data.decode()).get("degraded"))
+            except (ValueError, UnicodeDecodeError):
+                degraded = False
     return status, degraded, replica
 
 
 def run_load(url: str, requests: List[dict], schedule: List[float],
-             concurrency: int = 32,
-             timeout_s: float = 10.0) -> Tuple[List[Sample], float]:
+             concurrency: int = 32, timeout_s: float = 10.0,
+             wire_mode: str = "json",
+             gzip_body: bool = False) -> Tuple[List[Sample], float]:
     """Send every request at its scheduled offset (or as soon after as a
     worker frees up — the backlog then SHOWS in the recorded latency).
     The whole schedule is always drained: a hung server cannot make the
@@ -495,10 +500,27 @@ def run_load(url: str, requests: List[dict], schedule: List[float],
     offsets (the streaming scenario's windowed-rebatch baseline buffers
     points client-side the way the stream topology does, so each point's
     latency is measured against ITS OWN arrival slot, not the window
-    flush).  Underscore keys never reach the wire."""
-    bodies = [json.dumps({k: v for k, v in r.items()
-                          if not str(k).startswith("_")},
-                         separators=(",", ":")).encode() for r in requests]
+    flush).  Underscore keys never reach the wire.
+
+    ``wire_mode="binary"`` encodes requests as columnar frames and
+    negotiates binary responses (serve/wire.py — the docs/http-api.md
+    "Wire formats" contract); ``gzip_body`` gzips whichever wire is in
+    use (Content-Encoding: gzip)."""
+    clean = [{k: v for k, v in r.items() if not str(k).startswith("_")}
+             for r in requests]
+    headers = {"Content-Type": "application/json"}
+    if wire_mode == "binary":
+        from reporter_tpu.serve import wire as _wire
+        bodies = [_wire.encode_request(c) for c in clean]
+        headers = {"Content-Type": _wire.CONTENT_TYPE,
+                   "Accept": _wire.CONTENT_TYPE}
+    else:
+        bodies = [json.dumps(c, separators=(",", ":")).encode()
+                  for c in clean]
+    if gzip_body:
+        import gzip as _gzip
+        bodies = [_gzip.compress(b, compresslevel=1) for b in bodies]
+        headers["Content-Encoding"] = "gzip"
     samples: List[Optional[List[Sample]]] = [None] * len(requests)
     it = {"i": 0}
     lock = threading.Lock()
@@ -517,7 +539,8 @@ def run_load(url: str, requests: List[dict], schedule: List[float],
             if delay > 0:
                 time.sleep(delay)
             sent = time.monotonic()
-            code, degraded, replica = _post(url, bodies[i], timeout_s)
+            code, degraded, replica = _post(url, bodies[i], timeout_s,
+                                            headers=headers)
             done = time.monotonic()
             scheds = requests[i].get("_scheds") or [schedule[i]]
             samples[i] = [
@@ -796,6 +819,12 @@ def main(argv=None) -> int:
     ap.add_argument("--server-slo", action="store_true",
                     help="fetch GET /debug/slo after the run and require "
                          "the server verdict to AGREE with the client's")
+    ap.add_argument("--wire", choices=("json", "binary"), default="json",
+                    help="request/response wire: json (default) or the "
+                         "binary columnar frame (serve/wire.py; the "
+                         "service must advertise wire-columnar)")
+    ap.add_argument("--gzip", action="store_true",
+                    help="gzip request bodies (Content-Encoding: gzip)")
     ap.add_argument("--platform", default="cpu",
                     help="artifact provenance tag (cpu|tpu)")
     ap.add_argument("--out", default=None, help="artifact path (default "
@@ -913,7 +942,9 @@ def main(argv=None) -> int:
             stream_dropped += dropped
         samples, t0_epoch = run_load(base + "/report", reqs, schedule,
                                      concurrency=args.concurrency,
-                                     timeout_s=args.timeout_s)
+                                     timeout_s=args.timeout_s,
+                                     wire_mode=args.wire,
+                                     gzip_body=args.gzip)
         if not samples:
             sys.stderr.write("loadgen: no samples recorded\n")
             return 2
@@ -990,6 +1021,8 @@ def main(argv=None) -> int:
         # the run itself
         "url": base,
         "arrival": args.arrival,
+        "wire": args.wire,
+        "gzip": bool(args.gzip),
         "seed": args.seed,
         "mode": (("stream" if args.stream_window <= 1 else "stream-windowed")
                  if args.stream else

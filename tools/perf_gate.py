@@ -80,6 +80,16 @@ METRICS = {
     # CPU virtual devices share host cores, so CPU-bank efficiencies
     # (~1/devices) are only ever compared with other CPU banks.
     "mesh_scaling_efficiency": "higher",
+    # columnar host data plane (docs/performance.md "The columnar host
+    # data plane"): the padded-batch packer's points/s at the canonical
+    # [512, 64] shape, flattened from the artifact ``host_pipeline``
+    # block — the host-side throughput the vectorized scatter bought;
+    # regresses when it DROPS
+    "host_pack_points_per_sec": "higher",
+    # host share of (host + device) wall over a live match_many capture
+    # — the fraction the columnar plane exists to shrink; regresses when
+    # it RISES (host Python creeping back between the device dispatches)
+    "host_frac": "lower",
 }
 
 # default relative-drop thresholds per provenance: CPU rates move with
@@ -119,6 +129,15 @@ def load_bench_line(path: str) -> dict:
             mesh.get("scaling_efficiency"), (int, float)):
         line.setdefault("mesh_scaling_efficiency",
                         mesh["scaling_efficiency"])
+    hp = line.get("host_pipeline")
+    if isinstance(hp, dict):
+        pack = hp.get("pack")
+        if isinstance(pack, dict) and isinstance(
+                pack.get("host_pack_points_per_sec"), (int, float)):
+            line.setdefault("host_pack_points_per_sec",
+                            pack["host_pack_points_per_sec"])
+        if isinstance(hp.get("host_frac"), (int, float)):
+            line.setdefault("host_frac", hp["host_frac"])
     line["_path"] = path
     return line
 
